@@ -1,0 +1,28 @@
+"""k8s1m_tpu — a TPU-native million-node Kubernetes scheduling framework.
+
+Re-implements the capabilities of bchess/k8s-1m (reference mounted at
+/root/reference) with a TPU-first architecture:
+
+- ``snapshot``    — HBM-resident node table + host-side feature compiler
+                    (replaces the label-sharded informer caches of
+                    dist-scheduler, reference cmd/dist-scheduler/scheduler.go:201-219).
+- ``plugins``     — scheduling-framework Filter/Score plugins as vmapped
+                    tensor kernels (replaces the forked kube-scheduler's
+                    per-pod Go hot loop, ~560us/pod on 8,670 cores).
+- ``engine``      — the per-batch scheduling cycle: filter -> score ->
+                    masked top-k with random tie-break -> greedy conflict
+                    resolution (replaces scatter/gather + DistPermit +
+                    ScoreEvaluator, reference pkg/scoreevaluator/scoreevaluator.go:45-126).
+- ``parallel``    — 2D device-mesh sharding (pod-batch x node-shard) via
+                    shard_map; ICI collectives replace the fan-out-10 relay
+                    tree and CollectScore gRPC gather
+                    (reference pkg/schedulerset/schedulerset.go:161-193).
+- ``cluster``     — KWOK-style synthetic cluster + load generators
+                    (make_nodes / make_pods equivalents, reference kwok/).
+- ``oracle``      — pure-Python reference scheduler used as the
+                    differential-correctness oracle.
+- ``store``       — bindings for the native (C++) memetcd control-plane
+                    store (reference mem_etcd/, Rust).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,90 @@
+"""Static shape configuration for device-resident tables.
+
+Everything that lands on the TPU has a static, padded shape: XLA traces the
+scheduling step once per (TableSpec, PodSpec) bucket and reuses the
+executable. Growing the cluster past ``max_nodes`` re-buckets to the next
+power of two (one recompile), mirroring how the reference grows by adding
+scheduler shards (reference README.adoc:697-712) — except here a "shard" is
+a slice of one HBM-resident tensor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSpec:
+    """Shape of the device-resident node table.
+
+    The reference keeps ~100KB/node in Go informer caches
+    (reference RUNNING.adoc:193); this table costs a few hundred bytes/node,
+    so 1M nodes fit comfortably in a single chip's HBM.
+    """
+
+    max_nodes: int = 1 << 20
+    label_slots: int = 16      # padded label (key,value) pairs per node
+    taint_slots: int = 8       # padded taints per node
+    max_zones: int = 512       # distinct topology.kubernetes.io/zone values
+    max_regions: int = 64
+    # Active topology-spread / inter-pod-affinity constraint slots.  Slots
+    # are interned host-side and recycled; only constraints referenced by
+    # in-flight pods need to be resident.
+    spread_slots: int = 16
+    affinity_slots: int = 16
+
+    def __post_init__(self):
+        if self.max_nodes & (self.max_nodes - 1):
+            raise ValueError("max_nodes must be a power of two")
+
+
+@dataclasses.dataclass(frozen=True)
+class PodSpec:
+    """Shape of one encoded pod batch."""
+
+    batch: int = 256
+    tol_slots: int = 8         # tolerations per pod
+    aff_terms: int = 4         # required nodeAffinity terms (OR of terms)
+    aff_exprs: int = 4         # expressions per term (ANDed)
+    aff_values: int = 8        # values per expression (In/NotIn sets)
+    pref_terms: int = 4        # preferred nodeAffinity terms
+    spread_refs: int = 4       # topologySpreadConstraints per pod
+    affinity_refs: int = 4     # (anti)affinity terms per pod
+    top_k: int = 4             # bind candidates kept per pod for conflict resolution
+
+
+# Sentinel id meaning "no string" in every interned column.  Real ids start
+# at 1 so zero-initialised padding is automatically "absent".
+NONE_ID = 0
+
+# Taint / toleration effects (reference mem of upstream v1.Taint effects).
+EFFECT_NONE = 0                # toleration with no effect: matches all
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+
+# Toleration operators.
+TOL_OP_EQUAL = 0
+TOL_OP_EXISTS = 1
+
+# NodeSelector operators (upstream v1.NodeSelectorOperator).
+SEL_OP_IN = 0
+SEL_OP_NOT_IN = 1
+SEL_OP_EXISTS = 2
+SEL_OP_DOES_NOT_EXIST = 3
+SEL_OP_GT = 4
+SEL_OP_LT = 5
+
+# Topology keys get dedicated dense columns (domain-count tables need dense
+# domain ids; generic labels stay in the hashed slots).
+TOPO_HOSTNAME = 0              # kubernetes.io/hostname — domain == node
+TOPO_ZONE = 1                  # topology.kubernetes.io/zone
+TOPO_REGION = 2                # topology.kubernetes.io/region
+
+# whenUnsatisfiable modes for topology spread.
+SPREAD_DO_NOT_SCHEDULE = 0
+SPREAD_SCHEDULE_ANYWAY = 1
+
+# Numeric value parsed out of a label for Gt/Lt node-affinity operators;
+# this sentinel means "not an integer".
+NO_NUMERIC = -(1 << 31)

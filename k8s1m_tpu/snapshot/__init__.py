@@ -1,0 +1,27 @@
+from k8s1m_tpu.snapshot.interning import Interner, Vocab
+from k8s1m_tpu.snapshot.node_table import NodeTable, NodeTableHost, NodeInfo, Taint
+from k8s1m_tpu.snapshot.pod_encoding import (
+    PodBatch,
+    PodBatchHost,
+    PodInfo,
+    Toleration,
+    SelectorRequirement,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+)
+
+__all__ = [
+    "Interner",
+    "Vocab",
+    "NodeTable",
+    "NodeTableHost",
+    "NodeInfo",
+    "Taint",
+    "PodBatch",
+    "PodBatchHost",
+    "PodInfo",
+    "Toleration",
+    "SelectorRequirement",
+    "NodeSelectorTerm",
+    "PreferredSchedulingTerm",
+]

@@ -1,0 +1,83 @@
+"""Host-side string interning.
+
+Labels, taints and selector values are strings in Kubernetes but the TPU
+only sees integer ids.  The Interner is the boundary: every string that can
+appear in a filter/score decision is mapped to a stable int32 id on the
+host, once, at snapshot-delta-apply time.  The device never recompiles when
+new strings appear — ids are data, not shapes.
+
+The reference does the same thing implicitly: its Go scheduler caches parse
+label strings into map keys per informer event; here the parse happens once
+per string ever seen (reference cmd/dist-scheduler/leader_activities.go:112-172
+strips fields to shrink that cache; our equivalent is this table).
+"""
+
+from __future__ import annotations
+
+from k8s1m_tpu.config import NO_NUMERIC, NONE_ID
+
+
+class Interner:
+    """Bidirectional str<->int table. Id 0 is reserved for "absent"."""
+
+    def __init__(self) -> None:
+        self._to_id: dict[str, int] = {}
+        self._to_str: list[str | None] = [None]
+
+    def intern(self, s: str | None) -> int:
+        if s is None:
+            return NONE_ID
+        i = self._to_id.get(s)
+        if i is None:
+            i = len(self._to_str)
+            self._to_id[s] = i
+            self._to_str.append(s)
+        return i
+
+    def lookup(self, s: str | None) -> int:
+        """Like intern, but returns NONE_ID for never-seen strings.
+
+        Used when encoding *queries* (pod selectors): a value that was never
+        interned cannot match any node, and must not grow the table.
+        """
+        if s is None:
+            return NONE_ID
+        return self._to_id.get(s, NONE_ID)
+
+    def string(self, i: int) -> str | None:
+        return self._to_str[i]
+
+    def __len__(self) -> int:
+        return len(self._to_str)
+
+    def __contains__(self, s: str) -> bool:
+        return s in self._to_id
+
+
+def numeric_of(value: str) -> int:
+    """Integer value of a label for Gt/Lt selector ops, or NO_NUMERIC.
+
+    Upstream parses the node label with strconv.ParseInt; non-integers make
+    Gt/Lt requirements unsatisfiable.
+    """
+    try:
+        return int(value, 10)
+    except (ValueError, TypeError):
+        return NO_NUMERIC
+
+
+class Vocab:
+    """The full interning state shared by a snapshot.
+
+    Separate namespaces so e.g. a taint key and a label value never collide
+    into a false match.
+    """
+
+    def __init__(self) -> None:
+        self.label_keys = Interner()
+        self.label_values = Interner()
+        self.taint_keys = Interner()
+        self.taint_values = Interner()
+        self.node_names = Interner()
+        self.zones = Interner()
+        self.regions = Interner()

@@ -1,0 +1,329 @@
+"""The HBM-resident node table: a struct-of-arrays snapshot of every node.
+
+This replaces the reference's 256 label-sharded Go informer caches
+(reference cmd/dist-scheduler/scheduler.go:201-219, ~100KB/node in RAM per
+RUNNING.adoc:193) with one padded tensor table: ~250 bytes/node, so a
+million nodes is ~250MB — a fraction of one chip's HBM.  The table is a JAX
+pytree; sharding it over the mesh's node axis is the TPU equivalent of the
+reference's `dist-scheduler.dev/scheduler` label sharding
+(reference cmd/dist-scheduler/leader_activities.go:227-343).
+
+Mutation happens two ways, both jit-compatible scatters:
+- ``apply_delta``   — coordinator-streamed node add/update/remove, the
+  equivalent of informer events (revision-ordered by the coordinator the
+  way mem_etcd's notify thread orders watch events, reference
+  mem_etcd/src/store.rs:444-533).
+- ``commit_binds``  — the engine folds its own bind decisions back into
+  requested-resources before the next batch, the equivalent of the
+  scheduler's assume/bind cache update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from k8s1m_tpu.config import (
+    EFFECT_NO_SCHEDULE,
+    NONE_ID,
+    TableSpec,
+)
+from k8s1m_tpu.snapshot.interning import Vocab, numeric_of
+
+UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"
+ZONE_LABEL = "topology.kubernetes.io/zone"
+REGION_LABEL = "topology.kubernetes.io/region"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+
+@dataclasses.dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: int = EFFECT_NO_SCHEDULE
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    """Host-side description of one node (the parsed KWOK/real Node object)."""
+
+    name: str
+    cpu_milli: int = 4000
+    mem_kib: int = 8 << 20          # 8 GiB
+    pods: int = 110
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    taints: list[Taint] = dataclasses.field(default_factory=list)
+    unschedulable: bool = False
+
+
+@struct.dataclass
+class NodeTable:
+    """Device-resident snapshot. All arrays padded to spec.max_nodes rows."""
+
+    valid: jax.Array        # bool[N] — row is a live node
+    # Allocatable (reference: node.status.allocatable).
+    cpu_alloc: jax.Array    # i32[N] milliCPU
+    mem_alloc: jax.Array    # i32[N] KiB  (2 TiB/node cap; KWOK nodes are far below)
+    pods_alloc: jax.Array   # i32[N]
+    # Sum of requests of pods assumed/bound to the node.
+    cpu_req: jax.Array      # i32[N]
+    mem_req: jax.Array      # i32[N]
+    pods_req: jax.Array     # i32[N]
+    # Interned labels: padded (key,value) slots + pre-parsed numeric value
+    # for Gt/Lt selector operators.
+    label_key: jax.Array    # i32[N, L]
+    label_val: jax.Array    # i32[N, L]
+    label_num: jax.Array    # i32[N, L]
+    # Taints (node.spec.unschedulable is folded in as the canonical
+    # node.kubernetes.io/unschedulable:NoSchedule taint).
+    taint_key: jax.Array    # i32[N, T]
+    taint_val: jax.Array    # i32[N, T]
+    taint_effect: jax.Array  # i32[N, T]
+    # Dense topology-domain ids for the count tables.
+    zone: jax.Array         # i32[N] in [0, max_zones)
+    region: jax.Array       # i32[N] in [0, max_regions)
+    name_id: jax.Array      # i32[N] interned node name (NodeName plugin)
+
+    @property
+    def num_rows(self) -> int:
+        return self.valid.shape[0]
+
+    def free(self):
+        """(cpu, mem, pods) still unrequested, for Fit and LeastAllocated."""
+        return (
+            self.cpu_alloc - self.cpu_req,
+            self.mem_alloc - self.mem_req,
+            self.pods_alloc - self.pods_req,
+        )
+
+
+def empty_table(spec: TableSpec) -> NodeTable:
+    n, l, t = spec.max_nodes, spec.label_slots, spec.taint_slots
+    i32 = jnp.int32
+    return NodeTable(
+        valid=jnp.zeros((n,), jnp.bool_),
+        cpu_alloc=jnp.zeros((n,), i32),
+        mem_alloc=jnp.zeros((n,), i32),
+        pods_alloc=jnp.zeros((n,), i32),
+        cpu_req=jnp.zeros((n,), i32),
+        mem_req=jnp.zeros((n,), i32),
+        pods_req=jnp.zeros((n,), i32),
+        label_key=jnp.zeros((n, l), i32),
+        label_val=jnp.zeros((n, l), i32),
+        label_num=jnp.zeros((n, l), i32),
+        taint_key=jnp.zeros((n, t), i32),
+        taint_val=jnp.zeros((n, t), i32),
+        taint_effect=jnp.zeros((n, t), i32),
+        zone=jnp.zeros((n,), i32),
+        region=jnp.zeros((n,), i32),
+        name_id=jnp.zeros((n,), i32),
+    )
+
+
+class NodeTableHost:
+    """Host-side builder/mirror of the node table (numpy, mutable).
+
+    The coordinator owns one of these: informer-style deltas mutate it and
+    are batched into device scatters.  It is also the feature compiler —
+    the only place node strings are parsed and interned.
+    """
+
+    def __init__(self, spec: TableSpec, vocab: Vocab | None = None) -> None:
+        self.spec = spec
+        self.vocab = vocab or Vocab()
+        n, l, t = spec.max_nodes, spec.label_slots, spec.taint_slots
+        self.valid = np.zeros((n,), np.bool_)
+        self.cpu_alloc = np.zeros((n,), np.int32)
+        self.mem_alloc = np.zeros((n,), np.int32)
+        self.pods_alloc = np.zeros((n,), np.int32)
+        self.cpu_req = np.zeros((n,), np.int32)
+        self.mem_req = np.zeros((n,), np.int32)
+        self.pods_req = np.zeros((n,), np.int32)
+        self.label_key = np.zeros((n, l), np.int32)
+        self.label_val = np.zeros((n, l), np.int32)
+        self.label_num = np.zeros((n, l), np.int32)
+        self.taint_key = np.zeros((n, t), np.int32)
+        self.taint_val = np.zeros((n, t), np.int32)
+        self.taint_effect = np.zeros((n, t), np.int32)
+        self.zone = np.zeros((n,), np.int32)
+        self.region = np.zeros((n,), np.int32)
+        self.name_id = np.zeros((n,), np.int32)
+        self._row_of: dict[str, int] = {}
+        self._free_rows: list[int] = []
+        self._next_row = 0
+
+    # ---- row management -------------------------------------------------
+
+    def row_of(self, name: str) -> int:
+        return self._row_of[name]
+
+    def _alloc_row(self, name: str) -> int:
+        if name in self._row_of:
+            return self._row_of[name]
+        if self._free_rows:
+            row = self._free_rows.pop()
+        else:
+            row = self._next_row
+            if row >= self.spec.max_nodes:
+                raise ValueError(
+                    f"node table full ({self.spec.max_nodes}); re-bucket TableSpec"
+                )
+            self._next_row += 1
+        self._row_of[name] = row
+        return row
+
+    # ---- deltas ---------------------------------------------------------
+
+    def upsert(self, node: NodeInfo) -> int:
+        """Add or update a node; returns its row."""
+        v = self.vocab
+        row = self._alloc_row(node.name)
+
+        labels = dict(node.labels)
+        labels.setdefault(HOSTNAME_LABEL, node.name)
+        if len(labels) > self.spec.label_slots:
+            raise ValueError(
+                f"node {node.name}: {len(labels)} labels > "
+                f"label_slots={self.spec.label_slots}"
+            )
+        lk = np.zeros((self.spec.label_slots,), np.int32)
+        lv = np.zeros_like(lk)
+        ln = np.zeros_like(lk)
+        for i, (k, val) in enumerate(sorted(labels.items())):
+            lk[i] = v.label_keys.intern(k)
+            lv[i] = v.label_values.intern(val)
+            ln[i] = numeric_of(val)
+
+        taints = list(node.taints)
+        if node.unschedulable:
+            taints.append(Taint(UNSCHEDULABLE_TAINT_KEY, "", EFFECT_NO_SCHEDULE))
+        if len(taints) > self.spec.taint_slots:
+            raise ValueError(
+                f"node {node.name}: {len(taints)} taints > "
+                f"taint_slots={self.spec.taint_slots}"
+            )
+        tk = np.zeros((self.spec.taint_slots,), np.int32)
+        tv = np.zeros_like(tk)
+        te = np.zeros_like(tk)
+        for i, taint in enumerate(taints):
+            tk[i] = v.taint_keys.intern(taint.key)
+            tv[i] = v.taint_values.intern(taint.value)
+            te[i] = taint.effect
+
+        zone_id = v.zones.intern(labels.get(ZONE_LABEL)) if ZONE_LABEL in labels else NONE_ID
+        region_id = (
+            v.regions.intern(labels.get(REGION_LABEL)) if REGION_LABEL in labels else NONE_ID
+        )
+        if zone_id >= self.spec.max_zones or region_id >= self.spec.max_regions:
+            raise ValueError("zone/region id overflow; grow TableSpec.max_zones/max_regions")
+
+        self.valid[row] = True
+        self.cpu_alloc[row] = node.cpu_milli
+        self.mem_alloc[row] = node.mem_kib
+        self.pods_alloc[row] = node.pods
+        self.label_key[row], self.label_val[row], self.label_num[row] = lk, lv, ln
+        self.taint_key[row], self.taint_val[row], self.taint_effect[row] = tk, tv, te
+        self.zone[row] = zone_id
+        self.region[row] = region_id
+        self.name_id[row] = v.node_names.intern(node.name)
+        return row
+
+    def remove(self, name: str) -> int:
+        row = self._row_of.pop(name)
+        self.valid[row] = False
+        # Zero the row so stale ids can't match future queries.
+        for arr in (
+            self.cpu_alloc, self.mem_alloc, self.pods_alloc,
+            self.cpu_req, self.mem_req, self.pods_req,
+            self.zone, self.region, self.name_id,
+        ):
+            arr[row] = 0
+        for arr in (
+            self.label_key, self.label_val, self.label_num,
+            self.taint_key, self.taint_val, self.taint_effect,
+        ):
+            arr[row] = 0
+        self._free_rows.append(row)
+        return row
+
+    def add_pod(self, name: str, cpu_milli: int, mem_kib: int) -> None:
+        """Account an already-bound pod (host mirror of commit_binds)."""
+        row = self._row_of[name]
+        self.cpu_req[row] += cpu_milli
+        self.mem_req[row] += mem_kib
+        self.pods_req[row] += 1
+
+    def remove_pod(self, name: str, cpu_milli: int, mem_kib: int) -> None:
+        row = self._row_of[name]
+        self.cpu_req[row] -= cpu_milli
+        self.mem_req[row] -= mem_kib
+        self.pods_req[row] -= 1
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._row_of)
+
+    # ---- device transfer ------------------------------------------------
+
+    def to_device(self, sharding=None) -> NodeTable:
+        def put(x):
+            return jax.device_put(jnp.asarray(x), sharding) if sharding else jnp.asarray(x)
+
+        return NodeTable(
+            valid=put(self.valid),
+            cpu_alloc=put(self.cpu_alloc),
+            mem_alloc=put(self.mem_alloc),
+            pods_alloc=put(self.pods_alloc),
+            cpu_req=put(self.cpu_req),
+            mem_req=put(self.mem_req),
+            pods_req=put(self.pods_req),
+            label_key=put(self.label_key),
+            label_val=put(self.label_val),
+            label_num=put(self.label_num),
+            taint_key=put(self.taint_key),
+            taint_val=put(self.taint_val),
+            taint_effect=put(self.taint_effect),
+            zone=put(self.zone),
+            region=put(self.region),
+            name_id=put(self.name_id),
+        )
+
+
+# ---- jit-side mutation ----------------------------------------------------
+
+
+def commit_binds(
+    table: NodeTable,
+    node_idx: jax.Array,   # i32[B] row of the node each pod bound to (or any row if invalid)
+    cpu_milli: jax.Array,  # i32[B]
+    mem_kib: jax.Array,    # i32[B]
+    bound: jax.Array,      # bool[B] — pod actually bound this cycle
+) -> NodeTable:
+    """Fold this batch's bind decisions into requested-resources.
+
+    The reference achieves the same feedback through the scheduler cache's
+    AssumePod immediately after Permit (the bind write to the apiserver is
+    async); here the batch commit *is* the assume step.
+    """
+    cpu = jnp.where(bound, cpu_milli, 0)
+    mem = jnp.where(bound, mem_kib, 0)
+    one = jnp.where(bound, 1, 0).astype(jnp.int32)
+    return table.replace(
+        cpu_req=table.cpu_req.at[node_idx].add(cpu),
+        mem_req=table.mem_req.at[node_idx].add(mem),
+        pods_req=table.pods_req.at[node_idx].add(one),
+    )
+
+
+def apply_delta(table: NodeTable, rows: jax.Array, delta: NodeTable) -> NodeTable:
+    """Scatter a batch of changed rows (host-compiled) into the device table.
+
+    ``delta`` holds D rows of freshly-compiled node features; ``rows`` are
+    their destinations.  This is the device half of the coordinator's
+    revision-ordered informer stream.
+    """
+    return jax.tree.map(lambda t, d: t.at[rows].set(d), table, delta)

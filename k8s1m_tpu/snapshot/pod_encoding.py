@@ -1,0 +1,295 @@
+"""Host-side pod feature compiler: PodInfo -> fixed-shape PodBatch tensors.
+
+The reference scatters each pod's raw protobuf to 256 shards over a relay
+tree (reference cmd/dist-scheduler/relay.go:23-178); here a *batch* of pods
+is compiled to padded int tensors once and broadcast to the mesh as data.
+Everything string-ish goes through the snapshot Vocab; values never seen on
+any node encode to NONE_ID, which naturally cannot match (upstream's
+behavior for a selector naming an unknown value).
+
+Padding conventions (checked by the kernels):
+- a toleration slot is live iff tol_valid — key id 0 with op Exists is the
+  legal "tolerate everything" toleration, so validity is explicit;
+- an affinity term/expr slot is live iff term_valid/expr_valid;
+- expr_vals is padded with NONE_ID, which never equals a live label value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from k8s1m_tpu.config import (
+    EFFECT_NONE,
+    NONE_ID,
+    PodSpec,
+    SEL_OP_DOES_NOT_EXIST,
+    SEL_OP_EXISTS,
+    SEL_OP_GT,
+    SEL_OP_IN,
+    SEL_OP_LT,
+    SEL_OP_NOT_IN,
+    SPREAD_DO_NOT_SCHEDULE,
+    TOL_OP_EQUAL,
+    TOL_OP_EXISTS,
+    TOPO_HOSTNAME,
+)
+from k8s1m_tpu.snapshot.interning import Vocab, numeric_of
+
+
+@dataclasses.dataclass
+class Toleration:
+    key: str = ""                  # "" tolerates every key (with op Exists)
+    op: int = TOL_OP_EXISTS
+    value: str = ""
+    effect: int = EFFECT_NONE      # EFFECT_NONE tolerates every effect
+
+
+@dataclasses.dataclass
+class SelectorRequirement:
+    key: str
+    op: int                        # SEL_OP_*
+    values: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class NodeSelectorTerm:
+    match_expressions: list[SelectorRequirement] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    term: NodeSelectorTerm
+
+
+@dataclasses.dataclass
+class SpreadConstraintRef:
+    """A pod's reference to an interned topologySpreadConstraint slot."""
+
+    cid: int                       # constraint slot in ConstraintState
+    topo: int                      # TOPO_* key
+    max_skew: int = 1
+    mode: int = SPREAD_DO_NOT_SCHEDULE
+    self_match: bool = True        # pod matches the constraint's own selector
+
+
+@dataclasses.dataclass
+class AffinityTermRef:
+    """A pod's reference to an interned (anti)affinity term slot."""
+
+    tid: int                       # term slot in ConstraintState
+    topo: int = TOPO_HOSTNAME
+    required: bool = False
+    anti: bool = False
+    weight: int = 1                # for preferred terms (1-100)
+    self_match: bool = False       # bound pod will itself match this term's selector
+
+
+@dataclasses.dataclass
+class PodInfo:
+    """Host-side description of one pending pod."""
+
+    name: str
+    namespace: str = "default"
+    cpu_milli: int = 100
+    mem_kib: int = 200 << 10       # 200 MiB
+    node_name: str | None = None
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    tolerations: list[Toleration] = dataclasses.field(default_factory=list)
+    required_terms: list[NodeSelectorTerm] = dataclasses.field(default_factory=list)
+    preferred_terms: list[PreferredSchedulingTerm] = dataclasses.field(default_factory=list)
+    spread_refs: list[SpreadConstraintRef] = dataclasses.field(default_factory=list)
+    affinity_refs: list[AffinityTermRef] = dataclasses.field(default_factory=list)
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@struct.dataclass
+class PodBatch:
+    """Fixed-shape encoded pod batch (B pods, padded)."""
+
+    valid: jax.Array         # bool[B]
+    cpu: jax.Array           # i32[B] milliCPU requested
+    mem: jax.Array           # i32[B] KiB requested
+    node_name_id: jax.Array  # i32[B] spec.nodeName (NONE_ID = unset)
+    # Tolerations.
+    tol_valid: jax.Array     # bool[B, TO]
+    tol_key: jax.Array       # i32[B, TO]
+    tol_val: jax.Array       # i32[B, TO]
+    tol_op: jax.Array        # i32[B, TO]
+    tol_effect: jax.Array    # i32[B, TO]
+    # spec.nodeSelector — ANDed exact-match pairs.
+    sel_valid: jax.Array     # bool[B, S]   (S = aff_exprs slots reused)
+    sel_key: jax.Array       # i32[B, S]
+    sel_val: jax.Array       # i32[B, S]
+    # requiredDuringSchedulingIgnoredDuringExecution — OR of terms, AND of exprs.
+    req_term_valid: jax.Array  # bool[B, T]
+    req_expr_valid: jax.Array  # bool[B, T, E]
+    req_key: jax.Array         # i32[B, T, E]
+    req_op: jax.Array          # i32[B, T, E]
+    req_vals: jax.Array        # i32[B, T, E, V]
+    req_num: jax.Array         # i32[B, T, E] parsed value for Gt/Lt
+    # preferredDuringScheduling terms (single-term each, weighted).
+    pref_term_valid: jax.Array  # bool[B, P]
+    pref_weight: jax.Array      # i32[B, P]
+    pref_expr_valid: jax.Array  # bool[B, P, E]
+    pref_key: jax.Array         # i32[B, P, E]
+    pref_op: jax.Array          # i32[B, P, E]
+    pref_vals: jax.Array        # i32[B, P, E, V]
+    pref_num: jax.Array         # i32[B, P, E]
+    # Topology-spread constraint references (slots in ConstraintState).
+    spread_valid: jax.Array     # bool[B, SR]
+    spread_cid: jax.Array       # i32[B, SR]
+    spread_topo: jax.Array      # i32[B, SR]
+    spread_max_skew: jax.Array  # i32[B, SR]
+    spread_mode: jax.Array      # i32[B, SR]
+    spread_self: jax.Array      # bool[B, SR]
+    # Inter-pod (anti)affinity term references.
+    ipa_valid: jax.Array        # bool[B, AR]
+    ipa_tid: jax.Array          # i32[B, AR]
+    ipa_topo: jax.Array         # i32[B, AR]
+    ipa_required: jax.Array     # bool[B, AR]
+    ipa_anti: jax.Array         # bool[B, AR]
+    ipa_weight: jax.Array       # i32[B, AR]
+    ipa_self: jax.Array         # bool[B, AR]
+
+    @property
+    def batch(self) -> int:
+        return self.valid.shape[0]
+
+
+class PodBatchHost:
+    """Compiles a list of PodInfo into one PodBatch."""
+
+    def __init__(self, spec: PodSpec, vocab: Vocab) -> None:
+        self.spec = spec
+        self.vocab = vocab
+
+    def encode(self, pods: list[PodInfo]) -> PodBatch:
+        s = self.spec
+        b = s.batch
+        if len(pods) > b:
+            raise ValueError(f"{len(pods)} pods > batch {b}")
+        v = self.vocab
+
+        def zi(*shape):
+            return np.zeros(shape, np.int32)
+
+        def zb(*shape):
+            return np.zeros(shape, np.bool_)
+
+        out = dict(
+            valid=zb(b), cpu=zi(b), mem=zi(b), node_name_id=zi(b),
+            tol_valid=zb(b, s.tol_slots), tol_key=zi(b, s.tol_slots),
+            tol_val=zi(b, s.tol_slots), tol_op=zi(b, s.tol_slots),
+            tol_effect=zi(b, s.tol_slots),
+            sel_valid=zb(b, s.aff_exprs), sel_key=zi(b, s.aff_exprs),
+            sel_val=zi(b, s.aff_exprs),
+            req_term_valid=zb(b, s.aff_terms),
+            req_expr_valid=zb(b, s.aff_terms, s.aff_exprs),
+            req_key=zi(b, s.aff_terms, s.aff_exprs),
+            req_op=zi(b, s.aff_terms, s.aff_exprs),
+            req_vals=zi(b, s.aff_terms, s.aff_exprs, s.aff_values),
+            req_num=zi(b, s.aff_terms, s.aff_exprs),
+            pref_term_valid=zb(b, s.pref_terms),
+            pref_weight=zi(b, s.pref_terms),
+            pref_expr_valid=zb(b, s.pref_terms, s.aff_exprs),
+            pref_key=zi(b, s.pref_terms, s.aff_exprs),
+            pref_op=zi(b, s.pref_terms, s.aff_exprs),
+            pref_vals=zi(b, s.pref_terms, s.aff_exprs, s.aff_values),
+            pref_num=zi(b, s.pref_terms, s.aff_exprs),
+            spread_valid=zb(b, s.spread_refs), spread_cid=zi(b, s.spread_refs),
+            spread_topo=zi(b, s.spread_refs), spread_max_skew=zi(b, s.spread_refs),
+            spread_mode=zi(b, s.spread_refs), spread_self=zb(b, s.spread_refs),
+            ipa_valid=zb(b, s.affinity_refs), ipa_tid=zi(b, s.affinity_refs),
+            ipa_topo=zi(b, s.affinity_refs), ipa_required=zb(b, s.affinity_refs),
+            ipa_anti=zb(b, s.affinity_refs), ipa_weight=zi(b, s.affinity_refs),
+            ipa_self=zb(b, s.affinity_refs),
+        )
+
+        for i, pod in enumerate(pods):
+            out["valid"][i] = True
+            out["cpu"][i] = pod.cpu_milli
+            out["mem"][i] = pod.mem_kib
+            out["node_name_id"][i] = v.node_names.lookup(pod.node_name)
+
+            if len(pod.tolerations) > s.tol_slots:
+                raise ValueError(f"pod {pod.key}: too many tolerations")
+            for j, tol in enumerate(pod.tolerations):
+                out["tol_valid"][i, j] = True
+                out["tol_key"][i, j] = v.taint_keys.lookup(tol.key or None)
+                out["tol_val"][i, j] = v.taint_values.lookup(tol.value)
+                out["tol_op"][i, j] = tol.op
+                out["tol_effect"][i, j] = tol.effect
+
+            if len(pod.node_selector) > s.aff_exprs:
+                raise ValueError(f"pod {pod.key}: nodeSelector too large")
+            for j, (k, val) in enumerate(sorted(pod.node_selector.items())):
+                out["sel_valid"][i, j] = True
+                out["sel_key"][i, j] = v.label_keys.lookup(k)
+                out["sel_val"][i, j] = v.label_values.lookup(val)
+
+            self._encode_terms(
+                i, pod.required_terms, out["req_term_valid"], out["req_expr_valid"],
+                out["req_key"], out["req_op"], out["req_vals"], out["req_num"],
+            )
+            if len(pod.preferred_terms) > s.pref_terms:
+                raise ValueError(f"pod {pod.key}: too many preferred terms")
+            for j, pt in enumerate(pod.preferred_terms):
+                out["pref_term_valid"][i, j] = True
+                out["pref_weight"][i, j] = pt.weight
+                self._encode_exprs(
+                    i, j, pt.term.match_expressions, out["pref_expr_valid"],
+                    out["pref_key"], out["pref_op"], out["pref_vals"], out["pref_num"],
+                )
+
+            for j, ref in enumerate(pod.spread_refs):
+                out["spread_valid"][i, j] = True
+                out["spread_cid"][i, j] = ref.cid
+                out["spread_topo"][i, j] = ref.topo
+                out["spread_max_skew"][i, j] = ref.max_skew
+                out["spread_mode"][i, j] = ref.mode
+                out["spread_self"][i, j] = ref.self_match
+            for j, ref in enumerate(pod.affinity_refs):
+                out["ipa_valid"][i, j] = True
+                out["ipa_tid"][i, j] = ref.tid
+                out["ipa_topo"][i, j] = ref.topo
+                out["ipa_required"][i, j] = ref.required
+                out["ipa_anti"][i, j] = ref.anti
+                out["ipa_weight"][i, j] = ref.weight
+                out["ipa_self"][i, j] = ref.self_match
+
+        return PodBatch(**{k: jnp.asarray(a) for k, a in out.items()})
+
+    def _encode_terms(self, i, terms, term_valid, expr_valid, key, op, vals, num):
+        s = self.spec
+        if len(terms) > term_valid.shape[1]:
+            raise ValueError("too many required affinity terms")
+        for j, term in enumerate(terms):
+            term_valid[i, j] = True
+            self._encode_exprs(i, j, term.match_expressions, expr_valid, key, op, vals, num)
+
+    def _encode_exprs(self, i, j, exprs, expr_valid, key, op, vals, num):
+        s = self.spec
+        v = self.vocab
+        if len(exprs) > s.aff_exprs:
+            raise ValueError("too many match expressions in a term")
+        for e, req in enumerate(exprs):
+            expr_valid[i, j, e] = True
+            key[i, j, e] = v.label_keys.lookup(req.key)
+            op[i, j, e] = req.op
+            if req.op in (SEL_OP_GT, SEL_OP_LT):
+                num[i, j, e] = numeric_of(req.values[0]) if req.values else 0
+            else:
+                if len(req.values) > s.aff_values:
+                    raise ValueError("too many values in a match expression")
+                for k, val in enumerate(req.values):
+                    vals[i, j, e, k] = v.label_values.lookup(val)

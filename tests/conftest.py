@@ -1,0 +1,23 @@
+"""Tests run on a virtual 8-device CPU mesh.
+
+Real multi-chip hardware is not available in CI; sharding correctness is
+validated the JAX-idiomatic way — 8 virtual CPU devices — and the bench
+(bench.py) runs single real TPU chip.  Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,165 @@
+import numpy as np
+import pytest
+
+from k8s1m_tpu.config import (
+    EFFECT_NO_SCHEDULE,
+    NONE_ID,
+    PodSpec,
+    SEL_OP_GT,
+    SEL_OP_IN,
+    TableSpec,
+)
+from k8s1m_tpu.snapshot import (
+    NodeInfo,
+    NodeTableHost,
+    PodBatchHost,
+    PodInfo,
+    SelectorRequirement,
+    NodeSelectorTerm,
+    Taint,
+)
+from k8s1m_tpu.snapshot.interning import Interner, numeric_of
+from k8s1m_tpu.snapshot.node_table import commit_binds
+
+SPEC = TableSpec(max_nodes=64, max_zones=16, max_regions=8)
+
+
+def test_interner_roundtrip():
+    it = Interner()
+    a = it.intern("alpha")
+    b = it.intern("beta")
+    assert a != b and a != NONE_ID and b != NONE_ID
+    assert it.intern("alpha") == a
+    assert it.lookup("alpha") == a
+    assert it.lookup("never-seen") == NONE_ID
+    assert it.string(a) == "alpha"
+    assert it.intern(None) == NONE_ID
+
+
+def test_numeric_of():
+    assert numeric_of("42") == 42
+    assert numeric_of("-7") == -7
+    from k8s1m_tpu.config import NO_NUMERIC
+
+    assert numeric_of("4.5") == NO_NUMERIC
+    assert numeric_of("abc") == NO_NUMERIC
+
+
+def make_host():
+    host = NodeTableHost(SPEC)
+    for i in range(10):
+        host.upsert(
+            NodeInfo(
+                name=f"node-{i}",
+                cpu_milli=4000,
+                mem_kib=8 << 20,
+                pods=110,
+                labels={
+                    "topology.kubernetes.io/zone": f"zone-{i % 3}",
+                    "tier": "web" if i % 2 == 0 else "db",
+                    "rank": str(i),
+                },
+                taints=[Taint("dedicated", "gpu")] if i == 9 else [],
+                unschedulable=(i == 8),
+            )
+        )
+    return host
+
+
+def test_node_table_build_and_rows():
+    host = make_host()
+    assert host.num_nodes == 10
+    t = host.to_device()
+    valid = np.asarray(t.valid)
+    assert valid[:10].all() and not valid[10:].any()
+    # zone ids dense and distinct per zone label
+    zones = np.asarray(t.zone)[:10]
+    assert len(set(zones.tolist())) == 3
+    # unschedulable node got the synthetic taint
+    row = host.row_of("node-8")
+    tk = np.asarray(t.taint_key)[row]
+    assert (tk != NONE_ID).sum() == 1
+    # numeric label parsed
+    row0 = host.row_of("node-7")
+    nums = np.asarray(t.label_num)[row0]
+    assert 7 in nums.tolist()
+
+
+def test_node_remove_reuses_row_and_clears():
+    host = make_host()
+    row = host.row_of("node-3")
+    host.remove("node-3")
+    t = host.to_device()
+    assert not np.asarray(t.valid)[row]
+    assert np.asarray(t.label_key)[row].sum() == 0
+    new_row = host.upsert(NodeInfo(name="node-new"))
+    assert new_row == row
+
+
+def test_pod_accounting():
+    host = make_host()
+    host.add_pod("node-1", 500, 1 << 20)
+    host.add_pod("node-1", 250, 1 << 19)
+    row = host.row_of("node-1")
+    assert host.cpu_req[row] == 750
+    assert host.pods_req[row] == 2
+    host.remove_pod("node-1", 500, 1 << 20)
+    assert host.cpu_req[row] == 250
+    assert host.pods_req[row] == 1
+
+
+def test_table_overflow_raises():
+    small = NodeTableHost(TableSpec(max_nodes=2, max_zones=4, max_regions=4))
+    small.upsert(NodeInfo(name="a"))
+    small.upsert(NodeInfo(name="b"))
+    with pytest.raises(ValueError):
+        small.upsert(NodeInfo(name="c"))
+
+
+def test_commit_binds():
+    host = make_host()
+    t = host.to_device()
+    idx = np.array([0, 1, 0, 2], np.int32)
+    cpu = np.array([100, 200, 300, 400], np.int32)
+    mem = np.array([10, 20, 30, 40], np.int32)
+    bound = np.array([True, True, False, True])
+    t2 = commit_binds(t, idx, cpu, mem, bound)
+    assert int(t2.cpu_req[0]) == 100  # pod 2 not bound
+    assert int(t2.cpu_req[1]) == 200
+    assert int(t2.cpu_req[2]) == 400
+    assert int(t2.pods_req[0]) == 1
+
+
+def test_pod_encoding():
+    host = make_host()
+    enc = PodBatchHost(PodSpec(batch=8), host.vocab)
+    pods = [
+        PodInfo(
+            name="p0",
+            cpu_milli=250,
+            mem_kib=1 << 20,
+            node_selector={"tier": "web"},
+            required_terms=[
+                NodeSelectorTerm(
+                    [SelectorRequirement("rank", SEL_OP_GT, ["3"])]
+                )
+            ],
+        ),
+        PodInfo(name="p1", node_name="node-5"),
+        PodInfo(name="p2", node_selector={"tier": "nosuchvalue"}),
+    ]
+    batch = enc.encode(pods)
+    valid = np.asarray(batch.valid)
+    assert valid[:3].all() and not valid[3:].any()
+    assert int(batch.cpu[0]) == 250
+    # nodeSelector encoded with interned ids
+    assert np.asarray(batch.sel_valid)[0].sum() == 1
+    assert int(batch.sel_key[0, 0]) == host.vocab.label_keys.lookup("tier")
+    # unseen selector value encodes to NONE (can never match)
+    assert int(batch.sel_val[2, 0]) == NONE_ID
+    assert int(batch.sel_key[2, 0]) != NONE_ID
+    # Gt requirement carries the parsed number
+    assert int(batch.req_num[0, 0, 0]) == 3
+    # nodeName interned
+    assert int(batch.node_name_id[1]) == host.vocab.node_names.lookup("node-5")
+    assert int(batch.node_name_id[0]) == NONE_ID

@@ -1,0 +1,4 @@
+from k8s1m_tpu.cluster.kwok import populate_kwok_nodes, KwokShape
+from k8s1m_tpu.cluster.workload import uniform_pods
+
+__all__ = ["populate_kwok_nodes", "KwokShape", "uniform_pods"]

@@ -1,0 +1,103 @@
+"""Synthetic pod workloads — the make_pods equivalent.
+
+The reference floods the cluster with uniform pods carrying an owner-ref
+chain and schedulerName dist-scheduler (reference kwok/make_pods/main.go:109-172).
+Here a workload is a generator of PodInfo lists sized to the engine's batch.
+"""
+
+from __future__ import annotations
+
+from k8s1m_tpu.config import SPREAD_DO_NOT_SCHEDULE, TOPO_HOSTNAME, TOPO_ZONE
+from k8s1m_tpu.snapshot.constraints import ConstraintTracker
+from k8s1m_tpu.snapshot.pod_encoding import (
+    AffinityTermRef,
+    PodInfo,
+    SpreadConstraintRef,
+)
+
+
+def uniform_pods(
+    count: int,
+    *,
+    cpu_milli: int = 100,
+    mem_kib: int = 200 << 10,
+    name_prefix: str = "pod",
+    namespace: str = "default",
+) -> list[PodInfo]:
+    return [
+        PodInfo(
+            name=f"{name_prefix}-{i}",
+            namespace=namespace,
+            cpu_milli=cpu_milli,
+            mem_kib=mem_kib,
+        )
+        for i in range(count)
+    ]
+
+
+def spread_deployment(
+    tracker: ConstraintTracker,
+    name: str,
+    replicas: int,
+    *,
+    namespace: str = "default",
+    topo: int = TOPO_ZONE,
+    max_skew: int = 1,
+    mode: int = SPREAD_DO_NOT_SCHEDULE,
+    cpu_milli: int = 100,
+    mem_kib: int = 200 << 10,
+    start: int = 0,
+) -> list[PodInfo]:
+    """Replicas of a Deployment with a topologySpreadConstraint on its own
+    ``app=<name>`` selector — BASELINE.json config 3's workload shape."""
+    selector = {"app": name}
+    cid = tracker.spread_slot(namespace, selector, topo)
+    pods = []
+    for i in range(start, start + replicas):
+        labels = dict(selector)
+        pods.append(PodInfo(
+            name=f"{name}-{i}", namespace=namespace,
+            cpu_milli=cpu_milli, mem_kib=mem_kib, labels=labels,
+            spread_refs=[SpreadConstraintRef(cid, topo, max_skew, mode, True)],
+            spread_incs=tracker.spread_matches(namespace, labels),
+            ipa_incs=tracker.affinity_matches(namespace, labels),
+        ))
+    return pods
+
+
+def affinity_deployment(
+    tracker: ConstraintTracker,
+    name: str,
+    replicas: int,
+    *,
+    namespace: str = "default",
+    target: dict[str, str] | None = None,
+    topo: int = TOPO_HOSTNAME,
+    required: bool = True,
+    anti: bool = False,
+    weight: int = 1,
+    cpu_milli: int = 100,
+    mem_kib: int = 200 << 10,
+    start: int = 0,
+) -> list[PodInfo]:
+    """Replicas carrying one (anti)affinity term — config 4's shape.
+
+    ``target`` defaults to the deployment's own ``app=<name>`` selector
+    (self-affinity / self-anti-affinity, the common Deployment pattern).
+    """
+    selector = dict(target) if target is not None else {"app": name}
+    tid = tracker.affinity_slot(namespace, selector, topo)
+    pods = []
+    for i in range(start, start + replicas):
+        labels = {"app": name}
+        pods.append(PodInfo(
+            name=f"{name}-{i}", namespace=namespace,
+            cpu_milli=cpu_milli, mem_kib=mem_kib, labels=labels,
+            affinity_refs=[AffinityTermRef(
+                tid, topo, required, anti, weight,
+                self_match=ConstraintTracker.selector_matches(selector, labels),
+            )],
+            spread_incs=tracker.spread_matches(namespace, labels),
+            ipa_incs=tracker.affinity_matches(namespace, labels),
+        ))
+    return pods

@@ -25,6 +25,7 @@ class TableSpec:
     max_nodes: int = 1 << 20
     label_slots: int = 16      # padded label (key,value) pairs per node
     taint_slots: int = 8       # padded taints per node
+    max_taint_ids: int = 128   # distinct (key,value,effect) taint triples cluster-wide
     max_zones: int = 512       # distinct topology.kubernetes.io/zone values
     max_regions: int = 64
     # Active topology-spread / inter-pod-affinity constraint slots.  Slots
@@ -34,8 +35,8 @@ class TableSpec:
     affinity_slots: int = 16
 
     def __post_init__(self):
-        if self.max_nodes & (self.max_nodes - 1):
-            raise ValueError("max_nodes must be a power of two")
+        if self.max_nodes <= 0:
+            raise ValueError("max_nodes must be positive")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,14 +44,15 @@ class PodSpec:
     """Shape of one encoded pod batch."""
 
     batch: int = 256
-    tol_slots: int = 8         # tolerations per pod
+    query_keys: int = 16       # distinct label keys referenced by one batch's selectors
     aff_terms: int = 4         # required nodeAffinity terms (OR of terms)
     aff_exprs: int = 4         # expressions per term (ANDed)
     aff_values: int = 8        # values per expression (In/NotIn sets)
     pref_terms: int = 4        # preferred nodeAffinity terms
     spread_refs: int = 4       # topologySpreadConstraints per pod
     affinity_refs: int = 4     # (anti)affinity terms per pod
-    top_k: int = 4             # bind candidates kept per pod for conflict resolution
+    spread_incs: int = 4       # spread constraints whose selector matches the pod
+    ipa_incs: int = 4          # affinity terms whose selector matches the pod
 
 
 # Sentinel id meaning "no string" in every interned column.  Real ids start

@@ -1,0 +1,3 @@
+from k8s1m_tpu.engine.cycle import Assignment, Candidates, schedule_batch, filter_score_topk
+
+__all__ = ["Assignment", "Candidates", "schedule_batch", "filter_score_topk"]

@@ -1,0 +1,71 @@
+"""Greedy in-batch conflict resolution over per-pod bind candidates.
+
+The reference schedules pods concurrently and lets two pods race for one
+node; the loser's bind fails at the apiserver and rolls back (reference
+README.adoc:558-560, "optimistic concurrency").  Batched on TPU, the same
+problem is solved *before* binding: every pod brings its top-K candidate
+nodes (already sorted by packed priority), and a sequential lax.scan over
+the batch commits pods in order, re-checking candidate capacity against
+what earlier pods in the batch just took.  A pod whose K candidates are all
+exhausted leaves the batch unbound and is retried next cycle — exactly the
+reference's conflict-rollback, but at O(B*K) cost with no apiserver
+round-trip.
+
+The scan is tiny (B x K integers) and runs replicated on every device in
+the sharded cycle, so no cross-device coordination is needed at commit time.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from k8s1m_tpu.ops.priority import unpack_score
+
+
+def greedy_assign(
+    cand_idx,   # i32[B, K] global node rows, priority-descending (-1 = none)
+    cand_prio,  # i32[B, K] packed priorities (-1 = infeasible)
+    cand_cpu,   # i32[B, K] candidate's free cpu at batch start
+    cand_mem,   # i32[B, K]
+    cand_pods,  # i32[B, K] candidate's free pod slots at batch start
+    pod_cpu,    # i32[B]
+    pod_mem,    # i32[B]
+    pod_valid,  # bool[B]
+):
+    """Returns (node_row i32[B] (-1 unbound), bound bool[B], score i32[B],
+    chosen_k i32[B] — index of the winning candidate slot)."""
+    b, k = cand_idx.shape
+    arange_b = jnp.arange(b)
+
+    def step(carry, _):
+        node_of, bound, i = carry
+        # Resources already taken from pod i's candidates by pods j < i.
+        prev = (arange_b < i) & bound                       # [B]
+        eq = cand_idx[i][:, None] == node_of[None, :]       # [K, B]
+        taken = eq & prev[None, :]
+        dcpu = (taken * pod_cpu[None, :]).sum(axis=-1)
+        dmem = (taken * pod_mem[None, :]).sum(axis=-1)
+        dpods = taken.sum(axis=-1)
+
+        ok = (
+            (cand_prio[i] >= 0)
+            & (cand_idx[i] >= 0)
+            & (pod_cpu[i] <= cand_cpu[i] - dcpu)
+            & (pod_mem[i] <= cand_mem[i] - dmem)
+            & (cand_pods[i] - dpods >= 1)
+        )
+        any_ok = ok.any() & pod_valid[i]
+        # Candidates are priority-sorted, so the first feasible one is the
+        # winner (argmax of bool returns the first True).
+        kstar = jnp.argmax(ok)
+        node = jnp.where(any_ok, cand_idx[i, kstar], -1)
+        score = jnp.where(any_ok, unpack_score(cand_prio[i, kstar]), -1)
+        carry = (node_of.at[i].set(node), bound.at[i].set(any_ok), i + 1)
+        return carry, (node, any_ok, score, kstar.astype(jnp.int32))
+
+    # xs=None + carried index: see engine/cycle.py on lifted-constant scans.
+    init = (jnp.full((b,), -1, jnp.int32), jnp.zeros((b,), bool), jnp.int32(0))
+    _, (node_row, bound, score, chosen_k) = lax.scan(step, init, None, length=b)
+    return node_row, bound, score, chosen_k

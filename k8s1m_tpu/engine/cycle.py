@@ -1,0 +1,249 @@
+"""The scheduling cycle: filter -> score -> top-k -> assign -> commit.
+
+One call schedules a whole batch of pods against the whole node table.
+This is the TPU replacement for the reference's entire scatter/gather
+pipeline: relay-tree broadcast, 256 shards running filter+score, the
+CollectScore gather, DistPermit, and the bind-conflict rollback
+(reference SURVEY.md §3.2).  ~560us of fleet CPU per pod becomes a few
+microseconds of TPU time amortized over the batch.
+
+The node axis is processed in fixed-size chunks with a lax.scan carrying a
+running top-k: HBM traffic stays streaming (the table is read once per
+batch), compute per chunk stays in VMEM-sized tiles, and peak memory is
+O(B * chunk) instead of O(B * N).  Candidates carry their free-capacity
+and topology-domain payload so the greedy conflict scan (engine/assign.py),
+the constraint commit, and the sharded all-gather (parallel/) never have
+to re-gather from the (possibly sharded) table.
+
+In-batch semantics note: the greedy conflict scan re-checks *capacity*
+for pods later in the batch, but not topology constraints — two same-batch
+pods can land in a way that exceeds maxSkew by the batch size in the worst
+case.  The reference has exactly the same window (256 shards bind
+optimistically and only capacity conflicts roll back, reference
+README.adoc:558-560); constraint counts are exact again at the next batch
+boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from k8s1m_tpu.engine.assign import greedy_assign
+from k8s1m_tpu.ops.priority import pack
+from k8s1m_tpu.plugins.registry import Profile, score_and_filter
+from k8s1m_tpu.snapshot.constraints import (
+    ConstraintState,
+    commit_constraint_binds,
+    slice_constraints,
+)
+from k8s1m_tpu.snapshot.node_table import NodeTable, commit_binds
+from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+@struct.dataclass
+class Candidates:
+    """Top-K bind candidates per pod, with payload gathered at score time."""
+
+    idx: jax.Array    # i32[B, K] global node rows (-1 = none)
+    prio: jax.Array   # i32[B, K] packed priorities, descending (-1 = infeasible)
+    cpu: jax.Array    # i32[B, K] candidate free cpu at batch start
+    mem: jax.Array    # i32[B, K]
+    pods: jax.Array   # i32[B, K]
+    zone: jax.Array   # i32[B, K] candidate's topology domains
+    region: jax.Array  # i32[B, K]
+
+
+@struct.dataclass
+class Assignment:
+    node_row: jax.Array  # i32[B] (-1 = unbound, retry next batch)
+    bound: jax.Array     # bool[B]
+    score: jax.Array     # i32[B] integer plugin score of the chosen node
+    zone: jax.Array      # i32[B] domain of the chosen node
+    region: jax.Array    # i32[B]
+
+
+def _slice_table(table: NodeTable, start, chunk: int) -> NodeTable:
+    return jax.tree.map(
+        lambda a: lax.dynamic_slice_in_dim(a, start, chunk, axis=0), table
+    )
+
+
+def merge_topk(a: Candidates, b: Candidates, k: int) -> Candidates:
+    """Merge two candidate sets, keeping the k highest priorities."""
+    prio = jnp.concatenate([a.prio, b.prio], axis=-1)
+    top_prio, sel = lax.top_k(prio, k)
+
+    def take(xa, xb):
+        return jnp.take_along_axis(jnp.concatenate([xa, xb], axis=-1), sel, axis=-1)
+
+    return jax.tree.map(take, a, b).replace(prio=top_prio)
+
+
+def empty_candidates(b: int, k: int) -> Candidates:
+    zeros = jnp.zeros((b, k), jnp.int32)
+    return Candidates(
+        idx=jnp.full((b, k), -1, jnp.int32),
+        prio=jnp.full((b, k), -1, jnp.int32),
+        cpu=zeros, mem=zeros, pods=zeros, zone=zeros, region=zeros,
+    )
+
+
+def filter_score_topk(
+    table: NodeTable,
+    batch: PodBatch,
+    key: jax.Array,
+    profile: Profile,
+    *,
+    chunk: int,
+    k: int,
+    constraints: ConstraintState | None = None,
+    stats=None,
+    row_offset=0,
+) -> Candidates:
+    """Stream the node table in chunks, keeping each pod's top-k candidates.
+
+    ``row_offset`` biases emitted node rows — under shard_map each shard
+    passes its global row offset so candidate indices stay global.
+    """
+    n = table.num_rows
+    if n % chunk:
+        raise ValueError(f"table rows {n} not divisible by chunk {chunk}")
+    num_chunks = n // chunk
+    b = batch.batch
+
+    def body(carry, _):
+        carry, ci = carry
+        start = ci * chunk
+        tchunk = _slice_table(table, start, chunk)
+        cchunk = (
+            slice_constraints(constraints, start, chunk)
+            if constraints is not None else None
+        )
+        mask, score = score_and_filter(tchunk, batch, profile, cchunk, stats)
+        prio = pack(score, jax.random.fold_in(key, ci), mask)   # [B, chunk]
+        top_prio, idx = lax.top_k(prio, k)                      # [B, k]
+        free_cpu, free_mem, free_pods = tchunk.free()
+        local = Candidates(
+            idx=(idx + start + row_offset).astype(jnp.int32),
+            prio=top_prio,
+            cpu=jnp.take(free_cpu, idx),
+            mem=jnp.take(free_mem, idx),
+            pods=jnp.take(free_pods, idx),
+            zone=jnp.take(tchunk.zone, idx),
+            region=jnp.take(tchunk.region, idx),
+        )
+        return (merge_topk(carry, local, k), ci + 1), None
+
+    # NB: scan without an xs array — a `jnp.arange(num_chunks)` here gets
+    # lifted to an executable constant, which the pjit fast-path cache
+    # mishandles when one function owns multiple executables ("supplied 66
+    # buffers but compiled program expected 67").
+    init = (empty_candidates(b, k), jnp.int32(0))
+    if num_chunks == 1:
+        (cand, _), _ = body(init, None)
+    else:
+        (cand, _), _ = lax.scan(body, init, None, length=num_chunks)
+    # Mark infeasible candidates' rows as -1 so downstream never binds them.
+    return cand.replace(idx=jnp.where(cand.prio >= 0, cand.idx, -1))
+
+
+def commit_constraints_for_batch(
+    constraints: ConstraintState,
+    batch: PodBatch,
+    asg: "Assignment",
+    node_row,       # i32[B] rows to scatter node-domain counts into
+    bound_node,     # bool[B] gate for node-domain tables (shard-local mask)
+    bound_domain,   # bool[B] gate for zone/region tables (global mask)
+) -> ConstraintState:
+    own_valid = batch.ipa_valid & batch.ipa_required & batch.ipa_anti
+    return commit_constraint_binds(
+        constraints,
+        bound_node, bound_domain, node_row, asg.zone, asg.region,
+        batch.sinc_valid, batch.sinc_cid, batch.sinc_topo,
+        batch.iinc_valid, batch.iinc_tid, batch.iinc_topo,
+        own_valid, batch.ipa_tid, batch.ipa_topo,
+    )
+
+
+def _schedule_batch_impl(
+    table: NodeTable,
+    batch: PodBatch,
+    key: jax.Array,
+    constraints: ConstraintState | None,
+    profile: Profile,
+    chunk: int,
+    k: int,
+):
+    from k8s1m_tpu.plugins import topology
+
+    stats = (
+        topology.prologue(table, constraints) if constraints is not None else None
+    )
+    cand = filter_score_topk(
+        table, batch, key, profile,
+        chunk=chunk, k=k, constraints=constraints, stats=stats,
+    )
+    node_row, bound, score, chosen_k = greedy_assign(
+        cand.idx, cand.prio, cand.cpu, cand.mem, cand.pods,
+        batch.cpu, batch.mem, batch.valid,
+    )
+    take1 = lambda x: jnp.take_along_axis(x, chosen_k[:, None], axis=1)[:, 0]
+    asg = Assignment(
+        node_row=node_row, bound=bound, score=score,
+        zone=jnp.where(bound, take1(cand.zone), 0),
+        region=jnp.where(bound, take1(cand.region), 0),
+    )
+    safe_row = jnp.where(bound, node_row, 0)
+    table = commit_binds(table, safe_row, batch.cpu, batch.mem, bound)
+    if constraints is not None:
+        constraints = commit_constraints_for_batch(
+            constraints, batch, asg, safe_row, bound, bound
+        )
+    return table, constraints, asg
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_schedule(profile: Profile, chunk: int, k: int, with_constraints: bool):
+    # One jax.jit function object per static configuration.  Routing every
+    # configuration through a single jitted function trips a pjit fast-path
+    # cache bug in this environment once the function owns several
+    # executables ("Execution supplied 66 buffers but compiled program
+    # expected 67 buffers"); distinct function identities sidestep it.
+    if with_constraints:
+        fn = lambda table, batch, key, constraints: _schedule_batch_impl(
+            table, batch, key, constraints, profile, chunk, k
+        )
+    else:
+        fn = lambda table, batch, key: _schedule_batch_impl(
+            table, batch, key, None, profile, chunk, k
+        )
+    return jax.jit(fn)
+
+
+def schedule_batch(
+    table: NodeTable,
+    batch: PodBatch,
+    key: jax.Array,
+    *,
+    profile: Profile,
+    constraints: ConstraintState | None = None,
+    chunk: int = 16384,
+    k: int = 4,
+):
+    """Schedule one pod batch end-to-end on a single device.
+
+    Returns (new_table, new_constraints, Assignment).  The table and
+    constraint counts come back with this batch's binds already folded in
+    (the assume step), so back-to-back batches see each other's placements.
+    """
+    step = _jitted_schedule(profile, chunk, k, constraints is not None)
+    if constraints is None:
+        table, cons, asg = step(table, batch, key)
+    else:
+        table, cons, asg = step(table, batch, key, constraints)
+    return table, cons, asg

@@ -1,0 +1,3 @@
+from k8s1m_tpu.ops.label_match import ResolvedKeys, resolve_query_keys, match_expressions
+
+__all__ = ["ResolvedKeys", "resolve_query_keys", "match_expressions"]

@@ -1,0 +1,117 @@
+"""Device-side label lookup and NodeSelector expression matching.
+
+The expensive part of NodeAffinity — scanning each node's label slots per
+selector expression — is hoisted into one pass: ``resolve_query_keys``
+turns the node chunk's [N, L] label slots into dense [Q, N] lookups for
+the batch's Q distinct query keys.  Expression evaluation afterwards is
+pure elementwise arithmetic over gathers into those [Q, N] planes, which
+XLA fuses into the surrounding filter/score computation.
+
+Semantics mirror upstream nodeaffinity.NodeSelector.Match (consumed by the
+forked scheduler, reference dist-scheduler/go.mod:138):
+- In:           label present and value in set
+- NotIn:        label absent, or value not in set
+- Exists:       label present
+- DoesNotExist: label absent
+- Gt/Lt:        label present, parses as int, compares; non-integers never match
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from k8s1m_tpu.config import (
+    NO_NUMERIC,
+    NONE_ID,
+    SEL_OP_DOES_NOT_EXIST,
+    SEL_OP_EXISTS,
+    SEL_OP_GT,
+    SEL_OP_IN,
+    SEL_OP_LT,
+    SEL_OP_NOT_IN,
+)
+
+
+@struct.dataclass
+class ResolvedKeys:
+    """Per-node resolution of the batch's query keys."""
+
+    found: jax.Array  # bool[Q, N] node has the key
+    val: jax.Array    # i32[Q, N] label value id (0 when not found)
+    num: jax.Array    # i32[Q, N] parsed numeric value (0 when not found)
+
+
+def resolve_query_keys(label_key, label_val, label_num, qkey) -> ResolvedKeys:
+    """label_key/val/num: i32[N, L]; qkey: i32[Q] -> ResolvedKeys over [Q, N].
+
+    One scan of the label slots per chunk; every selector expression in the
+    batch reuses it.  qkey slot 0 is the reserved NONE key and resolves to
+    found=False everywhere (a NONE qkey only equals NONE label slots, which
+    are excluded as padding).
+    """
+    # [Q, N, L]: query key q matches slot l of node n.
+    eq = (qkey[:, None, None] == label_key[None, :, :]) & (
+        label_key[None, :, :] != NONE_ID
+    )
+    found = eq.any(axis=-1)
+    # Host guarantees label keys are unique per node, so at most one slot
+    # matches and a masked sum extracts it.
+    val = jnp.where(eq, label_val[None, :, :], 0).sum(axis=-1)
+    num = jnp.where(eq, label_num[None, :, :], 0).sum(axis=-1)
+    return ResolvedKeys(found=found, val=val.astype(jnp.int32), num=num.astype(jnp.int32))
+
+
+def match_expressions(
+    resolved: ResolvedKeys,
+    expr_valid,  # bool[..., E]
+    qidx,        # i32[..., E] index into the batch's query-key table
+    op,          # i32[..., E] SEL_OP_*
+    vals,        # i32[..., E, V] value-id set (NONE_ID padded)
+    num,         # i32[..., E] operand for Gt/Lt
+):
+    """Evaluate selector expressions against every node.
+
+    Returns (term_match: bool[..., N], has_expr: bool[...]):
+    term_match is the AND over valid expressions; a term with no valid
+    expressions matches nothing (upstream: an empty term is unsatisfiable),
+    which the caller enforces using has_expr.
+    """
+    # Gather the [Q, N] planes by expression key: -> [..., E, N].
+    f = jnp.take(resolved.found, qidx, axis=0)
+    v = jnp.take(resolved.val, qidx, axis=0)
+    x = jnp.take(resolved.num, qidx, axis=0)
+
+    # Value-set membership: [..., E, N, V] reduced over V.  Padded NONE_ID
+    # entries can't match because v==NONE_ID only when not found, and
+    # found gates In/Gt/Lt.
+    in_set = (v[..., None] == vals[..., None, :]).any(axis=-1)
+
+    # Gt/Lt need both sides parseable: node label AND the operand (upstream
+    # fails the requirement if either strconv.ParseInt fails; the encoder
+    # stores NO_NUMERIC for unparseable/missing operands).
+    operand = num[..., None]
+    numeric_ok = f & (x != NO_NUMERIC) & (operand != NO_NUMERIC)
+
+    o = op[..., None]
+    result = jnp.where(
+        o == SEL_OP_IN, f & in_set,
+        jnp.where(
+            o == SEL_OP_NOT_IN, ~(f & in_set),
+            jnp.where(
+                o == SEL_OP_EXISTS, f,
+                jnp.where(
+                    o == SEL_OP_DOES_NOT_EXIST, ~f,
+                    jnp.where(
+                        o == SEL_OP_GT, numeric_ok & (x > operand),
+                        jnp.where(o == SEL_OP_LT, numeric_ok & (x < operand), False),
+                    ),
+                ),
+            ),
+        ),
+    )
+    # AND over valid expressions; invalid slots are neutral.
+    term_match = (result | ~expr_valid[..., None]).all(axis=-2)
+    has_expr = expr_valid.any(axis=-1)
+    return term_match, has_expr

@@ -1,0 +1,3 @@
+from k8s1m_tpu.oracle.reference_scheduler import oracle_feasible, oracle_score
+
+__all__ = ["oracle_feasible", "oracle_score"]

@@ -1,0 +1,69 @@
+"""Device mesh and sharding specs for the scheduling framework.
+
+Two mesh axes replace the reference's two distribution mechanisms
+(reference SURVEY.md §2.5):
+
+- ``sp`` (shard parallel) — the node table's row axis is sharded over sp.
+  This is the TPU equivalent of the `dist-scheduler.dev/scheduler` node
+  label that partitions 1M nodes across 256 Go replicas (reference
+  cmd/dist-scheduler/leader_activities.go:227-343) — except rebalancing is
+  free: rows are assigned to devices by position, not by a leader
+  rewriting labels through the apiserver.
+- ``dp`` (data parallel) — the pending-pod batch axis.  The reference
+  broadcasts every pod to every shard through a fan-out-10 relay tree
+  (reference pkg/schedulerset/schedulerset.go:161-193) because NIC
+  bandwidth bounded the scatter; on a mesh the scatter is an ICI
+  all-gather at the end of the cycle instead.
+
+Node tables shard over ``sp`` and replicate over ``dp``; pod batches shard
+over ``dp`` and replicate over ``sp``; scalar/leaf metadata (qkey, PRNG
+key) is replicated everywhere.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from k8s1m_tpu.snapshot.node_table import NodeTable
+from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+def make_mesh(dp: int, sp: int, devices=None) -> jax.sharding.Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if dp * sp > len(devices):
+        raise ValueError(f"mesh {dp}x{sp} needs {dp*sp} devices, have {len(devices)}")
+    arr = np.asarray(devices[: dp * sp]).reshape(dp, sp)
+    return jax.sharding.Mesh(arr, ("dp", "sp"))
+
+
+def table_specs(table: NodeTable) -> NodeTable:
+    """PartitionSpec pytree: every node-table leaf shards its row axis over sp."""
+    return jax.tree.map(lambda _: P("sp"), table)
+
+
+def constraint_specs(cons) -> object:
+    """PartitionSpecs for ConstraintState: hostname-domain tables shard
+    their node axis (axis 1) over sp; zone/region tables replicate."""
+    from k8s1m_tpu.snapshot.constraints import ConstraintState
+
+    return ConstraintState(
+        spread_node=P(None, "sp"), spread_zone=P(), spread_region=P(),
+        tgt_node=P(None, "sp"), tgt_zone=P(), tgt_region=P(),
+        own_node=P(None, "sp"), own_zone=P(), own_region=P(),
+    )
+
+
+def batch_specs(batch: PodBatch) -> PodBatch:
+    """PartitionSpec pytree: pod-leading arrays shard over dp; qkey replicates."""
+
+    b = batch.batch
+
+    def spec(x):
+        return P("dp") if (x.ndim >= 1 and x.shape[0] == b) else P()
+
+    specs = jax.tree.map(spec, batch)
+    # qkey is [Q] and Q could coincidentally equal B; force it replicated.
+    return specs.replace(qkey=P())

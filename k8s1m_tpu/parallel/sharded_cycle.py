@@ -1,0 +1,128 @@
+"""The multi-device scheduling cycle: shard_map over the (dp, sp) mesh.
+
+Dataflow per cycle (replacing reference SURVEY.md §3.2's process hops):
+
+1. each (dp, sp) device runs the chunked filter+score+top-k over its
+   [B/dp, N/sp] block — the hot loop, purely local;
+2. candidates all-gather over ``sp`` and re-top-k — the ICI replacement
+   for the CollectScore gRPC gather + ScoreEvaluator rendezvous
+   (reference pkg/scoreevaluator/scoreevaluator.go:45-126);
+3. candidates (and pod resources) all-gather over ``dp``, giving every
+   device the full batch's candidate lists — a few KB;
+4. the greedy conflict-resolution scan runs *replicated* on every device
+   (identical inputs -> identical result, no coordination), replacing the
+   reference's optimistic bind-and-rollback;
+5. each sp shard commits the binds that landed in its row range to its
+   slice of the table and of the hostname-domain count tables; zone /
+   region count tables are replicated and take the full (identical)
+   update on every device.
+
+Total ICI traffic per cycle is O(B * K) candidate records — independent
+of node count; the reference moves O(shards) gRPC messages per pod.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from k8s1m_tpu.engine.assign import greedy_assign
+from k8s1m_tpu.engine.cycle import (
+    Assignment,
+    commit_constraints_for_batch,
+    filter_score_topk,
+)
+from k8s1m_tpu.parallel.mesh import batch_specs, constraint_specs, table_specs
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.constraints import ConstraintState
+from k8s1m_tpu.snapshot.node_table import NodeTable, commit_binds
+from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int,
+                      with_constraints: bool = False):
+    """Build the jitted multi-device scheduling step for a fixed mesh.
+
+    Returns step(table, batch, key[, constraints]):
+    -> (table, constraints|None, Assignment); table (and hostname-domain
+    count tables) sharded over sp, batch over dp, assignment replicated.
+    """
+    from k8s1m_tpu.plugins import topology
+
+    def _local_step(table: NodeTable, batch: PodBatch, key: jax.Array,
+                    constraints: ConstraintState | None = None):
+        sp = lax.axis_index("sp")
+        dp = lax.axis_index("dp")
+        rows = table.num_rows                       # rows per sp shard
+        row_offset = sp * rows
+
+        stats = (
+            topology.prologue(table, constraints, axis_name="sp")
+            if constraints is not None else None
+        )
+
+        # 1. local filter+score+top-k over this device's block.  Jitter is
+        # decorrelated across both mesh axes.
+        local_key = jax.random.fold_in(jax.random.fold_in(key, sp), dp)
+        cand = filter_score_topk(
+            table, batch, local_key, profile,
+            chunk=chunk, k=k, constraints=constraints, stats=stats,
+            row_offset=row_offset,
+        )
+
+        # 2. gather candidates across node shards, keep global top-k.
+        def gather_sp(x):
+            g = lax.all_gather(x, "sp")             # [SP, b, k]
+            return jnp.moveaxis(g, 0, 1).reshape(x.shape[0], -1)
+
+        cand = jax.tree.map(gather_sp, cand)
+        top_prio, sel = lax.top_k(cand.prio, k)
+        cand = jax.tree.map(
+            lambda x: jnp.take_along_axis(x, sel, axis=-1), cand
+        ).replace(prio=top_prio)
+
+        # 3. gather the full batch across dp (pods stay in batch order:
+        # dp shards are contiguous blocks).
+        def gather_dp(x):
+            g = lax.all_gather(x, "dp")
+            return g.reshape(-1, *x.shape[1:])
+
+        cand = jax.tree.map(gather_dp, cand)
+        batch_all = jax.tree.map(gather_dp, batch).replace(qkey=batch.qkey)
+
+        # 4. replicated greedy conflict resolution over the full batch.
+        node_row, bound, score, chosen_k = greedy_assign(
+            cand.idx, cand.prio, cand.cpu, cand.mem, cand.pods,
+            batch_all.cpu, batch_all.mem, batch_all.valid,
+        )
+        take1 = lambda x: jnp.take_along_axis(x, chosen_k[:, None], axis=1)[:, 0]
+        asg = Assignment(
+            node_row=node_row, bound=bound, score=score,
+            zone=jnp.where(bound, take1(cand.zone), 0),
+            region=jnp.where(bound, take1(cand.region), 0),
+        )
+
+        # 5. commit binds that landed in this shard's row range.
+        local = bound & (node_row >= row_offset) & (node_row < row_offset + rows)
+        local_row = jnp.where(local, node_row - row_offset, 0)
+        table = commit_binds(table, local_row, batch_all.cpu, batch_all.mem, local)
+        if constraints is not None:
+            constraints = commit_constraints_for_batch(
+                constraints, batch_all, asg, local_row, local, bound
+            )
+        return table, constraints, asg
+
+    def step(table, batch, key, constraints=None):
+        asg_specs = Assignment(P(), P(), P(), P(), P())
+        cons_specs = constraint_specs(constraints) if constraints is not None else None
+        return jax.shard_map(
+            _local_step,
+            mesh=mesh,
+            in_specs=(table_specs(table), batch_specs(batch), P(), cons_specs),
+            out_specs=(table_specs(table), cons_specs, asg_specs),
+            check_vma=False,
+        )(table, batch, key, constraints)
+
+    return jax.jit(step)

@@ -1,0 +1,4 @@
+from k8s1m_tpu.plugins.filters import feasible_mask
+from k8s1m_tpu.plugins.registry import Profile, default_profile
+
+__all__ = ["feasible_mask", "Profile", "default_profile"]

@@ -1,0 +1,100 @@
+"""Filter plugins as tensor kernels over (pod batch x node chunk).
+
+Each function returns a bool mask with shape [B, N] (True = node passes for
+pod).  They replace the Go scheduling-framework Filter plugins the forked
+scheduler runs per pod per node (~560us/pod of CPU across the fleet,
+reference README.adoc:786-787).  All masks AND together in feasible_mask;
+XLA fuses the whole thing into one pass over the node chunk.
+
+Upstream plugin parity:
+- fits_resources   <- NodeResourcesFit (cpu, memory, pod count)
+- node_name        <- NodeName
+- tolerates_taints <- TaintToleration (+NodeUnschedulable via the
+                      synthetic unschedulable taint, see node_table.py)
+- node_affinity    <- NodeAffinity required terms + spec.nodeSelector
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from k8s1m_tpu.config import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    NONE_ID,
+)
+from k8s1m_tpu.ops.label_match import ResolvedKeys, match_expressions, resolve_query_keys
+from k8s1m_tpu.snapshot.node_table import NodeTable
+from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+def fits_resources(table: NodeTable, batch: PodBatch):
+    """NodeResourcesFit: requests fit in allocatable-minus-requested."""
+    free_cpu, free_mem, free_pods = table.free()
+    return (
+        (batch.cpu[:, None] <= free_cpu[None, :])
+        & (batch.mem[:, None] <= free_mem[None, :])
+        & (free_pods[None, :] >= 1)
+    )
+
+
+def node_name(table: NodeTable, batch: PodBatch):
+    """NodeName: spec.nodeName, when set, must equal the node's name."""
+    unset = batch.node_name_id == NONE_ID
+    return unset[:, None] | (batch.node_name_id[:, None] == table.name_id[None, :])
+
+
+def tolerates_taints(table: NodeTable, batch: PodBatch):
+    """TaintToleration: every hard taint on the node must be tolerated.
+
+    The toleration evaluation already happened on the host per distinct
+    taint triple (PodBatch.tolerated); here it's a gather + reduce.
+    """
+    b = batch.batch
+    n, ts = table.taint_id.shape
+    hard = (table.taint_id != NONE_ID) & (
+        (table.taint_effect == EFFECT_NO_SCHEDULE)
+        | (table.taint_effect == EFFECT_NO_EXECUTE)
+    )
+    # [B, N*TS] gather of host-evaluated results, back to [B, N, TS].
+    tol = jnp.take(batch.tolerated, table.taint_id.reshape(-1), axis=1)
+    tol = tol.reshape(b, n, ts)
+    return ~(hard[None, :, :] & ~tol).any(axis=-1)
+
+
+def node_affinity(table: NodeTable, batch: PodBatch, resolved: ResolvedKeys):
+    """NodeAffinity required terms (OR of ANDed terms) + spec.nodeSelector."""
+    # nodeSelector: ANDed exact matches.
+    f = jnp.take(resolved.found, batch.sel_qidx, axis=0)   # [B, S, N]
+    v = jnp.take(resolved.val, batch.sel_qidx, axis=0)
+    sel_ok = f & (v == batch.sel_val[:, :, None])
+    sel_pass = (sel_ok | ~batch.sel_valid[:, :, None]).all(axis=1)
+
+    # required affinity: OR over terms.
+    term_match, has_expr = match_expressions(
+        resolved,
+        batch.req_expr_valid,
+        batch.req_qidx,
+        batch.req_op,
+        batch.req_vals,
+        batch.req_num,
+    )  # term_match: [B, T, N]
+    live = batch.req_term_valid & has_expr                 # empty term matches nothing
+    any_term = (term_match & live[:, :, None]).any(axis=1)
+    has_terms = batch.req_term_valid.any(axis=1)
+    aff_pass = jnp.where(has_terms[:, None], any_term, True)
+    return sel_pass & aff_pass
+
+
+def feasible_mask(table: NodeTable, batch: PodBatch, resolved: ResolvedKeys | None = None):
+    """AND of all filter plugins, plus row validity. bool[B, N]."""
+    if resolved is None:
+        resolved = resolve_query_keys(
+            table.label_key, table.label_val, table.label_num, batch.qkey
+        )
+    mask = table.valid[None, :]
+    mask = mask & fits_resources(table, batch)
+    mask = mask & node_name(table, batch)
+    mask = mask & tolerates_taints(table, batch)
+    mask = mask & node_affinity(table, batch, resolved)
+    return mask & batch.valid[:, None]

@@ -1,0 +1,82 @@
+"""Plugin profile: which filter/score kernels run and with what weights.
+
+Mirrors the reference's KubeSchedulerConfiguration profile — default
+filter/score set, DefaultPreemption disabled, DistPermit replaced by the
+engine's on-device global argmax (reference
+terraform/kubernetes/dist-scheduler.tf:551-570).  Weights are the upstream
+defaults for the plugins the BASELINE.json configs exercise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from k8s1m_tpu.ops.label_match import ResolvedKeys, resolve_query_keys
+from k8s1m_tpu.plugins import filters, scores
+from k8s1m_tpu.snapshot.node_table import NodeTable
+from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class Profile:
+    """Score weights (upstream defaults); 0 disables a plugin."""
+
+    least_allocated: float = 1.0
+    balanced_allocation: float = 1.0
+    taint_toleration: float = 3.0
+    node_affinity: float = 2.0
+    topology_spread: float = 2.0
+    interpod_affinity: float = 2.0
+
+
+def default_profile() -> Profile:
+    return Profile()
+
+
+def score_and_filter(
+    table: NodeTable,
+    batch: PodBatch,
+    profile: Profile,
+    constraints=None,
+    stats=None,
+):
+    """One fused pass over a node chunk: (mask bool[B,N], score i32[B,N]).
+
+    ``constraints`` is the ConstraintState chunk for topology-spread /
+    inter-pod-affinity (with ``stats`` the batch prologue); None disables
+    those plugins (configs 1-2 of BASELINE.json).
+    """
+    resolved = resolve_query_keys(
+        table.label_key, table.label_val, table.label_num, batch.qkey
+    )
+    mask = filters.feasible_mask(table, batch, resolved)
+
+    # Each plugin emits [0, 100] and is floored to an integer before
+    # weighting, like upstream's int64 framework scores — integer totals are
+    # what makes the random tie-break exact (see ops/priority.py).
+    def w(weight, s):
+        return jnp.floor(s).astype(jnp.int32) * int(weight)
+
+    score = jnp.zeros(mask.shape, jnp.int32)
+    if profile.least_allocated:
+        score += w(profile.least_allocated, scores.least_allocated(table, batch))
+    if profile.balanced_allocation:
+        score += w(profile.balanced_allocation, scores.balanced_allocation(table, batch))
+    if profile.taint_toleration:
+        score += w(profile.taint_toleration, scores.taint_toleration(table, batch))
+    if profile.node_affinity:
+        score += w(
+            profile.node_affinity, scores.node_affinity_score(table, batch, resolved)
+        )
+    if constraints is not None:
+        from k8s1m_tpu.plugins import topology
+
+        tmask, tscore = topology.filter_and_score(
+            table, batch, constraints, stats,
+            profile.topology_spread, profile.interpod_affinity,
+        )
+        mask = mask & tmask
+        score += tscore
+    return mask, score

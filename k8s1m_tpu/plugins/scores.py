@@ -1,0 +1,80 @@
+"""Score plugins as tensor kernels over (pod batch x node chunk).
+
+Each returns f32[B, N] in [0, 100] (higher = better), mirroring upstream
+scheduling-framework Score plugins; the registry applies the default-profile
+weights and sums, which is exactly the per-node total the fork publishes as
+NodePluginScoresState for DistPermit (reference
+dist-scheduler/pkg/distpermit/distpermit.go:51-56).
+
+Known divergence from upstream, by design: plugins whose upstream
+NormalizeScore divides by the *observed* max across nodes (TaintToleration,
+NodeAffinity) here normalize by a *static* per-pod bound instead (max
+possible count / sum of term weights).  Node ordering within each plugin is
+identical; only the inter-plugin mixing ratio can differ.  A static bound
+keeps the kernel single-pass over node chunks — the observed max would need
+a second full pass over 1M nodes per batch.  The differential oracle
+implements these exact semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from k8s1m_tpu.config import EFFECT_PREFER_NO_SCHEDULE, NONE_ID
+from k8s1m_tpu.ops.label_match import ResolvedKeys, match_expressions
+from k8s1m_tpu.snapshot.node_table import NodeTable
+from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+def least_allocated(table: NodeTable, batch: PodBatch):
+    """NodeResourcesFit LeastAllocated: mean over {cpu, mem} of free/alloc."""
+    cpu_after = table.cpu_req[None, :] + batch.cpu[:, None]
+    mem_after = table.mem_req[None, :] + batch.mem[:, None]
+    alloc_cpu = jnp.maximum(table.cpu_alloc, 1)[None, :]
+    alloc_mem = jnp.maximum(table.mem_alloc, 1)[None, :]
+    cpu_score = (alloc_cpu - cpu_after) / alloc_cpu
+    mem_score = (alloc_mem - mem_after) / alloc_mem
+    return 50.0 * (jnp.clip(cpu_score, 0.0) + jnp.clip(mem_score, 0.0))
+
+
+def balanced_allocation(table: NodeTable, batch: PodBatch):
+    """NodeResourcesBalancedAllocation: 100 * (1 - std of resource fractions).
+
+    For two resources the standard deviation is |f_cpu - f_mem| / 2.
+    """
+    alloc_cpu = jnp.maximum(table.cpu_alloc, 1)[None, :]
+    alloc_mem = jnp.maximum(table.mem_alloc, 1)[None, :]
+    f_cpu = jnp.clip((table.cpu_req[None, :] + batch.cpu[:, None]) / alloc_cpu, 0.0, 1.0)
+    f_mem = jnp.clip((table.mem_req[None, :] + batch.mem[:, None]) / alloc_mem, 0.0, 1.0)
+    return 100.0 * (1.0 - jnp.abs(f_cpu - f_mem) / 2.0)
+
+
+def taint_toleration(table: NodeTable, batch: PodBatch):
+    """TaintToleration score: fewer untolerated PreferNoSchedule taints is
+    better.  Static-bound normalization over taint_slots (see module doc)."""
+    b = batch.batch
+    n, ts = table.taint_id.shape
+    soft = (table.taint_id != NONE_ID) & (
+        table.taint_effect == EFFECT_PREFER_NO_SCHEDULE
+    )
+    tol = jnp.take(batch.tolerated, table.taint_id.reshape(-1), axis=1).reshape(b, n, ts)
+    count = (soft[None, :, :] & ~tol).sum(axis=-1)
+    return 100.0 * (1.0 - count / ts)
+
+
+def node_affinity_score(table: NodeTable, batch: PodBatch, resolved: ResolvedKeys):
+    """NodeAffinity preferred terms: sum of matched term weights, normalized
+    by the pod's total preferred weight (static bound, see module doc)."""
+    term_match, has_expr = match_expressions(
+        resolved,
+        batch.pref_expr_valid,
+        batch.pref_qidx,
+        batch.pref_op,
+        batch.pref_vals,
+        batch.pref_num,
+    )  # [B, P, N]
+    live = batch.pref_term_valid & has_expr
+    w = jnp.where(live, batch.pref_weight, 0)              # [B, P]
+    matched = (term_match & live[:, :, None]) * w[:, :, None]
+    total = jnp.maximum(w.sum(axis=1), 1)                  # [B]
+    return 100.0 * matched.sum(axis=1) / total[:, None]

@@ -1,0 +1,46 @@
+"""Pure-Python upstream-Kubernetes semantics helpers.
+
+These implement, on the host, the string-level predicates the Go scheduler
+framework evaluates per (pod, node) in its hot loop.  The TPU design moves
+them to the host at *interning granularity*: a toleration is evaluated once
+per distinct taint triple ever seen (not once per pod x node), and the
+result ships to the device as a bitmask.  The same functions back the
+differential oracle, so the device kernels and the oracle share one
+definition of the semantics.
+
+Reference for behavior: upstream k8s.io/api/core/v1 helpers as consumed by
+the forked scheduler (reference dist-scheduler/go.mod:138); toleration
+semantics are v1.Toleration.ToleratesTaint, node-affinity semantics are
+nodeaffinity.NodeSelector.Match.
+"""
+
+from __future__ import annotations
+
+from k8s1m_tpu.config import (
+    EFFECT_NONE,
+    TOL_OP_EQUAL,
+    TOL_OP_EXISTS,
+)
+
+
+def toleration_tolerates_taint(tol, taint) -> bool:
+    """v1.Toleration.ToleratesTaint.
+
+    tol: pod_encoding.Toleration; taint: node_table.Taint.
+    - empty effect on the toleration matches any effect;
+    - empty key matches any key (operator must be Exists);
+    - Exists ignores value, Equal compares values.
+    """
+    if tol.effect != EFFECT_NONE and tol.effect != taint.effect:
+        return False
+    if tol.key and tol.key != taint.key:
+        return False
+    if tol.op == TOL_OP_EXISTS:
+        return True
+    if tol.op == TOL_OP_EQUAL:
+        return tol.value == taint.value
+    return False
+
+
+def pod_tolerates_taint(tolerations, taint) -> bool:
+    return any(toleration_tolerates_taint(t, taint) for t in tolerations)

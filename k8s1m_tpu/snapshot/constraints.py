@@ -1,0 +1,218 @@
+"""Topology-spread and inter-pod-affinity constraint state.
+
+Upstream recomputes "how many matching pods per topology domain" by
+walking pod lists at every scheduling cycle — the O(pods x nodes) path
+BASELINE.json config 4 calls out.  The TPU design is incremental instead:
+constraints (a namespace + labelSelector + topologyKey triple) are
+interned host-side into dense slots, and the device keeps *count tables*
+per (slot, domain):
+
+- hostname-keyed domains are nodes, so counts are [slots, N] and shard
+  with the node axis;
+- zone/region-keyed domains are small dense tables, replicated.
+
+Bind commits scatter-add into these tables inside the same jit step that
+produced the binds, so the next batch sees them — the equivalent of the
+scheduler cache's AssumePod for topology state.  Unbinds (pod deletion)
+arrive as negative deltas from the coordinator.
+
+For inter-pod affinity two tables exist per granularity:
+- ``tgt``: pods *matched by* the term's selector per domain (evaluating
+  the incoming pod's own terms);
+- ``own``: pods *carrying* the term per domain (evaluating existing pods'
+  required anti-affinity against the incoming pod — upstream's symmetry
+  rule).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from k8s1m_tpu.config import (
+    TOPO_HOSTNAME,
+    TOPO_REGION,
+    TOPO_ZONE,
+    TableSpec,
+)
+
+
+@struct.dataclass
+class ConstraintState:
+    # PodTopologySpread: matching-pod counts per (constraint slot, domain).
+    spread_node: jax.Array    # i32[C, N]
+    spread_zone: jax.Array    # i32[C, Z]
+    spread_region: jax.Array  # i32[C, R]
+    # InterPodAffinity target counts (pods matching the term's selector).
+    tgt_node: jax.Array       # i32[A, N]
+    tgt_zone: jax.Array       # i32[A, Z]
+    tgt_region: jax.Array     # i32[A, R]
+    # InterPodAffinity owner counts (pods carrying the term; only required
+    # anti-affinity owners matter for the symmetry filter).
+    own_node: jax.Array       # i32[A, N]
+    own_zone: jax.Array       # i32[A, Z]
+    own_region: jax.Array     # i32[A, R]
+
+
+def empty_constraints(spec: TableSpec) -> ConstraintState:
+    c, a = spec.spread_slots, spec.affinity_slots
+    n, z, r = spec.max_nodes, spec.max_zones, spec.max_regions
+    i32 = jnp.int32
+    return ConstraintState(
+        spread_node=jnp.zeros((c, n), i32),
+        spread_zone=jnp.zeros((c, z), i32),
+        spread_region=jnp.zeros((c, r), i32),
+        tgt_node=jnp.zeros((a, n), i32),
+        tgt_zone=jnp.zeros((a, z), i32),
+        tgt_region=jnp.zeros((a, r), i32),
+        own_node=jnp.zeros((a, n), i32),
+        own_zone=jnp.zeros((a, z), i32),
+        own_region=jnp.zeros((a, r), i32),
+    )
+
+
+def slice_constraints(state: ConstraintState, start, chunk: int) -> ConstraintState:
+    """Slice the node-domain tables to match a node-table chunk; domain
+    tables pass through whole."""
+    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=1)
+    return state.replace(
+        spread_node=sl(state.spread_node),
+        tgt_node=sl(state.tgt_node),
+        own_node=sl(state.own_node),
+    )
+
+
+# ---- host-side interning ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectorKey:
+    """Identity of a constraint: namespace + matchLabels + topology key."""
+
+    namespace: str
+    match_labels: tuple[tuple[str, str], ...]
+    topo: int
+
+
+class ConstraintTracker:
+    """Interns spread constraints and affinity terms into device slots.
+
+    Slots are a small fixed pool (TableSpec.spread_slots/affinity_slots):
+    only constraints referenced by in-flight workloads need to live on
+    device, mirroring how the reference only materializes plugin state for
+    pods it is actively scheduling (CycleState, reference
+    pkg/distpermit/distpermit.go:51-56).
+    """
+
+    def __init__(self, spec: TableSpec) -> None:
+        self.spec = spec
+        self._spread: dict[SelectorKey, int] = {}
+        self._affinity: dict[SelectorKey, int] = {}
+
+    @staticmethod
+    def _key(namespace: str, selector: dict[str, str], topo: int) -> SelectorKey:
+        return SelectorKey(namespace, tuple(sorted(selector.items())), topo)
+
+    def spread_slot(self, namespace: str, selector: dict[str, str], topo: int) -> int:
+        key = self._key(namespace, selector, topo)
+        slot = self._spread.get(key)
+        if slot is None:
+            slot = len(self._spread)
+            if slot >= self.spec.spread_slots:
+                raise ValueError("out of spread constraint slots; grow TableSpec.spread_slots")
+            self._spread[key] = slot
+        return slot
+
+    def affinity_slot(self, namespace: str, selector: dict[str, str], topo: int) -> int:
+        key = self._key(namespace, selector, topo)
+        slot = self._affinity.get(key)
+        if slot is None:
+            slot = len(self._affinity)
+            if slot >= self.spec.affinity_slots:
+                raise ValueError("out of affinity term slots; grow TableSpec.affinity_slots")
+            self._affinity[key] = slot
+        return slot
+
+    @staticmethod
+    def selector_matches(selector: dict[str, str], labels: dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    def spread_matches(self, namespace: str, labels: dict[str, str]):
+        """(slot, topo) of every interned spread constraint matching a pod."""
+        return [
+            (slot, key.topo)
+            for key, slot in self._spread.items()
+            if key.namespace == namespace
+            and self.selector_matches(dict(key.match_labels), labels)
+        ]
+
+    def affinity_matches(self, namespace: str, labels: dict[str, str]):
+        return [
+            (slot, key.topo)
+            for key, slot in self._affinity.items()
+            if key.namespace == namespace
+            and self.selector_matches(dict(key.match_labels), labels)
+        ]
+
+
+# ---- jit-side commit -------------------------------------------------------
+
+
+def commit_constraint_binds(
+    state: ConstraintState,
+    bound_node,   # bool[B] gate for node-domain scatters (shard-local under sharding)
+    bound_domain,  # bool[B] gate for zone/region scatters (always global)
+    node_row,     # i32[B] (clipped to valid rows where unbound)
+    zone,         # i32[B] domain of the bound node
+    region,       # i32[B]
+    sinc_valid,   # bool[B, SI] pod matches spread constraint sinc_cid[b, j]
+    sinc_cid,     # i32[B, SI]
+    sinc_topo,    # i32[B, SI]
+    iinc_valid,   # bool[B, AI] pod matches affinity term iinc_tid[b, j]
+    iinc_tid,     # i32[B, AI]
+    iinc_topo,    # i32[B, AI]
+    own_valid,    # bool[B, AR] pod carries affinity term own_tid[b, j]
+    own_tid,      # i32[B, AR]
+    own_topo,     # i32[B, AR]
+) -> ConstraintState:
+    """Fold a batch's binds into the count tables (one scatter per table)."""
+
+    def flat(x, width):
+        return jnp.broadcast_to(x[:, None], (x.shape[0], width)).reshape(-1)
+
+    def apply(node_tab, zone_tab, region_tab, valid, slot, topo):
+        b, w = valid.shape
+        inc_node = (valid & bound_node[:, None]).astype(jnp.int32).reshape(-1)
+        inc_dom = (valid & bound_domain[:, None]).astype(jnp.int32).reshape(-1)
+        slot, topo = slot.reshape(-1), topo.reshape(-1)
+        node_tab = node_tab.at[slot, flat(node_row, w)].add(
+            jnp.where(topo == TOPO_HOSTNAME, inc_node, 0)
+        )
+        zone_tab = zone_tab.at[slot, flat(zone, w)].add(
+            jnp.where(topo == TOPO_ZONE, inc_dom, 0)
+        )
+        region_tab = region_tab.at[slot, flat(region, w)].add(
+            jnp.where(topo == TOPO_REGION, inc_dom, 0)
+        )
+        return node_tab, zone_tab, region_tab
+
+    sn, sz, sr = apply(
+        state.spread_node, state.spread_zone, state.spread_region,
+        sinc_valid, sinc_cid, sinc_topo,
+    )
+    tn, tz, tr = apply(
+        state.tgt_node, state.tgt_zone, state.tgt_region,
+        iinc_valid, iinc_tid, iinc_topo,
+    )
+    on, oz, orr = apply(
+        state.own_node, state.own_zone, state.own_region,
+        own_valid, own_tid, own_topo,
+    )
+    return ConstraintState(
+        spread_node=sn, spread_zone=sz, spread_region=sr,
+        tgt_node=tn, tgt_zone=tz, tgt_region=tr,
+        own_node=on, own_zone=oz, own_region=orr,
+    )

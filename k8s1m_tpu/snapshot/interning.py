@@ -18,24 +18,24 @@ from k8s1m_tpu.config import NO_NUMERIC, NONE_ID
 
 
 class Interner:
-    """Bidirectional str<->int table. Id 0 is reserved for "absent"."""
+    """Bidirectional value<->int table over hashables. Id 0 is "absent"."""
 
     def __init__(self) -> None:
-        self._to_id: dict[str, int] = {}
-        self._to_str: list[str | None] = [None]
+        self._to_id: dict = {}
+        self._to_val: list = [None]
 
-    def intern(self, s: str | None) -> int:
+    def intern(self, s) -> int:
         if s is None:
             return NONE_ID
         i = self._to_id.get(s)
         if i is None:
-            i = len(self._to_str)
+            i = len(self._to_val)
             self._to_id[s] = i
-            self._to_str.append(s)
+            self._to_val.append(s)
         return i
 
-    def lookup(self, s: str | None) -> int:
-        """Like intern, but returns NONE_ID for never-seen strings.
+    def lookup(self, s) -> int:
+        """Like intern, but returns NONE_ID for never-seen values.
 
         Used when encoding *queries* (pod selectors): a value that was never
         interned cannot match any node, and must not grow the table.
@@ -44,14 +44,22 @@ class Interner:
             return NONE_ID
         return self._to_id.get(s, NONE_ID)
 
-    def string(self, i: int) -> str | None:
-        return self._to_str[i]
+    def value(self, i: int):
+        return self._to_val[i]
+
+    # Kept for readability at string-namespace call sites.
+    string = value
 
     def __len__(self) -> int:
-        return len(self._to_str)
+        return len(self._to_val)
 
-    def __contains__(self, s: str) -> bool:
+    def __contains__(self, s) -> bool:
         return s in self._to_id
+
+    def items(self):
+        """Yields (id, value) for every interned value (skips the 0 slot)."""
+        for i in range(1, len(self._to_val)):
+            yield i, self._to_val[i]
 
 
 def numeric_of(value: str) -> int:
@@ -76,8 +84,11 @@ class Vocab:
     def __init__(self) -> None:
         self.label_keys = Interner()
         self.label_values = Interner()
-        self.taint_keys = Interner()
-        self.taint_values = Interner()
+        # Whole (key, value, effect) taint triples.  The toleration check is
+        # evaluated host-side once per (pod, distinct triple) and shipped to
+        # the device as a bitmask — the cluster-wide distinct-taint count is
+        # tiny even at 1M nodes.
+        self.taints = Interner()
         self.node_names = Interner()
         self.zones = Interner()
         self.regions = Interner()

@@ -78,10 +78,12 @@ class NodeTable:
     label_key: jax.Array    # i32[N, L]
     label_val: jax.Array    # i32[N, L]
     label_num: jax.Array    # i32[N, L]
-    # Taints (node.spec.unschedulable is folded in as the canonical
-    # node.kubernetes.io/unschedulable:NoSchedule taint).
-    taint_key: jax.Array    # i32[N, T]
-    taint_val: jax.Array    # i32[N, T]
+    # Taints as interned (key,value,effect)-triple ids plus the effect, so
+    # the filter can distinguish hard (NoSchedule/NoExecute) from soft
+    # (PreferNoSchedule) without re-deriving it.  node.spec.unschedulable is
+    # folded in as the canonical node.kubernetes.io/unschedulable:NoSchedule
+    # taint (upstream TaintNodeUnschedulable).
+    taint_id: jax.Array     # i32[N, T] triple id in [0, max_taint_ids)
     taint_effect: jax.Array  # i32[N, T]
     # Dense topology-domain ids for the count tables.
     zone: jax.Array         # i32[N] in [0, max_zones)
@@ -115,8 +117,7 @@ def empty_table(spec: TableSpec) -> NodeTable:
         label_key=jnp.zeros((n, l), i32),
         label_val=jnp.zeros((n, l), i32),
         label_num=jnp.zeros((n, l), i32),
-        taint_key=jnp.zeros((n, t), i32),
-        taint_val=jnp.zeros((n, t), i32),
+        taint_id=jnp.zeros((n, t), i32),
         taint_effect=jnp.zeros((n, t), i32),
         zone=jnp.zeros((n,), i32),
         region=jnp.zeros((n,), i32),
@@ -146,8 +147,7 @@ class NodeTableHost:
         self.label_key = np.zeros((n, l), np.int32)
         self.label_val = np.zeros((n, l), np.int32)
         self.label_num = np.zeros((n, l), np.int32)
-        self.taint_key = np.zeros((n, t), np.int32)
-        self.taint_val = np.zeros((n, t), np.int32)
+        self.taint_id = np.zeros((n, t), np.int32)
         self.taint_effect = np.zeros((n, t), np.int32)
         self.zone = np.zeros((n,), np.int32)
         self.region = np.zeros((n,), np.int32)
@@ -175,6 +175,19 @@ class NodeTableHost:
             self._next_row += 1
         self._row_of[name] = row
         return row
+
+    def alloc_rows(self, names: list[str]) -> np.ndarray:
+        """Bulk-allocate contiguous-ish rows for many new nodes.
+
+        Fast path for load generators (the make_nodes equivalent,
+        reference kwok/make_nodes/main.go:116-182): callers fill the table
+        columns vectorized; per-row python dispatch would dominate at 1M.
+        """
+        rows = np.empty((len(names),), np.int64)
+        for i, name in enumerate(names):
+            rows[i] = self._alloc_row(name)
+        self.valid[rows] = True
+        return rows
 
     # ---- deltas ---------------------------------------------------------
 
@@ -207,11 +220,14 @@ class NodeTableHost:
                 f"taint_slots={self.spec.taint_slots}"
             )
         tk = np.zeros((self.spec.taint_slots,), np.int32)
-        tv = np.zeros_like(tk)
         te = np.zeros_like(tk)
         for i, taint in enumerate(taints):
-            tk[i] = v.taint_keys.intern(taint.key)
-            tv[i] = v.taint_values.intern(taint.value)
+            tid = v.taints.intern((taint.key, taint.value, taint.effect))
+            if tid >= self.spec.max_taint_ids:
+                raise ValueError(
+                    "distinct taint triples overflow TableSpec.max_taint_ids"
+                )
+            tk[i] = tid
             te[i] = taint.effect
 
         zone_id = v.zones.intern(labels.get(ZONE_LABEL)) if ZONE_LABEL in labels else NONE_ID
@@ -226,7 +242,7 @@ class NodeTableHost:
         self.mem_alloc[row] = node.mem_kib
         self.pods_alloc[row] = node.pods
         self.label_key[row], self.label_val[row], self.label_num[row] = lk, lv, ln
-        self.taint_key[row], self.taint_val[row], self.taint_effect[row] = tk, tv, te
+        self.taint_id[row], self.taint_effect[row] = tk, te
         self.zone[row] = zone_id
         self.region[row] = region_id
         self.name_id[row] = v.node_names.intern(node.name)
@@ -244,7 +260,7 @@ class NodeTableHost:
             arr[row] = 0
         for arr in (
             self.label_key, self.label_val, self.label_num,
-            self.taint_key, self.taint_val, self.taint_effect,
+            self.taint_id, self.taint_effect,
         ):
             arr[row] = 0
         self._free_rows.append(row)
@@ -284,8 +300,7 @@ class NodeTableHost:
             label_key=put(self.label_key),
             label_val=put(self.label_val),
             label_num=put(self.label_num),
-            taint_key=put(self.taint_key),
-            taint_val=put(self.taint_val),
+            taint_id=put(self.taint_id),
             taint_effect=put(self.taint_effect),
             zone=put(self.zone),
             region=put(self.region),

@@ -3,15 +3,25 @@
 The reference scatters each pod's raw protobuf to 256 shards over a relay
 tree (reference cmd/dist-scheduler/relay.go:23-178); here a *batch* of pods
 is compiled to padded int tensors once and broadcast to the mesh as data.
-Everything string-ish goes through the snapshot Vocab; values never seen on
-any node encode to NONE_ID, which naturally cannot match (upstream's
-behavior for a selector naming an unknown value).
 
-Padding conventions (checked by the kernels):
-- a toleration slot is live iff tol_valid — key id 0 with op Exists is the
-  legal "tolerate everything" toleration, so validity is explicit;
+Two host-side precomputations keep the device hot loop free of string-ish
+inner dimensions:
+
+- **Tolerations** are evaluated on the host against every *distinct* taint
+  triple the cluster has ever seen (Vocab.taints) and shipped as a
+  ``tolerated[B, max_taint_ids]`` bitmask; the device filter is a gather +
+  reduce over taint slots, never a (toleration x taint) comparison.
+- **Query keys**: every label key referenced by this batch's selectors is
+  collected into a per-batch table ``qkey[Q]``.  The device resolves each
+  node's (found, value, numeric) for those Q keys once per node chunk, and
+  all selector expressions index into that [Q, N] resolution by position —
+  the per-node label-slot scan happens once, not once per expression.
+
+Padding conventions (relied on by the kernels):
 - an affinity term/expr slot is live iff term_valid/expr_valid;
-- expr_vals is padded with NONE_ID, which never equals a live label value.
+- expr value sets are padded with NONE_ID, which never equals a live label
+  value id (values never seen on any node also encode to NONE_ID, which is
+  exactly upstream's "cannot match" behavior).
 """
 
 from __future__ import annotations
@@ -25,20 +35,19 @@ from flax import struct
 
 from k8s1m_tpu.config import (
     EFFECT_NONE,
+    NO_NUMERIC,
     NONE_ID,
     PodSpec,
-    SEL_OP_DOES_NOT_EXIST,
-    SEL_OP_EXISTS,
     SEL_OP_GT,
-    SEL_OP_IN,
     SEL_OP_LT,
-    SEL_OP_NOT_IN,
     SPREAD_DO_NOT_SCHEDULE,
-    TOL_OP_EQUAL,
     TOL_OP_EXISTS,
     TOPO_HOSTNAME,
+    TableSpec,
 )
+from k8s1m_tpu.semantics import pod_tolerates_taint
 from k8s1m_tpu.snapshot.interning import Vocab, numeric_of
+from k8s1m_tpu.snapshot.node_table import Taint
 
 
 @dataclasses.dataclass
@@ -105,6 +114,11 @@ class PodInfo:
     preferred_terms: list[PreferredSchedulingTerm] = dataclasses.field(default_factory=list)
     spread_refs: list[SpreadConstraintRef] = dataclasses.field(default_factory=list)
     affinity_refs: list[AffinityTermRef] = dataclasses.field(default_factory=list)
+    # (slot, topo) pairs of constraints/terms whose selector matches this
+    # pod's labels — computed host-side (ConstraintTracker.*_matches) and
+    # used by the commit scatter to keep domain counts current.
+    spread_incs: list[tuple[int, int]] = dataclasses.field(default_factory=list)
+    ipa_incs: list[tuple[int, int]] = dataclasses.field(default_factory=list)
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
 
     @property
@@ -120,28 +134,27 @@ class PodBatch:
     cpu: jax.Array           # i32[B] milliCPU requested
     mem: jax.Array           # i32[B] KiB requested
     node_name_id: jax.Array  # i32[B] spec.nodeName (NONE_ID = unset)
-    # Tolerations.
-    tol_valid: jax.Array     # bool[B, TO]
-    tol_key: jax.Array       # i32[B, TO]
-    tol_val: jax.Array       # i32[B, TO]
-    tol_op: jax.Array        # i32[B, TO]
-    tol_effect: jax.Array    # i32[B, TO]
+    # Host-evaluated toleration results per distinct taint triple.
+    tolerated: jax.Array     # bool[B, max_taint_ids]
+    # Per-batch query-key table: global label-key ids; expressions below
+    # store *indices* into this table.
+    qkey: jax.Array          # i32[Q]
     # spec.nodeSelector — ANDed exact-match pairs.
     sel_valid: jax.Array     # bool[B, S]   (S = aff_exprs slots reused)
-    sel_key: jax.Array       # i32[B, S]
-    sel_val: jax.Array       # i32[B, S]
+    sel_qidx: jax.Array      # i32[B, S] index into qkey
+    sel_val: jax.Array       # i32[B, S] required label value id
     # requiredDuringSchedulingIgnoredDuringExecution — OR of terms, AND of exprs.
     req_term_valid: jax.Array  # bool[B, T]
     req_expr_valid: jax.Array  # bool[B, T, E]
-    req_key: jax.Array         # i32[B, T, E]
+    req_qidx: jax.Array        # i32[B, T, E]
     req_op: jax.Array          # i32[B, T, E]
     req_vals: jax.Array        # i32[B, T, E, V]
     req_num: jax.Array         # i32[B, T, E] parsed value for Gt/Lt
-    # preferredDuringScheduling terms (single-term each, weighted).
+    # preferredDuringScheduling terms (single-term each, weighted 1-100).
     pref_term_valid: jax.Array  # bool[B, P]
     pref_weight: jax.Array      # i32[B, P]
     pref_expr_valid: jax.Array  # bool[B, P, E]
-    pref_key: jax.Array         # i32[B, P, E]
+    pref_qidx: jax.Array        # i32[B, P, E]
     pref_op: jax.Array          # i32[B, P, E]
     pref_vals: jax.Array        # i32[B, P, E, V]
     pref_num: jax.Array         # i32[B, P, E]
@@ -160,6 +173,13 @@ class PodBatch:
     ipa_anti: jax.Array         # bool[B, AR]
     ipa_weight: jax.Array       # i32[B, AR]
     ipa_self: jax.Array         # bool[B, AR]
+    # Constraints/terms whose selector matches this pod (commit increments).
+    sinc_valid: jax.Array       # bool[B, SI]
+    sinc_cid: jax.Array         # i32[B, SI]
+    sinc_topo: jax.Array        # i32[B, SI]
+    iinc_valid: jax.Array       # bool[B, AI]
+    iinc_tid: jax.Array         # i32[B, AI]
+    iinc_topo: jax.Array        # i32[B, AI]
 
     @property
     def batch(self) -> int:
@@ -169,8 +189,9 @@ class PodBatch:
 class PodBatchHost:
     """Compiles a list of PodInfo into one PodBatch."""
 
-    def __init__(self, spec: PodSpec, vocab: Vocab) -> None:
+    def __init__(self, spec: PodSpec, table_spec: TableSpec, vocab: Vocab) -> None:
         self.spec = spec
+        self.table_spec = table_spec
         self.vocab = vocab
 
     def encode(self, pods: list[PodInfo]) -> PodBatch:
@@ -188,21 +209,20 @@ class PodBatchHost:
 
         out = dict(
             valid=zb(b), cpu=zi(b), mem=zi(b), node_name_id=zi(b),
-            tol_valid=zb(b, s.tol_slots), tol_key=zi(b, s.tol_slots),
-            tol_val=zi(b, s.tol_slots), tol_op=zi(b, s.tol_slots),
-            tol_effect=zi(b, s.tol_slots),
-            sel_valid=zb(b, s.aff_exprs), sel_key=zi(b, s.aff_exprs),
+            tolerated=zb(b, self.table_spec.max_taint_ids),
+            qkey=zi(s.query_keys),
+            sel_valid=zb(b, s.aff_exprs), sel_qidx=zi(b, s.aff_exprs),
             sel_val=zi(b, s.aff_exprs),
             req_term_valid=zb(b, s.aff_terms),
             req_expr_valid=zb(b, s.aff_terms, s.aff_exprs),
-            req_key=zi(b, s.aff_terms, s.aff_exprs),
+            req_qidx=zi(b, s.aff_terms, s.aff_exprs),
             req_op=zi(b, s.aff_terms, s.aff_exprs),
             req_vals=zi(b, s.aff_terms, s.aff_exprs, s.aff_values),
             req_num=zi(b, s.aff_terms, s.aff_exprs),
             pref_term_valid=zb(b, s.pref_terms),
             pref_weight=zi(b, s.pref_terms),
             pref_expr_valid=zb(b, s.pref_terms, s.aff_exprs),
-            pref_key=zi(b, s.pref_terms, s.aff_exprs),
+            pref_qidx=zi(b, s.pref_terms, s.aff_exprs),
             pref_op=zi(b, s.pref_terms, s.aff_exprs),
             pref_vals=zi(b, s.pref_terms, s.aff_exprs, s.aff_values),
             pref_num=zi(b, s.pref_terms, s.aff_exprs),
@@ -213,44 +233,76 @@ class PodBatchHost:
             ipa_topo=zi(b, s.affinity_refs), ipa_required=zb(b, s.affinity_refs),
             ipa_anti=zb(b, s.affinity_refs), ipa_weight=zi(b, s.affinity_refs),
             ipa_self=zb(b, s.affinity_refs),
+            sinc_valid=zb(b, s.spread_incs), sinc_cid=zi(b, s.spread_incs),
+            sinc_topo=zi(b, s.spread_incs),
+            iinc_valid=zb(b, s.ipa_incs), iinc_tid=zi(b, s.ipa_incs),
+            iinc_topo=zi(b, s.ipa_incs),
         )
+
+        # Per-batch query-key table.  Index 0 is reserved for "key NONE"
+        # (qkey[0] == NONE_ID, never found on any node) so padded
+        # expression slots resolve harmlessly.
+        qidx_of: dict[str, int] = {}
+
+        def qidx(key: str) -> int:
+            i = qidx_of.get(key)
+            if i is None:
+                i = len(qidx_of) + 1
+                if i >= s.query_keys:
+                    raise ValueError(
+                        f"batch references >{s.query_keys - 1} distinct selector "
+                        "keys; grow PodSpec.query_keys"
+                    )
+                qidx_of[key] = i
+                out["qkey"][i] = v.label_keys.lookup(key)
+            return i
 
         for i, pod in enumerate(pods):
             out["valid"][i] = True
             out["cpu"][i] = pod.cpu_milli
             out["mem"][i] = pod.mem_kib
-            out["node_name_id"][i] = v.node_names.lookup(pod.node_name)
+            # spec.nodeName naming a node we've never seen must match
+            # nothing (not "unset"), hence the -1 sentinel.
+            if pod.node_name is None:
+                out["node_name_id"][i] = NONE_ID
+            else:
+                nid = v.node_names.lookup(pod.node_name)
+                out["node_name_id"][i] = nid if nid != NONE_ID else -1
 
-            if len(pod.tolerations) > s.tol_slots:
-                raise ValueError(f"pod {pod.key}: too many tolerations")
-            for j, tol in enumerate(pod.tolerations):
-                out["tol_valid"][i, j] = True
-                out["tol_key"][i, j] = v.taint_keys.lookup(tol.key or None)
-                out["tol_val"][i, j] = v.taint_values.lookup(tol.value)
-                out["tol_op"][i, j] = tol.op
-                out["tol_effect"][i, j] = tol.effect
+            # Evaluate this pod's tolerations against every distinct taint
+            # triple (upstream: v1.Toleration.ToleratesTaint per node taint).
+            for tid, (tkey, tval, teffect) in v.taints.items():
+                out["tolerated"][i, tid] = pod_tolerates_taint(
+                    pod.tolerations, Taint(tkey, tval, teffect)
+                )
 
             if len(pod.node_selector) > s.aff_exprs:
                 raise ValueError(f"pod {pod.key}: nodeSelector too large")
             for j, (k, val) in enumerate(sorted(pod.node_selector.items())):
                 out["sel_valid"][i, j] = True
-                out["sel_key"][i, j] = v.label_keys.lookup(k)
+                out["sel_qidx"][i, j] = qidx(k)
                 out["sel_val"][i, j] = v.label_values.lookup(val)
 
-            self._encode_terms(
-                i, pod.required_terms, out["req_term_valid"], out["req_expr_valid"],
-                out["req_key"], out["req_op"], out["req_vals"], out["req_num"],
-            )
+            if len(pod.required_terms) > s.aff_terms:
+                raise ValueError(f"pod {pod.key}: too many required affinity terms")
+            for j, term in enumerate(pod.required_terms):
+                out["req_term_valid"][i, j] = True
+                self._encode_exprs(
+                    qidx, i, j, term.match_expressions, out["req_expr_valid"],
+                    out["req_qidx"], out["req_op"], out["req_vals"], out["req_num"],
+                )
             if len(pod.preferred_terms) > s.pref_terms:
                 raise ValueError(f"pod {pod.key}: too many preferred terms")
             for j, pt in enumerate(pod.preferred_terms):
                 out["pref_term_valid"][i, j] = True
                 out["pref_weight"][i, j] = pt.weight
                 self._encode_exprs(
-                    i, j, pt.term.match_expressions, out["pref_expr_valid"],
-                    out["pref_key"], out["pref_op"], out["pref_vals"], out["pref_num"],
+                    qidx, i, j, pt.term.match_expressions, out["pref_expr_valid"],
+                    out["pref_qidx"], out["pref_op"], out["pref_vals"], out["pref_num"],
                 )
 
+            if len(pod.spread_refs) > s.spread_refs:
+                raise ValueError(f"pod {pod.key}: too many spread constraints")
             for j, ref in enumerate(pod.spread_refs):
                 out["spread_valid"][i, j] = True
                 out["spread_cid"][i, j] = ref.cid
@@ -258,6 +310,8 @@ class PodBatchHost:
                 out["spread_max_skew"][i, j] = ref.max_skew
                 out["spread_mode"][i, j] = ref.mode
                 out["spread_self"][i, j] = ref.self_match
+            if len(pod.affinity_refs) > s.affinity_refs:
+                raise ValueError(f"pod {pod.key}: too many affinity terms")
             for j, ref in enumerate(pod.affinity_refs):
                 out["ipa_valid"][i, j] = True
                 out["ipa_tid"][i, j] = ref.tid
@@ -267,27 +321,35 @@ class PodBatchHost:
                 out["ipa_weight"][i, j] = ref.weight
                 out["ipa_self"][i, j] = ref.self_match
 
+            if len(pod.spread_incs) > s.spread_incs:
+                raise ValueError(f"pod {pod.key}: too many spread increments")
+            for j, (cid, topo) in enumerate(pod.spread_incs):
+                out["sinc_valid"][i, j] = True
+                out["sinc_cid"][i, j] = cid
+                out["sinc_topo"][i, j] = topo
+            if len(pod.ipa_incs) > s.ipa_incs:
+                raise ValueError(f"pod {pod.key}: too many affinity increments")
+            for j, (tid, topo) in enumerate(pod.ipa_incs):
+                out["iinc_valid"][i, j] = True
+                out["iinc_tid"][i, j] = tid
+                out["iinc_topo"][i, j] = topo
+
         return PodBatch(**{k: jnp.asarray(a) for k, a in out.items()})
 
-    def _encode_terms(self, i, terms, term_valid, expr_valid, key, op, vals, num):
-        s = self.spec
-        if len(terms) > term_valid.shape[1]:
-            raise ValueError("too many required affinity terms")
-        for j, term in enumerate(terms):
-            term_valid[i, j] = True
-            self._encode_exprs(i, j, term.match_expressions, expr_valid, key, op, vals, num)
-
-    def _encode_exprs(self, i, j, exprs, expr_valid, key, op, vals, num):
+    def _encode_exprs(self, qidx, i, j, exprs, expr_valid, qidx_arr, op, vals, num):
         s = self.spec
         v = self.vocab
         if len(exprs) > s.aff_exprs:
             raise ValueError("too many match expressions in a term")
         for e, req in enumerate(exprs):
             expr_valid[i, j, e] = True
-            key[i, j, e] = v.label_keys.lookup(req.key)
+            qidx_arr[i, j, e] = qidx(req.key)
             op[i, j, e] = req.op
             if req.op in (SEL_OP_GT, SEL_OP_LT):
-                num[i, j, e] = numeric_of(req.values[0]) if req.values else 0
+                # Missing/unparseable operand -> unsatisfiable (NO_NUMERIC).
+                num[i, j, e] = (
+                    numeric_of(req.values[0]) if req.values else NO_NUMERIC
+                )
             else:
                 if len(req.values) > s.aff_values:
                     raise ValueError("too many values in a match expression")

@@ -1,0 +1,174 @@
+"""Differential harness: randomized snapshots, device kernels vs oracle.
+
+SURVEY.md §7 calls this non-negotiable: same snapshot -> CPU reference
+implementation vs TPU kernels, masks must match bit-exactly and integer
+scores value-exactly.
+"""
+
+import numpy as np
+import pytest
+
+from k8s1m_tpu.config import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    PodSpec,
+    SEL_OP_DOES_NOT_EXIST,
+    SEL_OP_EXISTS,
+    SEL_OP_GT,
+    SEL_OP_IN,
+    SEL_OP_LT,
+    SEL_OP_NOT_IN,
+    TOL_OP_EQUAL,
+    TOL_OP_EXISTS,
+    TableSpec,
+)
+from k8s1m_tpu.oracle import oracle_feasible, oracle_score
+from k8s1m_tpu.plugins.registry import Profile, score_and_filter
+from k8s1m_tpu.snapshot import (
+    NodeInfo,
+    NodeSelectorTerm,
+    NodeTableHost,
+    PodBatchHost,
+    PodInfo,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
+    Taint,
+    Toleration,
+)
+
+SPEC = TableSpec(max_nodes=64, max_zones=16, max_regions=8, max_taint_ids=64)
+
+LABEL_KEYS = ["tier", "rank", "disk", "gpu"]
+LABEL_VALUES = {
+    "tier": ["web", "db", "cache"],
+    "rank": [str(i) for i in range(8)] + ["notanum"],
+    "disk": ["ssd", "hdd"],
+    "gpu": ["a100", "h100"],
+}
+TAINT_POOL = [
+    Taint("dedicated", "gpu", EFFECT_NO_SCHEDULE),
+    Taint("dedicated", "db", EFFECT_NO_SCHEDULE),
+    Taint("flaky", "", EFFECT_NO_EXECUTE),
+    Taint("old", "", EFFECT_PREFER_NO_SCHEDULE),
+    Taint("hot", "zone", EFFECT_PREFER_NO_SCHEDULE),
+]
+OPS = [SEL_OP_IN, SEL_OP_NOT_IN, SEL_OP_EXISTS, SEL_OP_DOES_NOT_EXIST,
+       SEL_OP_GT, SEL_OP_LT]
+
+
+def random_nodes(rng, n):
+    nodes = []
+    for i in range(n):
+        labels = {}
+        for k in LABEL_KEYS:
+            if rng.random() < 0.6:
+                labels[k] = str(rng.choice(LABEL_VALUES[k]))
+        taints = [TAINT_POOL[j] for j in range(len(TAINT_POOL)) if rng.random() < 0.15]
+        nodes.append(NodeInfo(
+            name=f"n{i}",
+            cpu_milli=int(rng.integers(500, 8000)),
+            mem_kib=int(rng.integers(1 << 18, 1 << 24)),
+            pods=int(rng.integers(1, 20)),
+            labels=labels,
+            taints=taints,
+            unschedulable=bool(rng.random() < 0.05),
+        ))
+    return nodes
+
+
+def random_expr(rng):
+    key = str(rng.choice(LABEL_KEYS + ["never-seen-key"]))
+    op = int(rng.choice(OPS))
+    vals = LABEL_VALUES.get(key, ["x"])
+    if op in (SEL_OP_GT, SEL_OP_LT):
+        # occasionally a non-numeric or missing operand: must match nothing
+        r = rng.random()
+        values = ["notanum"] if r < 0.15 else ([] if r < 0.25 else [str(rng.integers(0, 8))])
+    elif op in (SEL_OP_IN, SEL_OP_NOT_IN):
+        count = int(rng.integers(1, 4))
+        values = [str(v) for v in rng.choice(vals, size=count)]
+    else:
+        values = []
+    return SelectorRequirement(key, op, values)
+
+
+def random_pods(rng, b, node_names):
+    pods = []
+    for i in range(b):
+        p = PodInfo(
+            name=f"p{i}",
+            cpu_milli=int(rng.integers(10, 4000)),
+            mem_kib=int(rng.integers(1 << 15, 1 << 22)),
+        )
+        if rng.random() < 0.15:
+            p.node_name = str(rng.choice(node_names + ["ghost-node"]))
+        if rng.random() < 0.3:
+            k = str(rng.choice(LABEL_KEYS))
+            p.node_selector = {k: str(rng.choice(LABEL_VALUES[k]))}
+        if rng.random() < 0.4:
+            p.required_terms = [
+                NodeSelectorTerm([random_expr(rng) for _ in range(rng.integers(1, 3))])
+                for _ in range(rng.integers(1, 3))
+            ]
+        if rng.random() < 0.4:
+            p.preferred_terms = [
+                PreferredSchedulingTerm(
+                    int(rng.integers(1, 100)),
+                    NodeSelectorTerm([random_expr(rng)]),
+                )
+                for _ in range(rng.integers(1, 3))
+            ]
+        for t in TAINT_POOL:
+            if rng.random() < 0.25:
+                if rng.random() < 0.5:
+                    p.tolerations.append(Toleration(t.key, TOL_OP_EXISTS, "", t.effect))
+                else:
+                    p.tolerations.append(
+                        Toleration(t.key, TOL_OP_EQUAL, t.value,
+                                   t.effect if rng.random() < 0.8 else 0)
+                    )
+        if rng.random() < 0.1:
+            p.tolerations.append(Toleration("", TOL_OP_EXISTS))
+        pods.append(p)
+    return pods
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_differential_masks_and_scores(seed):
+    rng = np.random.default_rng(seed)
+    n, b = 48, 24
+    nodes = random_nodes(rng, n)
+    pods = random_pods(rng, b, [nd.name for nd in nodes])
+
+    host = NodeTableHost(SPEC)
+    for nd in nodes:
+        host.upsert(nd)
+    # Pre-bind some pods so requested-resources paths are exercised.
+    requested = {}
+    for nd in nodes:
+        if rng.random() < 0.3:
+            c, m = int(rng.integers(0, nd.cpu_milli)), int(rng.integers(0, nd.mem_kib))
+            host.add_pod(nd.name, c, m)
+            requested[nd.name] = (c, m, 1)
+
+    enc = PodBatchHost(PodSpec(batch=32, aff_values=8), SPEC, host.vocab)
+    batch = enc.encode(pods)
+    profile = Profile(topology_spread=0, interpod_affinity=0)
+    mask, score = score_and_filter(host.to_device(), batch, profile)
+    mask, score = np.asarray(mask), np.asarray(score)
+
+    for i, pod in enumerate(pods):
+        for nd in nodes:
+            j = host.row_of(nd.name)
+            req = requested.get(nd.name, (0, 0, 0))
+            want_mask = oracle_feasible(nd, pod, req)
+            assert mask[i, j] == want_mask, (
+                f"seed {seed}: mask mismatch pod {pod.name} node {nd.name}: "
+                f"device={mask[i, j]} oracle={want_mask}"
+            )
+            want_score = oracle_score(nd, pod, req, taint_slots=SPEC.taint_slots)
+            assert score[i, j] == want_score, (
+                f"seed {seed}: score mismatch pod {pod.name} node {nd.name}: "
+                f"device={score[i, j]} oracle={want_score}"
+            )

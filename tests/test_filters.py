@@ -1,0 +1,179 @@
+"""Filter kernel semantics, pinned against upstream plugin behavior.
+
+Each test builds a tiny cluster, encodes pods, and checks the [B, N] mask
+row by row — the same style as the reference's schedulerset topology tests
+(reference dist-scheduler/pkg/schedulerset/schedulerset_test.go), but for
+filter semantics the reference never unit-tested (it trusted upstream).
+"""
+
+import numpy as np
+
+from k8s1m_tpu.config import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    PodSpec,
+    SEL_OP_DOES_NOT_EXIST,
+    SEL_OP_EXISTS,
+    SEL_OP_GT,
+    SEL_OP_IN,
+    SEL_OP_LT,
+    SEL_OP_NOT_IN,
+    TOL_OP_EQUAL,
+    TOL_OP_EXISTS,
+    TableSpec,
+)
+from k8s1m_tpu.plugins.filters import feasible_mask
+from k8s1m_tpu.snapshot import (
+    NodeInfo,
+    NodeSelectorTerm,
+    NodeTableHost,
+    PodBatchHost,
+    PodInfo,
+    SelectorRequirement,
+    Taint,
+    Toleration,
+)
+
+SPEC = TableSpec(max_nodes=16, max_zones=8, max_regions=4, max_taint_ids=32)
+PSPEC = PodSpec(batch=8)
+
+
+def build(nodes):
+    host = NodeTableHost(SPEC)
+    for n in nodes:
+        host.upsert(n)
+    return host
+
+
+def mask_of(host, pods):
+    enc = PodBatchHost(PSPEC, SPEC, host.vocab)
+    batch = enc.encode(pods)
+    table = host.to_device()
+    m = np.asarray(feasible_mask(table, batch))
+    return m[: len(pods), : host.num_nodes]
+
+
+def test_resources_fit():
+    host = build([
+        NodeInfo(name="big", cpu_milli=4000, mem_kib=8 << 20, pods=10),
+        NodeInfo(name="small", cpu_milli=500, mem_kib=1 << 20, pods=10),
+        NodeInfo(name="full", cpu_milli=4000, mem_kib=8 << 20, pods=0),
+    ])
+    host.add_pod("small", 400, 1 << 19)  # small now has 100m / 512MiB free
+    m = mask_of(host, [
+        PodInfo(name="tiny", cpu_milli=50, mem_kib=1 << 18),
+        PodInfo(name="mid", cpu_milli=300, mem_kib=1 << 19),
+    ])
+    assert m.tolist() == [
+        [True, True, False],   # tiny fits big+small; full has 0 pod slots
+        [True, False, False],  # mid: small lacks cpu after the bound pod
+    ]
+
+
+def test_node_name():
+    host = build([NodeInfo(name="a"), NodeInfo(name="b")])
+    m = mask_of(host, [
+        PodInfo(name="p", node_name="b"),
+        PodInfo(name="q"),
+        PodInfo(name="r", node_name="ghost"),
+    ])
+    assert m.tolist() == [[False, True], [True, True], [False, False]]
+
+
+def test_taints_and_tolerations():
+    host = build([
+        NodeInfo(name="plain"),
+        NodeInfo(name="gpu", taints=[Taint("gpu", "a100", EFFECT_NO_SCHEDULE)]),
+        NodeInfo(name="evict", taints=[Taint("x", "", EFFECT_NO_EXECUTE)]),
+        NodeInfo(name="soft", taints=[Taint("y", "", EFFECT_PREFER_NO_SCHEDULE)]),
+        NodeInfo(name="cordoned", unschedulable=True),
+    ])
+    pods = [
+        PodInfo(name="bare"),
+        PodInfo(name="tol-eq", tolerations=[
+            Toleration("gpu", TOL_OP_EQUAL, "a100", EFFECT_NO_SCHEDULE)
+        ]),
+        PodInfo(name="tol-wrongval", tolerations=[
+            Toleration("gpu", TOL_OP_EQUAL, "h100", EFFECT_NO_SCHEDULE)
+        ]),
+        PodInfo(name="tol-exists-any-effect", tolerations=[
+            Toleration("gpu", TOL_OP_EXISTS), Toleration("x", TOL_OP_EXISTS),
+        ]),
+        PodInfo(name="tol-all", tolerations=[Toleration("", TOL_OP_EXISTS)]),
+    ]
+    m = mask_of(host, pods)
+    assert m.tolist() == [
+        # plain  gpu    evict  soft  cordoned
+        [True, False, False, True, False],   # bare: soft taint doesn't filter
+        [True, True, False, True, False],
+        [True, False, False, True, False],   # value mismatch
+        [True, True, True, True, False],     # empty-effect toleration matches all
+        [True, True, True, True, True],      # empty-key Exists tolerates everything
+    ]
+
+
+def test_node_selector_and_affinity():
+    host = build([
+        NodeInfo(name="web-1", labels={"tier": "web", "rank": "1"}),
+        NodeInfo(name="web-9", labels={"tier": "web", "rank": "9"}),
+        NodeInfo(name="db-5", labels={"tier": "db", "rank": "5"}),
+        NodeInfo(name="bare-0"),
+    ])
+    pods = [
+        PodInfo(name="sel", node_selector={"tier": "web"}),
+        PodInfo(name="in", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("tier", SEL_OP_IN, ["db", "cache"])])
+        ]),
+        PodInfo(name="notin", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("tier", SEL_OP_NOT_IN, ["web"])])
+        ]),
+        PodInfo(name="exists", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("rank", SEL_OP_EXISTS, [])])
+        ]),
+        PodInfo(name="noexist", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("tier", SEL_OP_DOES_NOT_EXIST, [])])
+        ]),
+        PodInfo(name="gt", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("rank", SEL_OP_GT, ["4"])])
+        ]),
+        PodInfo(name="and", required_terms=[
+            NodeSelectorTerm([
+                SelectorRequirement("tier", SEL_OP_IN, ["web"]),
+                SelectorRequirement("rank", SEL_OP_LT, ["5"]),
+            ])
+        ]),
+        PodInfo(name="or", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("tier", SEL_OP_IN, ["db"])]),
+            NodeSelectorTerm([SelectorRequirement("rank", SEL_OP_IN, ["1"])]),
+        ]),
+    ]
+    m = mask_of(host, pods)
+    assert m.tolist() == [
+        # web-1  web-9  db-5   bare-0
+        [True, True, False, False],    # nodeSelector tier=web
+        [False, False, True, False],   # In {db, cache}
+        [False, False, True, True],    # NotIn web: absent label matches
+        [True, True, True, False],     # Exists rank
+        [False, False, False, True],   # DoesNotExist tier
+        [False, True, True, False],    # rank > 4
+        [True, False, False, False],   # tier in web AND rank < 5
+        [True, False, True, False],    # OR of two terms
+    ]
+
+
+def test_unseen_selector_value_matches_nothing():
+    host = build([NodeInfo(name="a", labels={"tier": "web"})])
+    m = mask_of(host, [PodInfo(name="p", node_selector={"tier": "never-seen"})])
+    assert m.tolist() == [[False]]
+
+
+def test_removed_node_excluded():
+    host = build([NodeInfo(name="a"), NodeInfo(name="b")])
+    host.remove("a")
+    enc = PodBatchHost(PSPEC, SPEC, host.vocab)
+    batch = enc.encode([PodInfo(name="p")])
+    m = np.asarray(feasible_mask(host.to_device(), batch))
+    row_b = host.row_of("b")
+    assert m[0, row_b]
+    assert m[0].sum() == 1

@@ -1,0 +1,121 @@
+"""Score kernel semantics (documented static-bound normalization)."""
+
+import numpy as np
+
+from k8s1m_tpu.config import (
+    EFFECT_PREFER_NO_SCHEDULE,
+    PodSpec,
+    SEL_OP_IN,
+    TOL_OP_EXISTS,
+    TableSpec,
+)
+from k8s1m_tpu.ops.label_match import resolve_query_keys
+from k8s1m_tpu.plugins import scores
+from k8s1m_tpu.plugins.registry import Profile, score_and_filter
+from k8s1m_tpu.snapshot import (
+    NodeInfo,
+    NodeSelectorTerm,
+    NodeTableHost,
+    PodBatchHost,
+    PodInfo,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
+    Taint,
+    Toleration,
+)
+
+SPEC = TableSpec(max_nodes=16, max_zones=8, max_regions=4, max_taint_ids=32)
+PSPEC = PodSpec(batch=4)
+
+
+def setup(nodes, pods):
+    host = NodeTableHost(SPEC)
+    for n in nodes:
+        host.upsert(n)
+    enc = PodBatchHost(PSPEC, SPEC, host.vocab)
+    batch = enc.encode(pods)
+    return host, host.to_device(), batch
+
+
+def test_least_allocated_prefers_empty():
+    host, table, batch = setup(
+        [NodeInfo(name="empty", cpu_milli=1000, mem_kib=1000),
+         NodeInfo(name="half", cpu_milli=1000, mem_kib=1000)],
+        [PodInfo(name="p", cpu_milli=100, mem_kib=100)],
+    )
+    host.add_pod("half", 500, 500)
+    table = host.to_device()
+    s = np.asarray(scores.least_allocated(table, batch))[0, :2]
+    # empty: free after pod = 900/1000 each -> 90.  half: 400/1000 -> 40.
+    np.testing.assert_allclose(s, [90.0, 40.0], atol=1e-4)
+
+
+def test_balanced_allocation():
+    host, table, batch = setup(
+        [NodeInfo(name="bal", cpu_milli=1000, mem_kib=1000),
+         NodeInfo(name="skew", cpu_milli=1000, mem_kib=1000)],
+        [PodInfo(name="p", cpu_milli=200, mem_kib=200)],
+    )
+    host.add_pod("skew", 600, 0)
+    table = host.to_device()
+    s = np.asarray(scores.balanced_allocation(table, batch))[0, :2]
+    # bal: fractions (0.2, 0.2) -> std 0 -> 100.
+    # skew: fractions (0.8, 0.2) -> std 0.3 -> 70.
+    np.testing.assert_allclose(s, [100.0, 70.0], atol=1e-4)
+
+
+def test_taint_toleration_score():
+    ts = SPEC.taint_slots
+    host, table, batch = setup(
+        [NodeInfo(name="clean"),
+         NodeInfo(name="soft1", taints=[Taint("a", "", EFFECT_PREFER_NO_SCHEDULE)]),
+         NodeInfo(name="soft2", taints=[
+             Taint("a", "", EFFECT_PREFER_NO_SCHEDULE),
+             Taint("b", "", EFFECT_PREFER_NO_SCHEDULE)])],
+        [PodInfo(name="bare"),
+         PodInfo(name="tol-a", tolerations=[
+             Toleration("a", TOL_OP_EXISTS, "", EFFECT_PREFER_NO_SCHEDULE)])],
+    )
+    s = np.asarray(scores.taint_toleration(table, batch))[:2, :3]
+    per = 100.0 / ts
+    np.testing.assert_allclose(s[0], [100.0, 100.0 - per, 100.0 - 2 * per], atol=1e-4)
+    np.testing.assert_allclose(s[1], [100.0, 100.0, 100.0 - per], atol=1e-4)
+
+
+def test_node_affinity_preferred():
+    host, table, batch = setup(
+        [NodeInfo(name="web", labels={"tier": "web"}),
+         NodeInfo(name="db", labels={"tier": "db"}),
+         NodeInfo(name="both", labels={"tier": "web", "ssd": "yes"})],
+        [PodInfo(name="p", preferred_terms=[
+            PreferredSchedulingTerm(3, NodeSelectorTerm(
+                [SelectorRequirement("tier", SEL_OP_IN, ["web"])])),
+            PreferredSchedulingTerm(1, NodeSelectorTerm(
+                [SelectorRequirement("ssd", SEL_OP_IN, ["yes"])])),
+        ])],
+    )
+    resolved = resolve_query_keys(table.label_key, table.label_val, table.label_num, batch.qkey)
+    s = np.asarray(scores.node_affinity_score(table, batch, resolved))[0, :3]
+    # weights: web=3/4, db=0, both=4/4 (normalized by total pref weight 4)
+    np.testing.assert_allclose(s, [75.0, 0.0, 100.0], atol=1e-4)
+
+
+def test_score_and_filter_combination():
+    host, table, batch = setup(
+        [NodeInfo(name="a", cpu_milli=1000, mem_kib=1000),
+         NodeInfo(name="b", cpu_milli=1000, mem_kib=1000)],
+        [PodInfo(name="p", cpu_milli=100, mem_kib=100)],
+    )
+    profile = Profile(topology_spread=0, interpod_affinity=0)
+    mask, score = score_and_filter(table, batch, profile)
+    mask, score = np.asarray(mask), np.asarray(score)
+    assert mask[0, :2].all()
+    assert not mask[0, 2:].any()          # padding rows infeasible
+    assert not mask[1:].any()             # padding pods infeasible
+    # identical nodes -> identical combined score
+    assert score[0, 0] == score[0, 1]
+    # lone plugin check: least_allocated at weight 1 only
+    only_la = Profile(balanced_allocation=0, taint_toleration=0,
+                      node_affinity=0, topology_spread=0, interpod_affinity=0)
+    _, s2 = score_and_filter(table, batch, only_la)
+    np.testing.assert_allclose(np.asarray(s2)[0, 0], 90.0, atol=1e-4)

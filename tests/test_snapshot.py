@@ -77,7 +77,7 @@ def test_node_table_build_and_rows():
     assert len(set(zones.tolist())) == 3
     # unschedulable node got the synthetic taint
     row = host.row_of("node-8")
-    tk = np.asarray(t.taint_key)[row]
+    tk = np.asarray(t.taint_id)[row]
     assert (tk != NONE_ID).sum() == 1
     # numeric label parsed
     row0 = host.row_of("node-7")
@@ -132,7 +132,7 @@ def test_commit_binds():
 
 def test_pod_encoding():
     host = make_host()
-    enc = PodBatchHost(PodSpec(batch=8), host.vocab)
+    enc = PodBatchHost(PodSpec(batch=8), SPEC, host.vocab)
     pods = [
         PodInfo(
             name="p0",
@@ -152,14 +152,18 @@ def test_pod_encoding():
     valid = np.asarray(batch.valid)
     assert valid[:3].all() and not valid[3:].any()
     assert int(batch.cpu[0]) == 250
-    # nodeSelector encoded with interned ids
+    # nodeSelector encoded via the query-key table
     assert np.asarray(batch.sel_valid)[0].sum() == 1
-    assert int(batch.sel_key[0, 0]) == host.vocab.label_keys.lookup("tier")
+    qi = int(batch.sel_qidx[0, 0])
+    assert int(batch.qkey[qi]) == host.vocab.label_keys.lookup("tier")
     # unseen selector value encodes to NONE (can never match)
     assert int(batch.sel_val[2, 0]) == NONE_ID
-    assert int(batch.sel_key[2, 0]) != NONE_ID
+    assert int(batch.qkey[int(batch.sel_qidx[2, 0])]) != NONE_ID
     # Gt requirement carries the parsed number
     assert int(batch.req_num[0, 0, 0]) == 3
     # nodeName interned
     assert int(batch.node_name_id[1]) == host.vocab.node_names.lookup("node-5")
     assert int(batch.node_name_id[0]) == NONE_ID
+    # unknown nodeName must match nothing, not "unset"
+    ghost = enc.encode([PodInfo(name="g", node_name="no-such-node")])
+    assert int(ghost.node_name_id[0]) == -1

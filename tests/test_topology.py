@@ -1,0 +1,157 @@
+"""PodTopologySpread and InterPodAffinity end-to-end semantics.
+
+Constraint counts commit at batch boundaries (like the reference's
+optimistic concurrency, constraint state is exact between cycles), so
+these tests schedule one pod per batch where cross-pod constraints are
+under test.
+"""
+
+import jax
+import numpy as np
+
+from k8s1m_tpu.config import (
+    PodSpec,
+    SPREAD_DO_NOT_SCHEDULE,
+    SPREAD_SCHEDULE_ANYWAY,
+    TOPO_HOSTNAME,
+    TOPO_ZONE,
+    TableSpec,
+)
+from k8s1m_tpu.cluster.kwok import populate_kwok_nodes
+from k8s1m_tpu.cluster.workload import affinity_deployment, spread_deployment
+from k8s1m_tpu.engine import schedule_batch
+from k8s1m_tpu.parallel import make_mesh, make_sharded_step
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot import NodeTableHost, PodBatchHost
+from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
+
+SPEC = TableSpec(max_nodes=32, max_zones=8, max_regions=4,
+                 spread_slots=4, affinity_slots=4)
+PROFILE = Profile()
+
+
+def setup(num_nodes=16, zones=4):
+    host = NodeTableHost(SPEC)
+    populate_kwok_nodes(host, num_nodes, zones=zones, regions=2)
+    tracker = ConstraintTracker(SPEC)
+    enc = PodBatchHost(PodSpec(batch=8), SPEC, host.vocab)
+    return host, tracker, enc
+
+
+def run_one_by_one(host, enc, pods, cons, chunk=16):
+    """Schedule pods one per batch, returning rows + final states."""
+    table = host.to_device()
+    rows = []
+    for i, pod in enumerate(pods):
+        batch = enc.encode([pod])
+        table, cons, asg = schedule_batch(
+            table, batch, jax.random.key(i), profile=PROFILE, constraints=cons, chunk=chunk
+        )
+        rows.append(int(asg.node_row[0]))
+    return rows, table, cons
+
+
+def test_zone_spread_do_not_schedule_balances():
+    host, tracker, enc = setup(num_nodes=16, zones=4)
+    pods = spread_deployment(tracker, "web", 8, topo=TOPO_ZONE, max_skew=1)
+    rows, table, cons = run_one_by_one(host, enc, pods, empty_constraints(SPEC))
+    assert all(r >= 0 for r in rows)
+    zones = np.asarray(host.zone)[rows]
+    _, counts = np.unique(zones, return_counts=True)
+    # 8 pods over 4 zones with maxSkew 1 -> exactly 2 per zone.
+    assert counts.tolist() == [2, 2, 2, 2]
+    # device-side counts agree
+    dev_counts = np.asarray(cons.spread_zone)[0]
+    assert dev_counts.sum() == 8 and dev_counts.max() == 2
+
+
+def test_hostname_spread_one_per_node_until_skew():
+    host, tracker, enc = setup(num_nodes=8, zones=2)
+    pods = spread_deployment(tracker, "db", 8, topo=TOPO_HOSTNAME, max_skew=1)
+    rows, _, cons = run_one_by_one(host, enc, pods, empty_constraints(SPEC))
+    # 8 pods, 8 nodes, maxSkew 1 -> all distinct nodes.
+    assert len(set(rows)) == 8
+    assert np.asarray(cons.spread_node)[0].max() == 1
+
+
+def test_schedule_anyway_scores_but_never_blocks():
+    host, tracker, enc = setup(num_nodes=4, zones=4)
+    # 12 pods on 4 zones (one node each), soft constraint: must all bind.
+    pods = spread_deployment(tracker, "soft", 12, topo=TOPO_ZONE,
+                             max_skew=1, mode=SPREAD_SCHEDULE_ANYWAY)
+    rows, _, cons = run_one_by_one(host, enc, pods, empty_constraints(SPEC))
+    assert all(r >= 0 for r in rows)
+    dev_counts = np.asarray(cons.spread_zone)[0]
+    # soft spreading still balances: 3 per zone
+    assert dev_counts.max() == 3
+
+
+def test_required_affinity_bootstrap_then_colocate():
+    host, tracker, enc = setup(num_nodes=12, zones=3)
+    pods = affinity_deployment(tracker, "pair", 4, topo=TOPO_ZONE,
+                               required=True, anti=False)
+    rows, _, cons = run_one_by_one(host, enc, pods, empty_constraints(SPEC))
+    assert all(r >= 0 for r in rows)  # bootstrap admits the first replica
+    zones = np.asarray(host.zone)[rows]
+    assert len(set(zones.tolist())) == 1  # rest co-locate in its zone
+
+
+def test_required_anti_affinity_one_per_node():
+    host, tracker, enc = setup(num_nodes=6, zones=2)
+    pods = affinity_deployment(tracker, "solo", 6, topo=TOPO_HOSTNAME,
+                               required=True, anti=True)
+    rows, _, _ = run_one_by_one(host, enc, pods, empty_constraints(SPEC))
+    assert all(r >= 0 for r in rows)
+    assert len(set(rows)) == 6  # pairwise distinct nodes
+
+
+def test_required_anti_affinity_exhausts():
+    host, tracker, enc = setup(num_nodes=3, zones=1)
+    pods = affinity_deployment(tracker, "solo", 5, topo=TOPO_HOSTNAME,
+                               required=True, anti=True)
+    rows, _, _ = run_one_by_one(host, enc, pods, empty_constraints(SPEC))
+    assert sorted(r >= 0 for r in rows) == [False, False, True, True, True]
+
+
+def test_symmetric_anti_affinity_blocks_incoming():
+    host, tracker, enc = setup(num_nodes=4, zones=2)
+    # "guard" pods carry required anti-affinity against app=web, one lands
+    # per node (self labels don't match, so no self-conflict).
+    guards = affinity_deployment(tracker, "guard", 2, target={"app": "web"},
+                                 topo=TOPO_HOSTNAME, required=True, anti=True)
+    # web pods carry no affinity of their own, but match the guards' term.
+    webs = spread_deployment(tracker, "web", 4, topo=TOPO_ZONE, max_skew=8,
+                             mode=SPREAD_SCHEDULE_ANYWAY)
+    rows, _, _ = run_one_by_one(host, enc, guards + webs, empty_constraints(SPEC))
+    guard_rows, web_rows = set(rows[:2]), set(rows[2:])
+    assert all(r >= 0 for r in rows)
+    assert not (guard_rows & web_rows)  # symmetry keeps web off guard nodes
+
+
+def test_preferred_affinity_scores_colocation():
+    host, tracker, enc = setup(num_nodes=8, zones=4)
+    pods = affinity_deployment(tracker, "herd", 5, topo=TOPO_ZONE,
+                               required=False, anti=False, weight=100)
+    rows, _, _ = run_one_by_one(host, enc, pods, empty_constraints(SPEC))
+    zones = np.asarray(host.zone)[rows]
+    # Preference (not requirement): the big preferred weight should pull
+    # every follower into the first pod's zone.
+    assert len(set(zones.tolist())) == 1
+
+
+def test_sharded_constraints_match_single_device():
+    host, tracker, enc_ = setup(num_nodes=16, zones=4)
+    enc = PodBatchHost(PodSpec(batch=8), SPEC, host.vocab)
+    pods = spread_deployment(tracker, "web", 8, topo=TOPO_ZONE, max_skew=2)
+    table = host.to_device()
+    cons = empty_constraints(SPEC)
+
+    mesh = make_mesh(dp=2, sp=4)
+    step = make_sharded_step(mesh, PROFILE, chunk=4, k=4, with_constraints=True)
+    batch = enc.encode(pods)
+    t2, cons2, asg = step(table, batch, jax.random.key(0), cons)
+    assert int(np.asarray(asg.bound).sum()) == 8
+    # counts landed: 8 total zone increments
+    assert int(np.asarray(cons2.spread_zone).sum()) == 8
+    # node-table accounting matches bind count
+    assert int(np.asarray(t2.pods_req).sum()) == 8

@@ -67,6 +67,46 @@ class Assignment:
     region: jax.Array    # i32[B]
 
 
+@struct.dataclass
+class CommitFields:
+    """The slice of a PodBatch the post-candidate epilogue needs.
+
+    In the sharded cycle only these leaves cross the dp all-gather — the
+    selector tensors (req_vals, tolerated, ...) never leave their home
+    device, keeping the hop at O(B*K) candidate records as the module doc
+    promises."""
+
+    cpu: jax.Array           # i32[B]
+    mem: jax.Array           # i32[B]
+    valid: jax.Array         # bool[B]
+    sinc_valid: jax.Array    # spread-constraint commit increments
+    sinc_cid: jax.Array
+    sinc_topo: jax.Array
+    iinc_valid: jax.Array    # affinity-term commit increments
+    iinc_tid: jax.Array
+    iinc_topo: jax.Array
+    ipa_own_valid: jax.Array  # pod's own required anti-affinity terms
+    ipa_tid: jax.Array
+    ipa_topo: jax.Array
+
+
+def commit_fields_of(batch: PodBatch) -> CommitFields:
+    return CommitFields(
+        cpu=batch.cpu,
+        mem=batch.mem,
+        valid=batch.valid,
+        sinc_valid=batch.sinc_valid,
+        sinc_cid=batch.sinc_cid,
+        sinc_topo=batch.sinc_topo,
+        iinc_valid=batch.iinc_valid,
+        iinc_tid=batch.iinc_tid,
+        iinc_topo=batch.iinc_topo,
+        ipa_own_valid=batch.ipa_valid & batch.ipa_required & batch.ipa_anti,
+        ipa_tid=batch.ipa_tid,
+        ipa_topo=batch.ipa_topo,
+    )
+
+
 def _slice_table(table: NodeTable, start, chunk: int) -> NodeTable:
     return jax.tree.map(
         lambda a: lax.dynamic_slice_in_dim(a, start, chunk, axis=0), table
@@ -115,6 +155,13 @@ def filter_score_topk(
         raise ValueError(f"table rows {n} not divisible by chunk {chunk}")
     num_chunks = n // chunk
     b = batch.batch
+    if constraints is not None and stats is None:
+        # Single-device convenience: build the batch prologue here.  Under
+        # shard_map callers MUST pass stats from topology.prologue(...,
+        # axis_name=...) — the auto-built one would be shard-local.
+        from k8s1m_tpu.plugins import topology
+
+        stats = topology.prologue(table, constraints)
 
     def body(carry, _):
         carry, ci = carry
@@ -154,20 +201,59 @@ def filter_score_topk(
 
 def commit_constraints_for_batch(
     constraints: ConstraintState,
-    batch: PodBatch,
+    fields: CommitFields,
     asg: "Assignment",
     node_row,       # i32[B] rows to scatter node-domain counts into
     bound_node,     # bool[B] gate for node-domain tables (shard-local mask)
     bound_domain,   # bool[B] gate for zone/region tables (global mask)
 ) -> ConstraintState:
-    own_valid = batch.ipa_valid & batch.ipa_required & batch.ipa_anti
     return commit_constraint_binds(
         constraints,
         bound_node, bound_domain, node_row, asg.zone, asg.region,
-        batch.sinc_valid, batch.sinc_cid, batch.sinc_topo,
-        batch.iinc_valid, batch.iinc_tid, batch.iinc_topo,
-        own_valid, batch.ipa_tid, batch.ipa_topo,
+        fields.sinc_valid, fields.sinc_cid, fields.sinc_topo,
+        fields.iinc_valid, fields.iinc_tid, fields.iinc_topo,
+        fields.ipa_own_valid, fields.ipa_tid, fields.ipa_topo,
     )
+
+
+def finalize_batch(
+    table: NodeTable,
+    constraints: ConstraintState | None,
+    cand: Candidates,
+    fields: CommitFields,
+    *,
+    row_offset: int | jax.Array = 0,
+    rows: int | None = None,
+):
+    """Shared epilogue: greedy conflict resolution + capacity/constraint
+    commit.  ``rows=None`` means the whole table is local (single device);
+    otherwise only binds landing in [row_offset, row_offset+rows) update
+    this shard's node-row tables, while zone/region count tables (replicated
+    in the sharded cycle) take the full global update.
+
+    Returns (table, constraints, Assignment)."""
+    node_row, bound, score, chosen_k = greedy_assign(
+        cand.idx, cand.prio, cand.cpu, cand.mem, cand.pods,
+        fields.cpu, fields.mem, fields.valid,
+    )
+    take1 = lambda x: jnp.take_along_axis(x, chosen_k[:, None], axis=1)[:, 0]
+    asg = Assignment(
+        node_row=node_row, bound=bound, score=score,
+        zone=jnp.where(bound, take1(cand.zone), 0),
+        region=jnp.where(bound, take1(cand.region), 0),
+    )
+    if rows is None:
+        local = bound
+        local_row = jnp.where(bound, node_row, 0)
+    else:
+        local = bound & (node_row >= row_offset) & (node_row < row_offset + rows)
+        local_row = jnp.where(local, node_row - row_offset, 0)
+    table = commit_binds(table, local_row, fields.cpu, fields.mem, local)
+    if constraints is not None:
+        constraints = commit_constraints_for_batch(
+            constraints, fields, asg, local_row, local, bound
+        )
+    return table, constraints, asg
 
 
 def _schedule_batch_impl(
@@ -179,32 +265,11 @@ def _schedule_batch_impl(
     chunk: int,
     k: int,
 ):
-    from k8s1m_tpu.plugins import topology
-
-    stats = (
-        topology.prologue(table, constraints) if constraints is not None else None
-    )
     cand = filter_score_topk(
         table, batch, key, profile,
-        chunk=chunk, k=k, constraints=constraints, stats=stats,
+        chunk=chunk, k=k, constraints=constraints,
     )
-    node_row, bound, score, chosen_k = greedy_assign(
-        cand.idx, cand.prio, cand.cpu, cand.mem, cand.pods,
-        batch.cpu, batch.mem, batch.valid,
-    )
-    take1 = lambda x: jnp.take_along_axis(x, chosen_k[:, None], axis=1)[:, 0]
-    asg = Assignment(
-        node_row=node_row, bound=bound, score=score,
-        zone=jnp.where(bound, take1(cand.zone), 0),
-        region=jnp.where(bound, take1(cand.region), 0),
-    )
-    safe_row = jnp.where(bound, node_row, 0)
-    table = commit_binds(table, safe_row, batch.cpu, batch.mem, bound)
-    if constraints is not None:
-        constraints = commit_constraints_for_batch(
-            constraints, batch, asg, safe_row, bound, bound
-        )
-    return table, constraints, asg
+    return finalize_batch(table, constraints, cand, commit_fields_of(batch))
 
 
 @functools.lru_cache(maxsize=64)

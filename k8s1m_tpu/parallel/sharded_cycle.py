@@ -28,21 +28,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from k8s1m_tpu.engine.assign import greedy_assign
 from k8s1m_tpu.engine.cycle import (
     Assignment,
-    commit_constraints_for_batch,
+    commit_fields_of,
     filter_score_topk,
+    finalize_batch,
 )
 from k8s1m_tpu.parallel.mesh import batch_specs, constraint_specs, table_specs
 from k8s1m_tpu.plugins.registry import Profile
 from k8s1m_tpu.snapshot.constraints import ConstraintState
-from k8s1m_tpu.snapshot.node_table import NodeTable, commit_binds
+from k8s1m_tpu.snapshot.node_table import NodeTable
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
 
 
-def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int,
-                      with_constraints: bool = False):
+def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int):
     """Build the jitted multi-device scheduling step for a fixed mesh.
 
     Returns step(table, batch, key[, constraints]):
@@ -83,36 +82,24 @@ def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int,
             lambda x: jnp.take_along_axis(x, sel, axis=-1), cand
         ).replace(prio=top_prio)
 
-        # 3. gather the full batch across dp (pods stay in batch order:
-        # dp shards are contiguous blocks).
+        # 3. gather the epilogue's slice of the batch across dp (pods stay
+        # in batch order: dp shards are contiguous blocks).  Only
+        # CommitFields crosses this hop — the selector tensors never leave
+        # their home device.
         def gather_dp(x):
             g = lax.all_gather(x, "dp")
             return g.reshape(-1, *x.shape[1:])
 
         cand = jax.tree.map(gather_dp, cand)
-        batch_all = jax.tree.map(gather_dp, batch).replace(qkey=batch.qkey)
+        fields = jax.tree.map(gather_dp, commit_fields_of(batch))
 
-        # 4. replicated greedy conflict resolution over the full batch.
-        node_row, bound, score, chosen_k = greedy_assign(
-            cand.idx, cand.prio, cand.cpu, cand.mem, cand.pods,
-            batch_all.cpu, batch_all.mem, batch_all.valid,
+        # 4+5. replicated greedy conflict resolution (identical inputs ->
+        # identical result on every device), then commit the binds that
+        # landed in this shard's row range; zone/region count tables are
+        # replicated and take the full (identical) update everywhere.
+        return finalize_batch(
+            table, constraints, cand, fields, row_offset=row_offset, rows=rows
         )
-        take1 = lambda x: jnp.take_along_axis(x, chosen_k[:, None], axis=1)[:, 0]
-        asg = Assignment(
-            node_row=node_row, bound=bound, score=score,
-            zone=jnp.where(bound, take1(cand.zone), 0),
-            region=jnp.where(bound, take1(cand.region), 0),
-        )
-
-        # 5. commit binds that landed in this shard's row range.
-        local = bound & (node_row >= row_offset) & (node_row < row_offset + rows)
-        local_row = jnp.where(local, node_row - row_offset, 0)
-        table = commit_binds(table, local_row, batch_all.cpu, batch_all.mem, local)
-        if constraints is not None:
-            constraints = commit_constraints_for_batch(
-                constraints, batch_all, asg, local_row, local, bound
-            )
-        return table, constraints, asg
 
     def step(table, batch, key, constraints=None):
         asg_specs = Assignment(P(), P(), P(), P(), P())

@@ -21,14 +21,25 @@ from k8s1m_tpu.snapshot.pod_encoding import PodBatch
 
 @dataclasses.dataclass(frozen=True)
 class Profile:
-    """Score weights (upstream defaults); 0 disables a plugin."""
+    """Score weights (upstream defaults); 0 disables a plugin.
 
-    least_allocated: float = 1.0
-    balanced_allocation: float = 1.0
-    taint_toleration: float = 3.0
-    node_affinity: float = 2.0
-    topology_spread: float = 2.0
-    interpod_affinity: float = 2.0
+    Weights are integers like upstream's plugin weights — fractional
+    values would silently truncate in the int32 score accumulation."""
+
+    least_allocated: int = 1
+    balanced_allocation: int = 1
+    taint_toleration: int = 3
+    node_affinity: int = 2
+    topology_spread: int = 2
+    interpod_affinity: int = 2
+
+    def __post_init__(self):
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if not isinstance(v, int) or v < 0:
+                raise ValueError(
+                    f"Profile.{f.name} must be a non-negative int, got {v!r}"
+                )
 
 
 def default_profile() -> Profile:

@@ -1,0 +1,31 @@
+"""Native control-plane store — the mem_etcd equivalent.
+
+- ``native``      — ctypes bindings over the C++ core (native/memstore/).
+- ``etcd_server`` — etcd v3 gRPC wire layer (KV/Watch/Lease/Maintenance),
+                    the same API subset the reference serves
+                    (reference mem_etcd/src/main.rs:106-153).
+"""
+
+from k8s1m_tpu.store.native import (
+    INFINITY,
+    CompactedError,
+    FutureRevError,
+    KeyValue,
+    MemStore,
+    RangeResult,
+    WatchEvent,
+    Watcher,
+    prefix_end,
+)
+
+__all__ = [
+    "INFINITY",
+    "CompactedError",
+    "FutureRevError",
+    "KeyValue",
+    "MemStore",
+    "RangeResult",
+    "WatchEvent",
+    "Watcher",
+    "prefix_end",
+]

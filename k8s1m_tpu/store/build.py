@@ -1,0 +1,50 @@
+"""Build the native memstore shared library (g++; no pip deps).
+
+The reference ships mem_etcd as a Rust crate built by cargo
+(reference mem_etcd/Cargo.toml); here the native store is C++17 compiled
+on demand into the package directory.  Import-time auto-build keeps the
+test suite and the driver self-contained.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)), "native", "memstore")
+LIB_PATH = os.path.join(_PKG_DIR, "libmemstore.so")
+
+_lock = threading.Lock()
+
+
+def _stale() -> bool:
+    if not os.path.exists(LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(LIB_PATH)
+    for name in os.listdir(_SRC_DIR):
+        if name.endswith((".cc", ".h")):
+            if os.path.getmtime(os.path.join(_SRC_DIR, name)) > lib_mtime:
+                return True
+    return False
+
+
+def ensure_built(force: bool = False) -> str:
+    """Compile libmemstore.so if missing or out of date; returns its path."""
+    with _lock:
+        if not force and not _stale():
+            return LIB_PATH
+        tmp = LIB_PATH + ".tmp"
+        cmd = [
+            "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+            "-Wall", "-o", tmp,
+            os.path.join(_SRC_DIR, "memstore.cc"),
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, LIB_PATH)
+        return LIB_PATH
+
+
+if __name__ == "__main__":
+    print(ensure_built(force=True))

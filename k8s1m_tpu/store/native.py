@@ -1,0 +1,395 @@
+"""ctypes bindings for the native memstore (the mem_etcd equivalent).
+
+One MemStore == one in-process store instance; the etcd gRPC wire layer
+(k8s1m_tpu/store/etcd_server.py) serves it over the network with the same
+API subset the reference implements (reference mem_etcd/src/kv_service.rs,
+watch_service.rs).  Binary result layouts are defined in
+native/memstore/memstore.h.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import struct
+
+from k8s1m_tpu.store.build import ensure_built
+
+WAL_NONE = 0
+WAL_BUFFERED = 1
+WAL_FSYNC = 2
+_WAL_MODES = {"none": WAL_NONE, "buffered": WAL_BUFFERED, "fsync": WAL_FSYNC}
+
+_ERR_CAS = -1
+_ERR_COMPACTED = -2
+_ERR_FUTURE_REV = -3
+_ERR_NOT_FOUND = -4
+
+# etcd convention: range end of a single zero byte means "to infinity".
+INFINITY = b"\x00"
+
+
+class CompactedError(Exception):
+    def __init__(self, compact_revision: int = 0):
+        super().__init__(f"revision compacted (compact_revision={compact_revision})")
+        self.compact_revision = compact_revision
+
+
+class FutureRevError(Exception):
+    pass
+
+
+def prefix_end(prefix: bytes) -> bytes:
+    """etcd's prefix-range end: prefix with its last byte incremented
+    (the /a/b/c/ -> /a/b/c0 idiom, reference store.rs:536-588)."""
+    p = bytearray(prefix)
+    for i in reversed(range(len(p))):
+        if p[i] < 0xFF:
+            p[i] += 1
+            return bytes(p[: i + 1])
+    return INFINITY
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyValue:
+    key: bytes
+    value: bytes
+    create_revision: int
+    mod_revision: int
+    version: int
+    lease: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RangeResult:
+    revision: int       # store revision at read time
+    count: int          # total matches ignoring limit
+    more: bool
+    kvs: list[KeyValue]
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchEvent:
+    type: str           # "PUT" | "DELETE"
+    kv: KeyValue
+    prev_kv: KeyValue | None = None
+
+
+_KV_FIXED = struct.Struct("<IIqqqq")  # klen, vlen, create, mod, version, lease
+
+
+def _parse_kv(buf: memoryview, off: int) -> tuple[KeyValue, int]:
+    klen, vlen, crev, mrev, ver, lease = _KV_FIXED.unpack_from(buf, off)
+    off += _KV_FIXED.size
+    key = bytes(buf[off : off + klen]); off += klen
+    val = bytes(buf[off : off + vlen]); off += vlen
+    return KeyValue(key, val, crev, mrev, ver, lease), off
+
+
+def _load_lib():
+    lib = ctypes.CDLL(ensure_built())
+    c = ctypes
+    P8 = c.POINTER(c.c_uint8)
+    lib.ms_open.restype = c.c_void_p
+    lib.ms_open.argtypes = [c.c_char_p, c.c_int, c.c_char_p]
+    lib.ms_close.argtypes = [c.c_void_p]
+    lib.ms_free.argtypes = [c.c_void_p]
+    lib.ms_set.restype = c.c_int64
+    lib.ms_set.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_size_t, c.c_char_p, c.c_size_t,
+        c.c_int, c.c_int, c.c_int64, c.c_int64,
+        c.POINTER(c.c_int64), c.POINTER(P8), c.POINTER(c.c_size_t),
+    ]
+    lib.ms_range.restype = c.c_int
+    lib.ms_range.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_size_t, c.c_char_p, c.c_size_t,
+        c.c_int64, c.c_int64, c.c_int, c.c_int,
+        c.POINTER(P8), c.POINTER(c.c_size_t),
+    ]
+    for name in ("ms_current_revision", "ms_compact_revision",
+                 "ms_progress_revision", "ms_num_keys", "ms_db_size"):
+        fn = getattr(lib, name)
+        fn.restype = c.c_int64
+        fn.argtypes = [c.c_void_p]
+    lib.ms_compact.restype = c.c_int
+    lib.ms_compact.argtypes = [c.c_void_p, c.c_int64]
+    lib.ms_watch_create.restype = c.c_int64
+    lib.ms_watch_create.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_size_t, c.c_char_p, c.c_size_t,
+        c.c_int64, c.c_int, c.POINTER(c.c_int64),
+    ]
+    lib.ms_watch_cancel.restype = c.c_int
+    lib.ms_watch_cancel.argtypes = [c.c_void_p, c.c_int64]
+    lib.ms_watch_poll.restype = c.c_int
+    lib.ms_watch_poll.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int, c.c_int,
+        c.POINTER(P8), c.POINTER(c.c_size_t),
+    ]
+    lib.ms_watch_dropped.restype = c.c_int64
+    lib.ms_watch_dropped.argtypes = [c.c_void_p, c.c_int64]
+    lib.ms_stats_json.restype = c.c_int
+    lib.ms_stats_json.argtypes = [c.c_void_p, c.POINTER(P8), c.POINTER(c.c_size_t)]
+    lib.ms_wal_sync.restype = c.c_int
+    lib.ms_wal_sync.argtypes = [c.c_void_p]
+    return lib
+
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        _LIB = _load_lib()
+    return _LIB
+
+
+def _take_buf(lib, pp, plen) -> bytes:
+    if not pp:
+        return b""
+    data = ctypes.string_at(pp, plen.value)
+    lib.ms_free(pp)
+    return data
+
+
+class Watcher:
+    """Handle to one store watcher; poll() returns revision-ordered events."""
+
+    def __init__(self, store: "MemStore", wid: int):
+        self._store = store
+        self.id = wid
+        self.canceled = False
+
+    def poll(self, max_events: int = 1000, timeout_ms: int = 0) -> list[WatchEvent]:
+        lib = _lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        n = lib.ms_watch_poll(
+            self._store._h, self.id, max_events, timeout_ms,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if n == _ERR_NOT_FOUND:
+            self.canceled = True
+            return []
+        data = _take_buf(lib, out, out_len)
+        buf = memoryview(data)
+        (n_events,) = struct.unpack_from("<I", buf, 0)
+        if buf[4]:
+            self.canceled = True
+        off = 5
+        events = []
+        for _ in range(n_events):
+            etype, has_prev = buf[off], buf[off + 1]
+            off += 2
+            kv, off = _parse_kv(buf, off)
+            prev = None
+            if has_prev:
+                prev, off = _parse_kv(buf, off)
+            events.append(
+                WatchEvent("DELETE" if etype else "PUT", kv, prev)
+            )
+        return events
+
+    @property
+    def dropped(self) -> int:
+        return _lib().ms_watch_dropped(self._store._h, self.id)
+
+    def cancel(self) -> None:
+        if not self.canceled:
+            _lib().ms_watch_cancel(self._store._h, self.id)
+            self.canceled = True
+
+
+class MemStore:
+    """In-process native store with etcd semantics.
+
+    wal_dir=None disables the WAL; wal_mode in {none, buffered, fsync}
+    mirrors the reference's --wal-default (reference main.rs:60-81);
+    no_write_prefixes skips the WAL for hot non-durable prefixes like
+    /registry/leases (reference --wal-no-write-prefix).
+    """
+
+    def __init__(
+        self,
+        wal_dir: str | None = None,
+        wal_mode: str = "buffered",
+        no_write_prefixes: tuple[str, ...] = (),
+    ):
+        lib = _lib()
+        nwp = "\n".join(no_write_prefixes).encode()
+        self._h = lib.ms_open(
+            wal_dir.encode() if wal_dir else None, _WAL_MODES[wal_mode], nwp
+        )
+        if not self._h:
+            raise RuntimeError("ms_open failed")
+
+    def close(self) -> None:
+        if self._h:
+            _lib().ms_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- writes --------------------------------------------------------
+
+    def _set(
+        self,
+        key: bytes,
+        value: bytes | None,
+        has_req: bool,
+        req_is_version: bool,
+        req_val: int,
+        lease: int,
+    ):
+        lib = _lib()
+        latest = ctypes.c_int64()
+        cur = ctypes.POINTER(ctypes.c_uint8)()
+        cur_len = ctypes.c_size_t()
+        rev = lib.ms_set(
+            self._h, key, len(key),
+            value, 0 if value is None else len(value),
+            1 if has_req else 0, 1 if req_is_version else 0, req_val, lease,
+            ctypes.byref(latest), ctypes.byref(cur), ctypes.byref(cur_len),
+        )
+        if rev == _ERR_CAS:
+            cur_kv = None
+            if cur:
+                data = _take_buf(lib, cur, cur_len)
+                cur_kv, _ = _parse_kv(memoryview(data), 0)
+            return False, latest.value, cur_kv
+        return True, rev, None
+
+    def put(self, key: bytes, value: bytes, lease: int = 0) -> int:
+        ok, rev, _ = self._set(key, value, False, False, 0, lease)
+        assert ok
+        return rev
+
+    def delete(self, key: bytes) -> tuple[int, bool]:
+        """Returns (revision, deleted). Revision is 0 when nothing existed."""
+        ok, rev, _ = self._set(key, None, False, False, 0, 0)
+        assert ok
+        return rev, rev > 0
+
+    def cas(
+        self,
+        key: bytes,
+        value: bytes | None,
+        *,
+        required_mod: int | None = None,
+        required_version: int | None = None,
+        lease: int = 0,
+    ) -> tuple[bool, int, KeyValue | None]:
+        """Txn-style compare-and-set: exactly the one Txn shape Kubernetes
+        emits (reference kv_service.rs:126-337).  value=None deletes.
+        Returns (ok, revision, current_kv_on_failure)."""
+        if (required_mod is None) == (required_version is None):
+            raise ValueError("exactly one of required_mod/required_version")
+        is_ver = required_version is not None
+        req = required_version if is_ver else required_mod
+        return self._set(key, value, True, is_ver, req, lease)
+
+    # ---- reads ---------------------------------------------------------
+
+    def range(
+        self,
+        start: bytes,
+        end: bytes | None = None,
+        *,
+        revision: int = 0,
+        limit: int = 0,
+        count_only: bool = False,
+        keys_only: bool = False,
+    ) -> RangeResult:
+        lib = _lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = lib.ms_range(
+            self._h, start, len(start),
+            end, 0 if end is None else len(end),
+            revision, limit, 1 if count_only else 0, 1 if keys_only else 0,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if rc == _ERR_COMPACTED:
+            raise CompactedError(self.compact_revision)
+        if rc == _ERR_FUTURE_REV:
+            raise FutureRevError(f"revision {revision} > current")
+        data = _take_buf(lib, out, out_len)
+        buf = memoryview(data)
+        rev, count, n, more = struct.unpack_from("<qqIB", buf, 0)
+        off = 21
+        kvs = []
+        for _ in range(n):
+            kv, off = _parse_kv(buf, off)
+            kvs.append(kv)
+        return RangeResult(rev, count, bool(more), kvs)
+
+    def get(self, key: bytes, revision: int = 0) -> KeyValue | None:
+        res = self.range(key, revision=revision)
+        return res.kvs[0] if res.kvs else None
+
+    # ---- watch ---------------------------------------------------------
+
+    def watch(
+        self,
+        start: bytes,
+        end: bytes | None = None,
+        *,
+        start_revision: int = 0,
+        prev_kv: bool = False,
+    ) -> Watcher:
+        lib = _lib()
+        compact = ctypes.c_int64()
+        wid = lib.ms_watch_create(
+            self._h, start, len(start),
+            end, 0 if end is None else len(end),
+            start_revision, 1 if prev_kv else 0, ctypes.byref(compact),
+        )
+        if wid == _ERR_COMPACTED:
+            raise CompactedError(compact.value)
+        return Watcher(self, wid)
+
+    # ---- maintenance ---------------------------------------------------
+
+    def compact(self, revision: int) -> None:
+        rc = _lib().ms_compact(self._h, revision)
+        if rc == _ERR_COMPACTED:
+            raise CompactedError(self.compact_revision)
+        if rc == _ERR_FUTURE_REV:
+            raise FutureRevError(f"compact {revision} > current")
+
+    def wal_sync(self) -> None:
+        if _lib().ms_wal_sync(self._h) != 0:
+            raise OSError("WAL sync failed")
+
+    def stats(self) -> dict:
+        import json
+
+        lib = _lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        lib.ms_stats_json(self._h, ctypes.byref(out), ctypes.byref(out_len))
+        return json.loads(_take_buf(lib, out, out_len))
+
+    @property
+    def current_revision(self) -> int:
+        return _lib().ms_current_revision(self._h)
+
+    @property
+    def compact_revision(self) -> int:
+        return _lib().ms_compact_revision(self._h)
+
+    @property
+    def progress_revision(self) -> int:
+        return _lib().ms_progress_revision(self._h)
+
+    @property
+    def num_keys(self) -> int:
+        return _lib().ms_num_keys(self._h)
+
+    @property
+    def db_size(self) -> int:
+        return _lib().ms_db_size(self._h)

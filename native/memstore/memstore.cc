@@ -1,0 +1,970 @@
+// memstore implementation — see memstore.h for the design rationale and the
+// mapping onto the reference's mem_etcd (reference mem_etcd/src/*.rs).
+//
+// Deliberate redesigns vs the reference (documented, not accidental):
+//  * One global ordered index instead of per-Kind B-trees: cross-prefix
+//    ranges work (the reference errors on them, store.rs:590-675); the
+//    per-Kind prefix_split survives in the WAL file layout and stats.
+//  * Watch events enqueue inside the write critical section, so revision
+//    order is structural; no notify thread / re-ordering heap
+//    (reference store.rs:444-533 needs both).
+//  * Tombstones are garbage-collected at compaction (the reference leaves
+//    this as a TODO, store.rs:832).
+//  * Values live at the compact revision are preserved in a per-key base
+//    slot so reads at rev >= compact_rev stay correct even for keys whose
+//    last write predates compaction.
+
+#include "memstore.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <chrono>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <shared_mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Bytes = std::shared_ptr<const std::string>;
+
+Bytes make_bytes(const uint8_t* p, size_t n) {
+  return std::make_shared<const std::string>(reinterpret_cast<const char*>(p),
+                                             n);
+}
+
+// ---- prefix_split ---------------------------------------------------------
+// /registry/<kind>/...          -> /registry/<kind>/
+// /registry/<group.with.dot>/<kind>/... -> /registry/<group>/<kind>/
+// (reference store.rs:836-863: Kubernetes never ranges across Kinds).
+std::string prefix_split(const std::string& key) {
+  if (key.empty() || key[0] != '/') return key;
+  size_t s1 = key.find('/', 1);
+  if (s1 == std::string::npos) return key;
+  size_t s2 = key.find('/', s1 + 1);
+  if (s2 == std::string::npos) return key;
+  // second path component (between s1 and s2)
+  if (key.find('.', s1 + 1) < s2) {
+    size_t s3 = key.find('/', s2 + 1);
+    if (s3 != std::string::npos) return key.substr(0, s3 + 1);
+  }
+  return key.substr(0, s2 + 1);
+}
+
+// ---- serialization --------------------------------------------------------
+
+void put_u32(std::string& b, uint32_t v) {
+  b.append(reinterpret_cast<const char*>(&v), 4);
+}
+void put_u8(std::string& b, uint8_t v) { b.push_back(static_cast<char>(v)); }
+void put_i64(std::string& b, int64_t v) {
+  b.append(reinterpret_cast<const char*>(&v), 8);
+}
+
+struct KvMeta {
+  int64_t create_rev = 0, mod_rev = 0, version = 0, lease = 0;
+  Bytes val;  // null for tombstone / keys_only
+};
+
+void put_kv(std::string& b, const std::string& key, const KvMeta& m,
+            bool keys_only = false) {
+  const bool hv = m.val && !keys_only;
+  put_u32(b, static_cast<uint32_t>(key.size()));
+  put_u32(b, hv ? static_cast<uint32_t>(m.val->size()) : 0);
+  put_i64(b, m.create_rev);
+  put_i64(b, m.mod_rev);
+  put_i64(b, m.version);
+  put_i64(b, m.lease);
+  b.append(key);
+  if (hv) b.append(*m.val);
+}
+
+uint8_t* to_malloc(const std::string& b, size_t* len_out) {
+  uint8_t* p = static_cast<uint8_t*>(malloc(b.size() ? b.size() : 1));
+  memcpy(p, b.data(), b.size());
+  *len_out = b.size();
+  return p;
+}
+
+// ---- core structures ------------------------------------------------------
+
+struct TreeItem {
+  std::string key;
+  std::vector<int64_t> revs;  // every revision that touched this key
+  bool present = false;
+  Bytes latest;
+  int64_t create_rev = 0, mod_rev = 0, version = 0, lease = 0;
+  // Value live at the compact revision when history below it was dropped.
+  int64_t base_rev = 0;
+  KvMeta base;
+};
+
+struct RevEntry {  // one revision in the global MVCC log
+  TreeItem* item = nullptr;
+  Bytes val;  // null => delete
+  int64_t create_rev = 0, version = 0, lease = 0;
+};
+
+struct Event {
+  uint8_t type = 0;  // 0 PUT, 1 DELETE
+  std::string key;
+  KvMeta kv;
+  bool has_prev = false;
+  KvMeta prev;
+};
+
+constexpr size_t kWatcherQueueCap = 65536;
+
+struct Watcher {
+  int64_t id = 0;
+  std::string start, end;  // end conventions: "" single key, "\0" infinity
+  bool single = false;
+  bool want_prev = false;
+  int64_t min_rev = 0;  // suppress live events below this revision
+  std::mutex m;
+  std::condition_variable cv;
+  std::deque<Event> q;
+  int64_t dropped = 0;
+  bool canceled = false;
+
+  bool matches(const std::string& key) const {
+    if (single) return key == start;
+    if (key < start) return false;
+    if (end == std::string(1, '\0')) return true;
+    return key < end;
+  }
+};
+
+// ---- WAL ------------------------------------------------------------------
+// Per-prefix append-only files, background writer batching into writev,
+// modes none/buffered/fsync, boot-time merge-replay by revision
+// (reference mem_etcd/src/wal.rs:62-299).
+
+struct WalRec {
+  int fd = -1;
+  int64_t rev = 0;
+  std::string key;
+  Bytes val;  // null => delete
+};
+
+constexpr uint32_t kDeleteMarker = 0xFFFFFFFFu;
+
+std::string hex_encode(const std::string& s) {
+  static const char* d = "0123456789abcdef";
+  std::string o;
+  o.reserve(s.size() * 2);
+  for (unsigned char c : s) {
+    o.push_back(d[c >> 4]);
+    o.push_back(d[c & 15]);
+  }
+  return o;
+}
+
+class Wal {
+ public:
+  Wal(std::string dir, int mode) : dir_(std::move(dir)), mode_(mode) {
+    writer_ = std::thread([this] { Run(); });
+  }
+
+  ~Wal() {
+    {
+      std::lock_guard<std::mutex> g(qm_);
+      stop_ = true;
+    }
+    qcv_.notify_all();
+    writer_.join();
+    for (auto& [prefix, fd] : fds_)
+      if (fd >= 0) close(fd);
+  }
+
+  int FdFor(const std::string& prefix) {
+    std::lock_guard<std::mutex> g(fd_mu_);
+    auto it = fds_.find(prefix);
+    if (it != fds_.end()) return it->second;
+    std::string path = dir_ + "/prefix_" + hex_encode(prefix) + ".wal";
+    int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    fds_[prefix] = fd;
+    return fd;
+  }
+
+  void Append(int fd, int64_t rev, std::string key, Bytes val) {
+    {
+      std::lock_guard<std::mutex> g(qm_);
+      q_.push_back(WalRec{fd, rev, std::move(key), std::move(val)});
+      last_enqueued_ = rev;
+    }
+    qcv_.notify_one();
+  }
+
+  void WaitPersisted(int64_t rev) {
+    std::unique_lock<std::mutex> g(pm_);
+    pcv_.wait(g, [&] { return persisted_ >= rev || io_error_; });
+  }
+
+  int Sync() {
+    int64_t target;
+    {
+      std::lock_guard<std::mutex> g(qm_);
+      target = last_enqueued_;
+    }
+    WaitPersisted(target);
+    {
+      std::lock_guard<std::mutex> g(fd_mu_);
+      for (auto& [prefix, fd] : fds_)
+        if (fd >= 0 && fsync(fd) != 0) return MS_ERR_IO;
+    }
+    return io_error_ ? MS_ERR_IO : MS_OK;
+  }
+
+  bool fsync_mode() const { return mode_ == MS_WAL_FSYNC; }
+
+ private:
+  void Run() {
+    std::vector<WalRec> batch;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> g(qm_);
+        qcv_.wait(g, [&] { return stop_ || !q_.empty(); });
+        if (q_.empty() && stop_) return;
+        // Drain up to ~16 KiB worth or the whole queue, whichever is
+        // smaller (reference wal.rs:173-248 batches 16 KiB / 500 us).
+        size_t bytes = 0;
+        while (!q_.empty() && bytes < (16u << 10)) {
+          bytes += q_.front().key.size() +
+                   (q_.front().val ? q_.front().val->size() : 0) + 16;
+          batch.push_back(std::move(q_.front()));
+          q_.pop_front();
+        }
+      }
+      WriteBatch(batch);
+      batch.clear();
+    }
+  }
+
+  void WriteBatch(std::vector<WalRec>& batch) {
+    if (batch.empty()) return;
+    // Group contiguous records per fd into one buffered write.
+    std::unordered_map<int, std::string> bufs;
+    int64_t max_rev = 0;
+    for (auto& r : batch) {
+      std::string& b = bufs[r.fd];
+      uint64_t rev = static_cast<uint64_t>(r.rev);
+      b.append(reinterpret_cast<const char*>(&rev), 8);
+      put_u32(b, static_cast<uint32_t>(r.key.size()));
+      put_u32(b, r.val ? static_cast<uint32_t>(r.val->size()) : kDeleteMarker);
+      b.append(r.key);
+      if (r.val) b.append(*r.val);
+      max_rev = std::max(max_rev, r.rev);
+    }
+    bool err = false;
+    for (auto& [fd, buf] : bufs) {
+      if (fd < 0) continue;
+      const char* p = buf.data();
+      size_t n = buf.size();
+      while (n > 0) {
+        ssize_t w = write(fd, p, n);
+        if (w < 0) {
+          err = true;
+          break;
+        }
+        p += w;
+        n -= static_cast<size_t>(w);
+      }
+      if (!err && mode_ == MS_WAL_FSYNC) err = fsync(fd) != 0;
+    }
+    {
+      std::lock_guard<std::mutex> g(pm_);
+      persisted_ = std::max(persisted_, max_rev);
+      if (err) io_error_ = true;
+    }
+    pcv_.notify_all();
+  }
+
+  std::string dir_;
+  int mode_;
+  std::mutex qm_;
+  std::condition_variable qcv_;
+  std::deque<WalRec> q_;
+  int64_t last_enqueued_ = 0;
+  bool stop_ = false;
+  std::mutex pm_;
+  std::condition_variable pcv_;
+  int64_t persisted_ = 0;
+  bool io_error_ = false;
+  std::mutex fd_mu_;
+  std::map<std::string, int> fds_;
+  std::thread writer_;
+};
+
+struct PrefixStats {
+  int64_t keys = 0;
+  int64_t bytes = 0;
+};
+
+}  // namespace
+
+// ---- the store ------------------------------------------------------------
+
+struct ms_store {
+  mutable std::shared_mutex mu;
+
+  std::map<std::string, TreeItem*> sorted;          // full-key ordered index
+  std::unordered_map<std::string, TreeItem*> by_key;  // O(1) point lookup
+
+  // Global revision log: entry for revision r lives at log[r - log_base].
+  std::deque<RevEntry> log;
+  int64_t log_base = 1;   // revision of log.front()
+  int64_t current = 0;    // latest allocated revision
+  int64_t compacted = 0;  // compact revision (0 = never)
+
+  std::map<int64_t, std::shared_ptr<Watcher>> watchers;
+  int64_t next_watcher = 0;
+
+  std::map<std::string, PrefixStats> prefix_stats;
+  std::atomic<int64_t> live_keys{0};
+  std::atomic<int64_t> db_bytes{0};
+
+  std::unique_ptr<Wal> wal;
+  std::vector<std::string> no_write_prefixes;
+  bool replaying = false;
+
+  ~ms_store() {
+    wal.reset();  // drain writer before freeing items
+    for (auto& [k, item] : by_key) delete item;
+  }
+
+  bool wal_skip(const std::string& key) const {
+    for (const auto& p : no_write_prefixes)
+      if (key.compare(0, p.size(), p) == 0) return true;
+    return false;
+  }
+
+  // Value of `item` as of revision rev (largest touch <= rev).
+  // Returns MS_OK with meta (meta.val null => deleted at that revision,
+  // i.e. key absent), or MS_ERR_COMPACTED when the history is gone.
+  int value_at(const TreeItem* item, int64_t rev, KvMeta* out) const {
+    auto it = std::upper_bound(item->revs.begin(), item->revs.end(), rev);
+    if (it == item->revs.begin()) {
+      out->val = nullptr;  // key did not exist yet at rev
+      return MS_OK;
+    }
+    int64_t r = *(it - 1);
+    if (r == item->mod_rev) {
+      out->create_rev = item->create_rev;
+      out->mod_rev = item->mod_rev;
+      out->version = item->version;
+      out->lease = item->lease;
+      out->val = item->present ? item->latest : nullptr;
+      return MS_OK;
+    }
+    if (r >= log_base) {
+      const RevEntry& e = log[static_cast<size_t>(r - log_base)];
+      out->create_rev = e.create_rev;
+      out->mod_rev = r;
+      out->version = e.version;
+      out->lease = e.lease;
+      out->val = e.val;
+      return MS_OK;
+    }
+    if (r == item->base_rev) {
+      *out = item->base;
+      out->mod_rev = r;
+      return MS_OK;
+    }
+    return MS_ERR_COMPACTED;
+  }
+
+  void dispatch(const std::string& key, const Event& ev) {
+    for (auto& [id, w] : watchers) {
+      if (!w->matches(key)) continue;
+      if (ev.kv.mod_rev < w->min_rev) continue;
+      std::lock_guard<std::mutex> g(w->m);
+      if (w->canceled) continue;
+      if (w->q.size() >= kWatcherQueueCap) {
+        w->dropped++;
+        continue;
+      }
+      Event e = ev;
+      if (!w->want_prev) {
+        e.has_prev = false;
+        e.prev = KvMeta{};
+      }
+      w->q.push_back(std::move(e));
+      w->cv.notify_one();
+    }
+  }
+};
+
+// ---- open / replay --------------------------------------------------------
+
+static int64_t store_set_locked(ms_store* s, const std::string& key,
+                                const uint8_t* val, size_t vlen, bool is_del,
+                                int has_req, int req_is_version,
+                                int64_t req_val, int64_t lease,
+                                int64_t* latest_rev_out, uint8_t** cur_out,
+                                size_t* cur_len_out, bool* fsync_wait_out);
+
+ms_store* ms_open(const char* wal_dir, int wal_mode,
+                  const char* no_write_prefixes) {
+  auto* s = new ms_store();
+  if (no_write_prefixes && *no_write_prefixes) {
+    std::string all(no_write_prefixes);
+    size_t pos = 0;
+    while (pos <= all.size()) {
+      size_t nl = all.find('\n', pos);
+      if (nl == std::string::npos) nl = all.size();
+      if (nl > pos) s->no_write_prefixes.push_back(all.substr(pos, nl - pos));
+      pos = nl + 1;
+    }
+  }
+
+  // Revisions start at 1 like etcd: write a dummy key before the WAL is
+  // attached so it is never persisted (reference main.rs:103-104).
+  store_set_locked(s, "~", reinterpret_cast<const uint8_t*>(""), 0, false, 0,
+                   0, 0, 0, nullptr, nullptr, nullptr, nullptr);
+
+  std::string dir = wal_dir ? wal_dir : "";
+  if (!dir.empty()) {
+    mkdir(dir.c_str(), 0755);
+    // Replay existing files before attaching the writer.
+    struct Rec {
+      int64_t rev;
+      std::string key, val;
+      bool is_del;
+    };
+    std::vector<std::vector<Rec>> files;
+    {
+      // enumerate prefix_*.wal
+      DIR* d = opendir(dir.c_str());
+      if (d) {
+        struct dirent* de;
+        while ((de = readdir(d)) != nullptr) {
+          std::string name = de->d_name;
+          if (name.rfind("prefix_", 0) != 0) continue;
+          if (name.size() < 4 || name.substr(name.size() - 4) != ".wal")
+            continue;
+          FILE* f = fopen((dir + "/" + name).c_str(), "rb");
+          if (!f) continue;
+          std::vector<Rec> recs;
+          for (;;) {
+            uint64_t r;
+            uint32_t kl, vl;
+            if (fread(&r, 8, 1, f) != 1) break;
+            if (fread(&kl, 4, 1, f) != 1) break;
+            if (fread(&vl, 4, 1, f) != 1) break;
+            Rec rec;
+            rec.rev = static_cast<int64_t>(r);
+            rec.key.resize(kl);
+            if (kl && fread(rec.key.data(), 1, kl, f) != kl) break;
+            rec.is_del = (vl == kDeleteMarker);
+            if (!rec.is_del) {
+              rec.val.resize(vl);
+              if (vl && fread(rec.val.data(), 1, vl, f) != vl) break;
+            }
+            recs.push_back(std::move(rec));
+          }
+          fclose(f);
+          if (!recs.empty()) files.push_back(std::move(recs));
+        }
+        closedir(d);
+      }
+    }
+    // k-way merge by recorded revision.
+    using HeapItem = std::pair<int64_t, std::pair<size_t, size_t>>;
+    std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<>> heap;
+    for (size_t i = 0; i < files.size(); i++)
+      heap.push({files[i][0].rev, {i, 0}});
+    s->replaying = true;
+    while (!heap.empty()) {
+      auto [rev, fi] = heap.top();
+      heap.pop();
+      auto& rec = files[fi.first][fi.second];
+      store_set_locked(s, rec.key,
+                       reinterpret_cast<const uint8_t*>(rec.val.data()),
+                       rec.val.size(), rec.is_del, 0, 0, 0, 0, nullptr,
+                       nullptr, nullptr, nullptr);
+      if (fi.second + 1 < files[fi.first].size())
+        heap.push({files[fi.first][fi.second + 1].rev,
+                   {fi.first, fi.second + 1}});
+    }
+    s->replaying = false;
+    s->wal = std::make_unique<Wal>(dir, wal_mode);
+  }
+  return s;
+}
+
+void ms_close(ms_store* s) { delete s; }
+void ms_free(void* p) { free(p); }
+
+// ---- set ------------------------------------------------------------------
+
+static int64_t store_set_locked(ms_store* s, const std::string& key,
+                                const uint8_t* val, size_t vlen, bool is_del,
+                                int has_req, int req_is_version,
+                                int64_t req_val, int64_t lease,
+                                int64_t* latest_rev_out, uint8_t** cur_out,
+                                size_t* cur_len_out, bool* fsync_wait_out) {
+  TreeItem* item = nullptr;
+  auto it = s->by_key.find(key);
+  if (it != s->by_key.end()) item = it->second;
+  const bool present = item && item->present;
+
+  if (has_req) {
+    int64_t have = req_is_version ? (present ? item->version : 0)
+                                  : (present ? item->mod_rev : 0);
+    if (have != req_val) {
+      if (latest_rev_out) *latest_rev_out = s->current;
+      if (cur_out && present) {
+        std::string b;
+        KvMeta m{item->create_rev, item->mod_rev, item->version, item->lease,
+                 item->latest};
+        put_kv(b, key, m);
+        *cur_out = to_malloc(b, cur_len_out);
+      }
+      return MS_ERR_CAS;
+    }
+  }
+
+  if (is_del && !present) return 0;  // delete of absent key: no revision
+
+  if (!item) {
+    item = new TreeItem();
+    item->key = key;
+    s->by_key.emplace(key, item);
+    s->sorted.emplace(key, item);
+  } else if (!present && !is_del) {
+    s->sorted.emplace(key, item);  // resurrect tombstone into the index
+  }
+
+  // Capture prev for watchers before mutating.
+  KvMeta prev;
+  bool had_prev = present;
+  if (present)
+    prev = KvMeta{item->create_rev, item->mod_rev, item->version, item->lease,
+                  item->latest};
+
+  const int64_t rev = ++s->current;
+  RevEntry e;
+  e.item = item;
+
+  const std::string& prefix = prefix_split(key);
+  auto& ps = s->prefix_stats[prefix];
+
+  if (is_del) {
+    ps.keys--;
+    ps.bytes -= static_cast<int64_t>(key.size() +
+                                     (item->latest ? item->latest->size() : 0));
+    s->live_keys.fetch_sub(1, std::memory_order_relaxed);
+    s->db_bytes.fetch_sub(
+        static_cast<int64_t>(key.size() +
+                             (item->latest ? item->latest->size() : 0)),
+        std::memory_order_relaxed);
+    item->present = false;
+    item->latest = nullptr;
+    item->mod_rev = rev;
+    item->version = 0;
+    item->create_rev = 0;
+    item->lease = 0;
+    s->sorted.erase(key);  // latest index holds live keys only
+  } else {
+    Bytes v = make_bytes(val, vlen);
+    int64_t old_bytes =
+        present ? static_cast<int64_t>(key.size() + item->latest->size()) : 0;
+    if (!present) {
+      item->create_rev = rev;
+      item->version = 1;
+      ps.keys++;
+      s->live_keys.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      item->version++;
+    }
+    item->present = true;
+    item->latest = v;
+    item->mod_rev = rev;
+    item->lease = lease;
+    int64_t new_bytes = static_cast<int64_t>(key.size() + vlen);
+    ps.bytes += new_bytes - old_bytes;
+    s->db_bytes.fetch_add(new_bytes - old_bytes, std::memory_order_relaxed);
+    e.val = v;
+    e.create_rev = item->create_rev;
+    e.version = item->version;
+    e.lease = lease;
+  }
+  item->revs.push_back(rev);
+  s->log.push_back(std::move(e));
+
+  // WAL append (inside the lock: queue order == revision order).
+  if (s->wal && !s->replaying && !s->wal_skip(key)) {
+    int fd = s->wal->FdFor(prefix);
+    s->wal->Append(fd, rev, key, s->log.back().val);
+    if (fsync_wait_out) *fsync_wait_out = s->wal->fsync_mode();
+  }
+
+  // Watch dispatch (inside the lock: revision-ordered by construction).
+  if (!s->watchers.empty()) {
+    Event ev;
+    ev.type = is_del ? 1 : 0;
+    ev.key = key;
+    if (is_del) {
+      ev.kv = KvMeta{0, rev, 0, 0, nullptr};
+    } else {
+      ev.kv = KvMeta{item->create_rev, rev, item->version, item->lease,
+                     item->latest};
+    }
+    ev.has_prev = had_prev;
+    ev.prev = prev;
+    s->dispatch(key, ev);
+  }
+
+  return rev;
+}
+
+int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
+               const uint8_t* val, size_t vlen, int has_req,
+               int req_is_version, int64_t req_val, int64_t lease,
+               int64_t* latest_rev_out, uint8_t** cur_out,
+               size_t* cur_len_out) {
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  int64_t rev;
+  bool fsync_wait = false;
+  {
+    std::unique_lock<std::shared_mutex> g(s->mu);
+    rev = store_set_locked(s, k, val, vlen, val == nullptr, has_req,
+                           req_is_version, req_val, lease, latest_rev_out,
+                           cur_out, cur_len_out, &fsync_wait);
+  }
+  if (rev > 0 && fsync_wait) {
+    // fsync mode: block until durable (reference store.rs:415-437).
+    s->wal->WaitPersisted(rev);
+  }
+  return rev;
+}
+
+// ---- range ----------------------------------------------------------------
+
+namespace {
+
+// end conventions: len 0 => single key; "\0" => infinity; else exclusive.
+enum class RangeKind { kSingle, kToInfinity, kBounded };
+
+RangeKind range_kind(const uint8_t* end, size_t end_len) {
+  if (end == nullptr || end_len == 0) return RangeKind::kSingle;
+  if (end_len == 1 && end[0] == 0) return RangeKind::kToInfinity;
+  return RangeKind::kBounded;
+}
+
+}  // namespace
+
+int ms_range(ms_store* s, const uint8_t* start, size_t start_len,
+             const uint8_t* end, size_t end_len, int64_t rev, int64_t limit,
+             int count_only, int keys_only, uint8_t** out, size_t* out_len) {
+  std::string k(reinterpret_cast<const char*>(start), start_len);
+  RangeKind kind = range_kind(end, end_len);
+  std::string e = kind == RangeKind::kBounded
+                      ? std::string(reinterpret_cast<const char*>(end), end_len)
+                      : std::string();
+
+  std::shared_lock<std::shared_mutex> g(s->mu);
+  if (rev > 0) {
+    if (rev > s->current) return MS_ERR_FUTURE_REV;
+    if (s->compacted && rev < s->compacted) return MS_ERR_COMPACTED;
+  }
+  const bool historical = rev > 0 && rev < s->current;
+
+  std::string body;
+  int64_t total = 0;
+  uint32_t n = 0;
+
+  auto emit = [&](const std::string& key, const KvMeta& m) {
+    total++;
+    if (count_only) return;
+    if (limit > 0 && n >= limit) return;
+    put_kv(body, key, m, keys_only != 0);
+    n++;
+  };
+
+  if (kind == RangeKind::kSingle) {
+    auto it = s->by_key.find(k);
+    if (it != s->by_key.end()) {
+      TreeItem* item = it->second;
+      if (historical) {
+        KvMeta m;
+        int rc = s->value_at(item, rev, &m);
+        if (rc != MS_OK) return rc;
+        if (m.val) emit(k, m);
+      } else if (item->present) {
+        emit(k, KvMeta{item->create_rev, item->mod_rev, item->version,
+                       item->lease, item->latest});
+      }
+    }
+    if (historical) {
+      // A key deleted later than `rev` is absent from `sorted`; by_key
+      // covers it above.  Nothing more to do for single-key reads.
+    }
+  } else {
+    if (historical) {
+      // Historical ranges must see keys that are tombstoned *now* but were
+      // live at `rev`; those are absent from `sorted`.  Walk `by_key`-backed
+      // items via an ordered scan over all items: maintain a merged view by
+      // iterating `sorted` for live keys and checking tombstones from the
+      // revision log is costly; instead iterate an ordered snapshot of all
+      // item keys in range.  Item count == live + tombstoned keys.
+      // (Tombstones are GC'd at compaction, keeping this bounded.)
+      std::vector<std::pair<const std::string*, TreeItem*>> in_range;
+      for (auto& [key, item] : s->by_key) {
+        if (key < k) continue;
+        if (kind == RangeKind::kBounded && key >= e) continue;
+        in_range.emplace_back(&key, item);
+      }
+      std::sort(in_range.begin(), in_range.end(),
+                [](auto& a, auto& b) { return *a.first < *b.first; });
+      for (auto& [key, item] : in_range) {
+        KvMeta m;
+        int rc = s->value_at(item, rev, &m);
+        if (rc != MS_OK) return rc;
+        if (m.val) emit(*key, m);
+      }
+    } else {
+      auto it = s->sorted.lower_bound(k);
+      for (; it != s->sorted.end(); ++it) {
+        if (kind == RangeKind::kBounded && it->first >= e) break;
+        TreeItem* item = it->second;
+        emit(it->first, KvMeta{item->create_rev, item->mod_rev, item->version,
+                               item->lease, item->latest});
+      }
+    }
+  }
+
+  std::string head;
+  put_i64(head, s->current);
+  put_i64(head, total);
+  put_u32(head, n);
+  put_u8(head, (limit > 0 && total > n) ? 1 : 0);
+  head.append(body);
+  *out = to_malloc(head, out_len);
+  return MS_OK;
+}
+
+int64_t ms_current_revision(ms_store* s) {
+  std::shared_lock<std::shared_mutex> g(s->mu);
+  return s->current;
+}
+
+int64_t ms_compact_revision(ms_store* s) {
+  std::shared_lock<std::shared_mutex> g(s->mu);
+  return s->compacted;
+}
+
+int64_t ms_progress_revision(ms_store* s) { return ms_current_revision(s); }
+
+// ---- compaction -----------------------------------------------------------
+
+int ms_compact(ms_store* s, int64_t rev) {
+  std::unique_lock<std::shared_mutex> g(s->mu);
+  if (rev <= s->compacted) return MS_ERR_COMPACTED;
+  if (rev > s->current) return MS_ERR_FUTURE_REV;
+  s->compacted = rev;
+  while (s->log_base < rev && !s->log.empty()) {
+    RevEntry& e = s->log.front();
+    TreeItem* item = e.item;
+    const int64_t r = s->log_base;
+    if (item) {
+      // Preserve the value live at the compact revision (etcd keeps
+      // non-superseded versions; see header).
+      auto it = std::upper_bound(item->revs.begin(), item->revs.end(), rev);
+      int64_t live = (it == item->revs.begin()) ? 0 : *(it - 1);
+      if (r == live && e.val) {
+        // Keep it even when r == mod_rev today: a later write would move
+        // `latest` on and strand reads in [compact_rev, that write).
+        item->base_rev = r;
+        item->base = KvMeta{e.create_rev, r, e.version, e.lease, e.val};
+      }
+      // Tombstone GC (the reference's TODO, store.rs:832): a key deleted
+      // before the compact revision with no later writes can be dropped
+      // entirely.
+      if (!e.val && r == item->mod_rev && !item->present) {
+        s->by_key.erase(item->key);
+        s->sorted.erase(item->key);
+        delete item;
+        // Null out any remaining log references (none: r == mod_rev means
+        // this was the item's last touch).
+      }
+    }
+    s->log.pop_front();
+    s->log_base++;
+  }
+  return MS_OK;
+}
+
+// ---- watches --------------------------------------------------------------
+
+int64_t ms_watch_create(ms_store* s, const uint8_t* start, size_t start_len,
+                        const uint8_t* end, size_t end_len, int64_t start_rev,
+                        int want_prev_kv, int64_t* compact_rev_out) {
+  std::unique_lock<std::shared_mutex> g(s->mu);
+  if (start_rev > 0 && s->compacted && start_rev < s->compacted) {
+    if (compact_rev_out) *compact_rev_out = s->compacted;
+    return MS_ERR_COMPACTED;
+  }
+  auto w = std::make_shared<Watcher>();
+  w->id = s->next_watcher++;
+  w->start.assign(reinterpret_cast<const char*>(start), start_len);
+  RangeKind kind = range_kind(end, end_len);
+  w->single = kind == RangeKind::kSingle;
+  if (kind == RangeKind::kBounded)
+    w->end.assign(reinterpret_cast<const char*>(end), end_len);
+  else if (kind == RangeKind::kToInfinity)
+    w->end = std::string(1, '\0');
+  w->want_prev = want_prev_kv != 0;
+  w->min_rev = start_rev;
+
+  // Replay past changes >= start_rev from the revision log, in revision
+  // order (reference store.rs:766-806 walks per-key revision lists; the
+  // log scan is equivalent and already ordered).
+  if (start_rev > 0 && start_rev <= s->current) {
+    for (int64_t r = std::max(start_rev, s->log_base); r <= s->current; r++) {
+      const RevEntry& e = s->log[static_cast<size_t>(r - s->log_base)];
+      if (!e.item || !w->matches(e.item->key)) continue;
+      Event ev;
+      ev.key = e.item->key;
+      if (e.val) {
+        ev.type = 0;
+        ev.kv = KvMeta{e.create_rev, r, e.version, e.lease, e.val};
+      } else {
+        ev.type = 1;
+        ev.kv = KvMeta{0, r, 0, 0, nullptr};
+      }
+      if (w->want_prev) {
+        // prev = value just before r, even across the start revision
+        // (reference watch_service_test.rs:372-425 pins this).
+        KvMeta prev;
+        if (s->value_at(e.item, r - 1, &prev) == MS_OK && prev.val) {
+          ev.has_prev = true;
+          ev.prev = prev;
+        }
+      }
+      w->q.push_back(std::move(ev));
+    }
+  }
+
+  s->watchers.emplace(w->id, w);
+  return w->id;
+}
+
+int ms_watch_cancel(ms_store* s, int64_t watcher_id) {
+  std::shared_ptr<Watcher> w;
+  {
+    std::unique_lock<std::shared_mutex> g(s->mu);
+    auto it = s->watchers.find(watcher_id);
+    if (it == s->watchers.end()) return MS_ERR_NOT_FOUND;
+    w = it->second;
+    s->watchers.erase(it);
+  }
+  {
+    std::lock_guard<std::mutex> g(w->m);
+    w->canceled = true;
+  }
+  w->cv.notify_all();
+  return MS_OK;
+}
+
+int ms_watch_poll(ms_store* s, int64_t watcher_id, int max_events,
+                  int timeout_ms, uint8_t** out, size_t* out_len) {
+  std::shared_ptr<Watcher> w;
+  {
+    std::shared_lock<std::shared_mutex> g(s->mu);
+    auto it = s->watchers.find(watcher_id);
+    if (it != s->watchers.end()) w = it->second;
+  }
+  if (!w) return MS_ERR_NOT_FOUND;
+
+  std::vector<Event> events;
+  bool canceled;
+  {
+    std::unique_lock<std::mutex> g(w->m);
+    if (w->q.empty() && timeout_ms > 0 && !w->canceled)
+      w->cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                     [&] { return !w->q.empty() || w->canceled; });
+    canceled = w->canceled;
+    while (!w->q.empty() && static_cast<int>(events.size()) < max_events) {
+      events.push_back(std::move(w->q.front()));
+      w->q.pop_front();
+    }
+  }
+
+  std::string b;
+  put_u32(b, static_cast<uint32_t>(events.size()));
+  put_u8(b, canceled ? 1 : 0);
+  for (auto& ev : events) {
+    put_u8(b, ev.type);
+    put_u8(b, ev.has_prev ? 1 : 0);
+    put_kv(b, ev.key, ev.kv);
+    if (ev.has_prev) put_kv(b, ev.key, ev.prev);
+  }
+  *out = to_malloc(b, out_len);
+  return static_cast<int>(events.size());
+}
+
+int64_t ms_watch_dropped(ms_store* s, int64_t watcher_id) {
+  std::shared_lock<std::shared_mutex> g(s->mu);
+  auto it = s->watchers.find(watcher_id);
+  if (it == s->watchers.end()) return MS_ERR_NOT_FOUND;
+  std::lock_guard<std::mutex> g2(it->second->m);
+  return it->second->dropped;
+}
+
+// ---- stats / maintenance --------------------------------------------------
+
+int64_t ms_num_keys(ms_store* s) {
+  return s->live_keys.load(std::memory_order_relaxed);
+}
+
+int64_t ms_db_size(ms_store* s) {
+  return s->db_bytes.load(std::memory_order_relaxed);
+}
+
+int ms_stats_json(ms_store* s, uint8_t** out, size_t* out_len) {
+  std::shared_lock<std::shared_mutex> g(s->mu);
+  std::string j = "{\"revision\":" + std::to_string(s->current) +
+                  ",\"compact_revision\":" + std::to_string(s->compacted) +
+                  ",\"keys\":" + std::to_string(s->live_keys.load()) +
+                  ",\"db_bytes\":" + std::to_string(s->db_bytes.load()) +
+                  ",\"watchers\":" + std::to_string(s->watchers.size()) +
+                  ",\"prefixes\":{";
+  bool first = true;
+  for (auto& [p, st] : s->prefix_stats) {
+    if (!first) j += ",";
+    first = false;
+    std::string esc;
+    for (char c : p) {
+      if (c == '"' || c == '\\') esc += '\\';
+      esc += c;
+    }
+    j += "\"" + esc + "\":{\"keys\":" + std::to_string(st.keys) +
+         ",\"bytes\":" + std::to_string(st.bytes) + "}";
+  }
+  j += "}}";
+  *out = to_malloc(j, out_len);
+  return MS_OK;
+}
+
+int ms_wal_sync(ms_store* s) {
+  if (!s->wal) return MS_OK;
+  return s->wal->Sync();
+}

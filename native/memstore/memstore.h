@@ -1,0 +1,167 @@
+/* memstore — in-memory MVCC key-value store with etcd semantics.
+ *
+ * TPU-native framework's equivalent of the reference's mem_etcd
+ * (reference mem_etcd/src/store.rs, wal.rs, block_deque.rs — Rust).
+ * Re-designed, not translated:
+ *   - per-Kind ordered maps keyed by the /registry/[group/]kind/ prefix
+ *     (same prefix_split insight, reference store.rs:836-863), held in a
+ *     sorted map of trees so cross-prefix ranges also work;
+ *   - one global revision log (block array) for MVCC time travel
+ *     (reference block_deque.rs);
+ *   - watch events are enqueued to per-watcher bounded queues *inside* the
+ *     write critical section, so they are revision-ordered by construction
+ *     — no re-ordering heap or notify thread needed (the reference needs
+ *     one because its revision allocation and notification are decoupled,
+ *     store.rs:444-533);
+ *   - WAL: per-prefix append-only files, none/buffered/fsync modes, a
+ *     background writer batching records, boot-time merge-replay by
+ *     revision (reference wal.rs:62-299).
+ *
+ * The API is a flat C ABI for ctypes; buffers returned by the store are
+ * malloc'd copies the caller frees with ms_free.
+ */
+#ifndef MEMSTORE_H
+#define MEMSTORE_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct ms_store ms_store;
+
+/* WAL modes (reference mem_etcd --wal-default, main.rs:60-81). */
+enum {
+  MS_WAL_NONE = 0,
+  MS_WAL_BUFFERED = 1,
+  MS_WAL_FSYNC = 2,
+};
+
+/* Error codes (negative returns). */
+enum {
+  MS_OK = 0,
+  MS_ERR_CAS = -1,        /* compare failed; see ms_set out params */
+  MS_ERR_COMPACTED = -2,  /* revision below compact revision */
+  MS_ERR_FUTURE_REV = -3, /* revision above current revision */
+  MS_ERR_NOT_FOUND = -4,
+  MS_ERR_INVALID = -5,
+  MS_ERR_IO = -6,
+};
+
+/* Open a store. wal_dir NULL/empty disables the WAL entirely.
+ * no_write_prefixes: '\n'-separated list of key prefixes whose writes skip
+ * the WAL (reference --wal-no-write-prefix; events/leases at 100K/s need
+ * not be durable).  Replays any existing WAL files before returning. */
+ms_store* ms_open(const char* wal_dir, int wal_mode,
+                  const char* no_write_prefixes);
+void ms_close(ms_store* s);
+
+/* Free any buffer returned through an out-parameter. */
+void ms_free(void* p);
+
+/* ---- writes ----------------------------------------------------------- */
+
+/* Set or delete (val==NULL) a key, with optional compare-and-swap.
+ *
+ *   has_req        0: unconditional; 1: CAS
+ *   req_is_version 0: compare latest mod_revision == req_val
+ *                  1: compare version == req_val   (0 = key must not exist)
+ *   lease          lease id recorded on the KV (0 = none)
+ *
+ * Success: returns the new revision (> 0).
+ * CAS failure: returns MS_ERR_CAS and sets *latest_rev_out to the store's
+ * current revision; if the key currently exists and cur_out != NULL, a
+ * serialized KV record (see layout below) is malloc'd into *cur_out.
+ * This is exactly the Txn failure branch payload
+ * (reference store.rs:189-382, kv_service.rs:126-337). */
+int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
+               const uint8_t* val, size_t vlen, int has_req,
+               int req_is_version, int64_t req_val, int64_t lease,
+               int64_t* latest_rev_out, uint8_t** cur_out,
+               size_t* cur_len_out);
+
+/* In fsync mode, ms_set returns only after the record is durable. */
+
+/* ---- reads ------------------------------------------------------------ */
+
+/* KV record layout inside result buffers (all little-endian):
+ *   u32 klen | u32 vlen | i64 create_rev | i64 mod_rev | i64 version
+ *   | i64 lease | key bytes | val bytes
+ *
+ * Range result buffer layout:
+ *   i64 header_revision | i64 total_count | u32 n_kvs | u8 more
+ *   | n_kvs * KV record
+ *
+ * Range over [start, end); end NULL/len 0 = single key; end == "\0" (one
+ * zero byte) = from start to infinity (etcd convention).  rev 0 = latest.
+ * limit 0 = unlimited.  count_only / keys_only as in etcd RangeRequest.
+ * Returns MS_OK or MS_ERR_COMPACTED / MS_ERR_FUTURE_REV. */
+int ms_range(ms_store* s, const uint8_t* start, size_t start_len,
+             const uint8_t* end, size_t end_len, int64_t rev, int64_t limit,
+             int count_only, int keys_only, uint8_t** out, size_t* out_len);
+
+int64_t ms_current_revision(ms_store* s);
+int64_t ms_compact_revision(ms_store* s);
+/* Highest revision whose watch events are fully enqueued (== current
+ * revision here, since enqueue happens inside the write lock; the split
+ * exists in the reference because its notify path is async,
+ * store.rs:528). */
+int64_t ms_progress_revision(ms_store* s);
+
+/* ---- compaction ------------------------------------------------------- */
+
+/* Drop value history strictly below rev.  Latest values are untouched.
+ * Returns MS_OK, MS_ERR_COMPACTED (rev already compacted) or
+ * MS_ERR_FUTURE_REV. */
+int ms_compact(ms_store* s, int64_t rev);
+
+/* ---- watches ---------------------------------------------------------- */
+
+/* Create a watcher over [start, end) (end conventions as ms_range).
+ * start_rev > 0 replays history from that revision (inclusive); 0 means
+ * "from next write".  Events (including the replay) are delivered through
+ * ms_watch_poll in revision order.
+ * Returns watcher id >= 0, or MS_ERR_COMPACTED (and sets *compact_rev_out)
+ * if start_rev is below the compact revision. */
+int64_t ms_watch_create(ms_store* s, const uint8_t* start, size_t start_len,
+                        const uint8_t* end, size_t end_len, int64_t start_rev,
+                        int want_prev_kv, int64_t* compact_rev_out);
+
+int ms_watch_cancel(ms_store* s, int64_t watcher_id);
+
+/* Poll result buffer layout:
+ *   u32 n_events | u8 canceled | n_events * event
+ *   event: u8 type (0 PUT, 1 DELETE) | u8 has_prev | KV record
+ *          | [prev KV record if has_prev]
+ * Blocks up to timeout_ms for at least one event (0 = non-blocking).
+ * max_events bounds the batch (like the reference's recv_many(...,1000),
+ * watch_service.rs:126-146). Returns number of events, or < 0 on error
+ * (MS_ERR_NOT_FOUND for unknown/canceled watcher). */
+int ms_watch_poll(ms_store* s, int64_t watcher_id, int max_events,
+                  int timeout_ms, uint8_t** out, size_t* out_len);
+
+/* Events dropped on this watcher because its queue (10,000 deep, like
+ * reference store.rs:27) overflowed; the server should cancel such
+ * watchers. */
+int64_t ms_watch_dropped(ms_store* s, int64_t watcher_id);
+
+/* ---- stats / maintenance --------------------------------------------- */
+
+/* Total live keys. */
+int64_t ms_num_keys(ms_store* s);
+/* Approximate resident bytes of keys+latest values (db_size analogue). */
+int64_t ms_db_size(ms_store* s);
+/* JSON object: per-prefix {keys, bytes}, revision, watcher count, etc. */
+int ms_stats_json(ms_store* s, uint8_t** out, size_t* out_len);
+
+/* Block until all WAL records at or below the current revision are
+ * persisted (flush).  No-op without a WAL. Returns MS_OK / MS_ERR_IO. */
+int ms_wal_sync(ms_store* s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MEMSTORE_H */

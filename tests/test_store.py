@@ -1,0 +1,439 @@
+"""Native memstore tests — ports the semantics of the reference's Rust
+corpus (reference mem_etcd/tests/store_test.rs, watch_test.rs), which
+encodes the etcd-subset contract Kubernetes depends on.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from k8s1m_tpu.store import (
+    CompactedError,
+    FutureRevError,
+    MemStore,
+    prefix_end,
+)
+
+K = b"/registry/pods/default/a"
+K2 = b"/registry/pods/default/b"
+NODE_PREFIX = b"/registry/minions/"
+
+
+@pytest.fixture()
+def store():
+    s = MemStore()
+    yield s
+    s.close()
+
+
+# ---- MVCC / revisions (store_test.rs:1-120) ------------------------------
+
+
+def test_revisions_start_at_one(store):
+    # The dummy key makes the first real write revision 2, like etcd after
+    # its bootstrap write (reference main.rs:103-104).
+    assert store.current_revision == 1
+    rev = store.put(K, b"v1")
+    assert rev == 2
+
+
+def test_put_get_roundtrip(store):
+    rev = store.put(K, b"v1")
+    kv = store.get(K)
+    assert kv.value == b"v1"
+    assert kv.mod_revision == rev
+    assert kv.create_revision == rev
+    assert kv.version == 1
+
+
+def test_version_increments_and_create_rev_stable(store):
+    r1 = store.put(K, b"v1")
+    r2 = store.put(K, b"v2")
+    kv = store.get(K)
+    assert kv.version == 2
+    assert kv.create_revision == r1
+    assert kv.mod_revision == r2
+
+
+def test_range_at_historical_revision(store):
+    r1 = store.put(K, b"v1")
+    store.put(K, b"v2")
+    old = store.get(K, revision=r1)
+    assert old.value == b"v1"
+    assert old.version == 1
+    new = store.get(K)
+    assert new.value == b"v2"
+
+
+def test_range_before_key_existed(store):
+    rev0 = store.current_revision
+    store.put(K, b"v1")
+    assert store.get(K, revision=rev0) is None
+
+
+def test_delete_and_recreate_resets_create_revision(store):
+    # store_test.rs:212-218: re-create after delete resets create_rev and
+    # version.
+    r1 = store.put(K, b"v1")
+    store.delete(K)
+    r3 = store.put(K, b"v2")
+    kv = store.get(K)
+    assert kv.create_revision == r3 != r1
+    assert kv.version == 1
+
+
+def test_delete_missing_is_noop(store):
+    rev_before = store.current_revision
+    rev, deleted = store.delete(K)
+    assert not deleted
+    assert store.current_revision == rev_before
+
+
+def test_historical_read_sees_deleted_key(store):
+    r1 = store.put(K, b"v1")
+    store.delete(K)
+    assert store.get(K) is None
+    assert store.get(K, revision=r1).value == b"v1"
+
+
+def test_future_revision_errors(store):
+    store.put(K, b"v1")
+    with pytest.raises(FutureRevError):
+        store.range(K, revision=store.current_revision + 1)
+
+
+# ---- CAS (store_test.rs Txn semantics) -----------------------------------
+
+
+def test_cas_by_mod_revision(store):
+    rev = store.put(K, b"v1")
+    ok, new_rev, _ = store.cas(K, b"v2", required_mod=rev)
+    assert ok and new_rev > rev
+    # Stale revision fails and returns the current KV.
+    ok, latest, cur = store.cas(K, b"v3", required_mod=rev)
+    assert not ok
+    assert latest == store.current_revision
+    assert cur.value == b"v2"
+
+
+def test_cas_create_only(store):
+    # mod_revision 0 compare == "key must not exist" (the k8s Create Txn).
+    ok, _, _ = store.cas(K, b"v1", required_mod=0)
+    assert ok
+    ok, _, cur = store.cas(K, b"v1b", required_mod=0)
+    assert not ok
+    assert cur.value == b"v1"
+
+
+def test_cas_by_version(store):
+    store.put(K, b"v1")
+    ok, _, _ = store.cas(K, b"v2", required_version=1)
+    assert ok
+    ok, _, _ = store.cas(K, b"v3", required_version=1)
+    assert not ok
+
+
+def test_cas_delete(store):
+    rev = store.put(K, b"v1")
+    ok, _, _ = store.cas(K, None, required_mod=rev)
+    assert ok
+    assert store.get(K) is None
+
+
+def test_cas_on_deleted_key_compares_zero(store):
+    store.put(K, b"v1")
+    store.delete(K)
+    ok, _, _ = store.cas(K, b"v2", required_mod=0)
+    assert ok
+
+
+# ---- ranges (store_test.rs + kv_service_test.rs) --------------------------
+
+
+def _fill_nodes(store, n=10):
+    revs = []
+    for i in range(n):
+        revs.append(store.put(NODE_PREFIX + f"node-{i:03d}".encode(), b"x" * 8))
+    return revs
+
+
+def test_prefix_range_sorted(store):
+    _fill_nodes(store, 10)
+    store.put(b"/registry/pods/default/p", b"y")  # different prefix
+    res = store.range(NODE_PREFIX, prefix_end(NODE_PREFIX))
+    assert len(res.kvs) == 10
+    keys = [kv.key for kv in res.kvs]
+    assert keys == sorted(keys)
+    assert res.count == 10
+    assert not res.more
+
+
+def test_range_limit_and_count(store):
+    _fill_nodes(store, 10)
+    res = store.range(NODE_PREFIX, prefix_end(NODE_PREFIX), limit=3)
+    assert len(res.kvs) == 3
+    assert res.count == 10
+    assert res.more
+
+
+def test_range_count_only(store):
+    _fill_nodes(store, 10)
+    res = store.range(NODE_PREFIX, prefix_end(NODE_PREFIX), count_only=True)
+    assert res.count == 10
+    assert res.kvs == []
+
+
+def test_range_keys_only(store):
+    _fill_nodes(store, 3)
+    res = store.range(NODE_PREFIX, prefix_end(NODE_PREFIX), keys_only=True)
+    assert all(kv.value == b"" for kv in res.kvs)
+    assert len(res.kvs) == 3
+
+
+def test_bounded_range_exclusive_end(store):
+    _fill_nodes(store, 5)
+    res = store.range(NODE_PREFIX + b"node-001", NODE_PREFIX + b"node-003")
+    assert [kv.key for kv in res.kvs] == [
+        NODE_PREFIX + b"node-001",
+        NODE_PREFIX + b"node-002",
+    ]
+
+
+def test_cross_prefix_range(store):
+    # A deliberate capability beyond the reference (its per-Kind trees
+    # reject cross-Kind ranges, reference store.rs:590-675).
+    _fill_nodes(store, 2)
+    store.put(b"/registry/pods/default/p", b"y")
+    res = store.range(b"/registry/", prefix_end(b"/registry/"))
+    assert len(res.kvs) == 3
+
+
+def test_historical_range_includes_later_deleted_keys(store):
+    _fill_nodes(store, 3)
+    rev = store.current_revision
+    store.delete(NODE_PREFIX + b"node-001")
+    now = store.range(NODE_PREFIX, prefix_end(NODE_PREFIX))
+    assert len(now.kvs) == 2
+    old = store.range(NODE_PREFIX, prefix_end(NODE_PREFIX), revision=rev)
+    assert len(old.kvs) == 3
+
+
+# ---- compaction -----------------------------------------------------------
+
+
+def test_compact_basic(store):
+    r1 = store.put(K, b"v1")
+    store.put(K, b"v2")
+    r3 = store.put(K, b"v3")
+    store.compact(r3)
+    with pytest.raises(CompactedError):
+        store.range(K, revision=r1)
+    assert store.get(K).value == b"v3"
+
+
+def test_compact_preserves_values_live_at_compact_rev(store):
+    # Key written before the compact revision, unmodified since: reads at
+    # rev >= compact_rev must still see it (etcd keeps non-superseded
+    # versions; the reference can lose these, see memstore.cc header).
+    store.put(K, b"stable")
+    r_marker = store.put(K2, b"x1")
+    store.put(K2, b"x2")
+    store.compact(store.current_revision)
+    res = store.get(K, revision=store.current_revision)
+    assert res.value == b"stable"
+    del r_marker
+
+
+def test_compact_value_superseded_then_modified_later(store):
+    r1 = store.put(K, b"v1")
+    r2 = store.put(K, b"v2")
+    store.put(K2, b"pad")
+    store.compact(store.current_revision)
+    r4 = store.put(K, b"v3")
+    # v2 was live at compact time and must survive for reads in [C, r4).
+    assert store.get(K, revision=r4 - 1).value == b"v2"
+    assert store.get(K).value == b"v3"
+    del r1, r2
+
+
+def test_compact_errors(store):
+    store.put(K, b"v1")
+    store.compact(store.current_revision)
+    with pytest.raises(CompactedError):
+        store.compact(1)
+    with pytest.raises(FutureRevError):
+        store.compact(store.current_revision + 10)
+
+
+def test_tombstone_gc_at_compaction(store):
+    store.put(K, b"v1")
+    store.delete(K)
+    keys_before = store.num_keys
+    store.compact(store.current_revision)
+    # Key count metric unchanged (already decremented at delete), but the
+    # tombstone row is gone: a re-create behaves like a fresh key.
+    rev = store.put(K, b"v2")
+    kv = store.get(K)
+    assert kv.create_revision == rev and kv.version == 1
+    assert store.num_keys == keys_before + 1
+
+
+# ---- watches (watch_test.rs) ---------------------------------------------
+
+
+def test_watch_live_events(store):
+    w = store.watch(NODE_PREFIX, prefix_end(NODE_PREFIX))
+    assert w.poll() == []
+    store.put(NODE_PREFIX + b"n1", b"v1")
+    store.delete(NODE_PREFIX + b"n1")
+    evs = w.poll()
+    assert [e.type for e in evs] == ["PUT", "DELETE"]
+    assert evs[0].kv.value == b"v1"
+    assert evs[1].kv.key == NODE_PREFIX + b"n1"
+    assert evs[1].kv.value == b""
+    # Revision-ordered.
+    assert evs[0].kv.mod_revision < evs[1].kv.mod_revision
+
+
+def test_watch_past_replay_from_revision(store):
+    r1 = store.put(K, b"v1")
+    store.put(K, b"v2")
+    w = store.watch(K, start_revision=r1)
+    evs = w.poll()
+    assert [e.kv.value for e in evs] == [b"v1", b"v2"]
+    assert [e.kv.mod_revision for e in evs] == [r1, r1 + 1]
+
+
+def test_watch_single_key_ignores_others(store):
+    w = store.watch(K)
+    store.put(K2, b"other")
+    store.put(K, b"mine")
+    evs = w.poll()
+    assert len(evs) == 1
+    assert evs[0].kv.key == K
+
+
+def test_watch_future_revision_suppresses_earlier_events(store):
+    # Watch starting at a future revision only sees events >= it
+    # (watch_test.rs future-revision watches).
+    target = store.current_revision + 2
+    w = store.watch(K, start_revision=target)
+    store.put(K, b"early")      # rev = target - 1
+    store.put(K, b"on-time")    # rev = target
+    evs = w.poll()
+    assert [e.kv.value for e in evs] == [b"on-time"]
+
+
+def test_watch_at_compacted_revision_errors(store):
+    store.put(K, b"v1")
+    store.put(K, b"v2")
+    store.compact(store.current_revision)
+    with pytest.raises(CompactedError) as ei:
+        store.watch(K, start_revision=1)
+    assert ei.value.compact_revision == store.compact_revision
+
+
+def test_watch_prev_kv(store):
+    store.put(K, b"v1")
+    w = store.watch(K, prev_kv=True)
+    store.put(K, b"v2")
+    store.delete(K)
+    evs = w.poll()
+    assert evs[0].prev_kv.value == b"v1"
+    assert evs[1].type == "DELETE"
+    assert evs[1].prev_kv.value == b"v2"
+
+
+def test_watch_prev_kv_across_start_revision(store):
+    # watch_service_test.rs:372-425: the replayed event's prev_kv comes
+    # from *before* the start revision.
+    store.put(K, b"v1")
+    r2 = store.put(K, b"v2")
+    w = store.watch(K, start_revision=r2, prev_kv=True)
+    evs = w.poll()
+    assert evs[0].kv.value == b"v2"
+    assert evs[0].prev_kv.value == b"v1"
+
+
+def test_watch_cancel(store):
+    w = store.watch(K)
+    w.cancel()
+    store.put(K, b"v1")
+    assert w.poll() == []
+    assert w.canceled
+
+
+def test_watch_batching(store):
+    w = store.watch(NODE_PREFIX, prefix_end(NODE_PREFIX))
+    for i in range(25):
+        store.put(NODE_PREFIX + f"n{i:02d}".encode(), b"v")
+    first = w.poll(max_events=10)
+    assert len(first) == 10
+    rest = w.poll(max_events=1000)
+    assert len(rest) == 15
+
+
+# ---- WAL checkpoint/resume (RUNNING.adoc:68-111) --------------------------
+
+
+def test_wal_persist_and_replay(tmp_path):
+    wal = str(tmp_path / "wal")
+    with MemStore(wal_dir=wal, wal_mode="buffered") as s:
+        s.put(K, b"v1")
+        s.put(K2, b"other")
+        s.put(K, b"v2")
+        s.delete(K2)
+        s.wal_sync()
+    with MemStore(wal_dir=wal, wal_mode="buffered") as s:
+        assert s.get(K).value == b"v2"
+        assert s.get(K2) is None
+        kv = s.get(K)
+        assert kv.version == 2
+
+
+def test_wal_fsync_mode(tmp_path):
+    wal = str(tmp_path / "wal")
+    with MemStore(wal_dir=wal, wal_mode="fsync") as s:
+        for i in range(50):
+            s.put(K, b"v%d" % i)
+    with MemStore(wal_dir=wal, wal_mode="fsync") as s:
+        assert s.get(K).value == b"v49"
+
+
+def test_wal_no_write_prefix(tmp_path):
+    wal = str(tmp_path / "wal")
+    with MemStore(
+        wal_dir=wal, wal_mode="buffered",
+        no_write_prefixes=("/registry/leases/",),
+    ) as s:
+        s.put(b"/registry/leases/kube-node-lease/n1", b"lease")
+        s.put(K, b"durable")
+        s.wal_sync()
+    with MemStore(wal_dir=wal, wal_mode="buffered") as s:
+        assert s.get(K).value == b"durable"
+        assert s.get(b"/registry/leases/kube-node-lease/n1") is None
+
+
+def test_wal_per_prefix_files(tmp_path):
+    wal = str(tmp_path / "wal")
+    with MemStore(wal_dir=wal, wal_mode="buffered") as s:
+        s.put(b"/registry/pods/default/a", b"1")
+        s.put(b"/registry/minions/n1", b"2")
+        s.wal_sync()
+    files = [f for f in os.listdir(wal) if f.endswith(".wal")]
+    assert len(files) == 2  # one per /registry/<kind>/ prefix
+
+
+# ---- stats ---------------------------------------------------------------
+
+
+def test_stats(store):
+    _fill_nodes(store, 4)
+    store.put(b"/registry/pods/default/p", b"yy")
+    st = store.stats()
+    assert st["keys"] == store.num_keys == 6  # 4 nodes + 1 pod + dummy "~"
+    assert st["prefixes"]["/registry/minions/"]["keys"] == 4
+    assert st["revision"] == store.current_revision
+    assert store.db_size > 0

@@ -147,7 +147,7 @@ def test_sharded_constraints_match_single_device():
     cons = empty_constraints(SPEC)
 
     mesh = make_mesh(dp=2, sp=4)
-    step = make_sharded_step(mesh, PROFILE, chunk=4, k=4, with_constraints=True)
+    step = make_sharded_step(mesh, PROFILE, chunk=4, k=4)
     batch = enc.encode(pods)
     t2, cons2, asg = step(table, batch, jax.random.key(0), cons)
     assert int(np.asarray(asg.bound).sum()) == 8

@@ -1,0 +1,8 @@
+from k8s1m_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    AlertingHistogram,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)

@@ -1,0 +1,40 @@
+"""/metrics HTTP endpoint (the reference serves one per component —
+mem_etcd's axum server on --metrics-port, reference main.rs:83-101)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k8s1m_tpu.obs.metrics import REGISTRY
+
+
+def start_metrics_server(
+    port: int, host: str = "127.0.0.1", extra=None
+) -> ThreadingHTTPServer:
+    """Serve REGISTRY (plus an optional extra text producer) on /metrics.
+
+    Runs in a daemon thread; returns the server (``.server_port`` for
+    port=0 auto-assignment, ``.shutdown()`` to stop).
+    """
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = REGISTRY.render()
+            if extra is not None:
+                body += extra()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.end_headers()
+            self.wfile.write(body.encode())
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -1,0 +1,230 @@
+"""Prometheus-style metrics, self-contained (no client library).
+
+Every component of the reference exposes Prometheus metrics (reference
+mem_etcd/src/metrics.rs:50-209, dist-scheduler
+cmd/dist-scheduler/scheduler_metrics.go:78-190); this module is the
+framework-wide equivalent: counters, gauges, histograms with labels,
+rendered in the Prometheus text exposition format by ``Registry.render``
+and served by ``k8s1m_tpu.obs.http.start_metrics_server``.
+
+``AlertingHistogram`` reproduces the reference's ``AlertingHistogramTimer``
+(mem_etcd/src/store.rs:883-907): any observation over the alert threshold
+is logged immediately, so slow ops surface without a dashboard.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import threading
+import time
+from contextlib import contextmanager
+
+log = logging.getLogger("k8s1m.metrics")
+
+# Exponential latency buckets: 10us .. ~160s.
+DEFAULT_BUCKETS = tuple(1e-5 * (2**i) for i in range(24))
+
+
+def _label_str(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...] = (),
+                 registry: "Registry | None" = None):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        (registry if registry is not None else REGISTRY).register(self)
+
+    def _key(self, labels: dict[str, str]) -> tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, want {self.labelnames}"
+            )
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def render(self) -> list[str]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lbl = _label_str(dict(zip(self.labelnames, key)))
+                out.append(f"{self.name}{lbl} {v}")
+        return out
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._values: dict[tuple, float] = {}
+        self._callbacks: dict[tuple, object] = {}
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = float(v)
+
+    def set_function(self, fn, **labels) -> None:
+        """Gauge computed at scrape time (e.g. store.num_keys)."""
+        with self._lock:
+            self._callbacks[self._key(labels)] = fn
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + n
+
+    def dec(self, n: float = 1.0, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def value(self, **labels) -> float:
+        key = self._key(labels)
+        if key in self._callbacks:
+            return float(self._callbacks[key]())
+        return self._values.get(key, 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        with self._lock:
+            items = dict(self._values)
+            for key, fn in self._callbacks.items():
+                try:
+                    items[key] = float(fn())
+                except Exception:  # scrape must not die with the callback
+                    continue
+        for key, v in sorted(items.items()):
+            lbl = _label_str(dict(zip(self.labelnames, key)))
+            out.append(f"{self.name}{lbl} {v}")
+        return out
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, labelnames: tuple[str, ...] = (),
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+                 registry: "Registry | None" = None):
+        super().__init__(name, help, labelnames, registry)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = self._key(labels)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            if key not in self._counts:
+                self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+                self._totals[key] = 0
+            self._counts[key][i] += 1
+            self._sums[key] += v
+            self._totals[key] += 1
+
+    @contextmanager
+    def time(self, **labels):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0, **labels)
+
+    def quantile(self, q: float, **labels) -> float:
+        """Approximate quantile from bucket upper bounds (test/bench helper)."""
+        key = self._key(labels)
+        with self._lock:
+            counts = list(self._counts.get(key, []))
+            total = self._totals.get(key, 0)
+        if not total:
+            return 0.0
+        target = q * total
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for key in sorted(self._counts):
+                base = dict(zip(self.labelnames, key))
+                cum = 0
+                for i, ub in enumerate(self.buckets):
+                    cum += self._counts[key][i]
+                    lbl = _label_str({**base, "le": repr(ub)})
+                    out.append(f"{self.name}_bucket{lbl} {cum}")
+                lbl = _label_str({**base, "le": "+Inf"})
+                out.append(f"{self.name}_bucket{lbl} {self._totals[key]}")
+                out.append(f"{self.name}_sum{_label_str(base)} {self._sums[key]}")
+                out.append(f"{self.name}_count{_label_str(base)} {self._totals[key]}")
+        return out
+
+
+class AlertingHistogram(Histogram):
+    """Histogram that logs any observation above ``alert_s`` immediately
+    (reference AlertingHistogramTimer, mem_etcd/src/store.rs:883-907)."""
+
+    def __init__(self, *args, alert_s: float = 0.1, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.alert_s = alert_s
+
+    def observe(self, v: float, **labels) -> None:
+        super().observe(v, **labels)
+        if v > self.alert_s:
+            log.warning("%s%s took %.1fms", self.name, labels or "", v * 1e3)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, m: Metric) -> None:
+        with self._lock:
+            if m.name in self._metrics:
+                raise ValueError(f"duplicate metric {m.name}")
+            self._metrics[m.name] = m
+
+    def get(self, name: str) -> Metric | None:
+        return self._metrics.get(name)
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

@@ -1,0 +1,235 @@
+"""Async etcd v3 client over the hand-authored proto subset.
+
+Used by the load generators (tools/) and tests; the same role the
+reference's stress-client and etcd clientv3 users play
+(reference mem_etcd/stress-client/src/main.rs, etcd-lease-flood/main.go).
+Works against any etcd v3 server, not just ours — the wire format is the
+public one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+from grpc import aio
+
+from k8s1m_tpu.store.native import prefix_end
+from k8s1m_tpu.store.proto import rpc_pb2
+
+_M = "etcdserverpb"
+
+
+@dataclasses.dataclass
+class WatchBatch:
+    events: list          # list[mvcc_pb2.Event]
+    revision: int         # header revision of the response
+    compact_revision: int = 0
+    created: bool = False
+    canceled: bool = False
+
+
+class EtcdClient:
+    def __init__(self, target: str, channel: aio.Channel | None = None):
+        self.channel = channel or aio.insecure_channel(target)
+        c = self.channel
+        pb = rpc_pb2
+
+        def u(svc, name, req, resp):
+            return c.unary_unary(
+                f"/{_M}.{svc}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+
+        self._range = u("KV", "Range", pb.RangeRequest, pb.RangeResponse)
+        self._put = u("KV", "Put", pb.PutRequest, pb.PutResponse)
+        self._delete = u("KV", "DeleteRange", pb.DeleteRangeRequest, pb.DeleteRangeResponse)
+        self._txn = u("KV", "Txn", pb.TxnRequest, pb.TxnResponse)
+        self._compact = u("KV", "Compact", pb.CompactionRequest, pb.CompactionResponse)
+        self._lease_grant = u("Lease", "LeaseGrant", pb.LeaseGrantRequest, pb.LeaseGrantResponse)
+        self._lease_revoke = u("Lease", "LeaseRevoke", pb.LeaseRevokeRequest, pb.LeaseRevokeResponse)
+        self._status = u("Maintenance", "Status", pb.StatusRequest, pb.StatusResponse)
+        self._watch_stream = c.stream_stream(
+            f"/{_M}.Watch/Watch",
+            request_serializer=pb.WatchRequest.SerializeToString,
+            response_deserializer=pb.WatchResponse.FromString,
+        )
+
+    async def close(self):
+        await self.channel.close()
+
+    # ---- KV ------------------------------------------------------------
+
+    async def put(self, key: bytes, value: bytes, lease: int = 0) -> int:
+        resp = await self._put(rpc_pb2.PutRequest(key=key, value=value, lease=lease))
+        return resp.header.revision
+
+    async def get(self, key: bytes):
+        resp = await self._range(rpc_pb2.RangeRequest(key=key))
+        return resp.kvs[0] if resp.kvs else None
+
+    async def range(
+        self,
+        key: bytes,
+        range_end: bytes = b"",
+        *,
+        limit: int = 0,
+        revision: int = 0,
+        count_only: bool = False,
+        keys_only: bool = False,
+    ) -> rpc_pb2.RangeResponse:
+        return await self._range(
+            rpc_pb2.RangeRequest(
+                key=key,
+                range_end=range_end,
+                limit=limit,
+                revision=revision,
+                count_only=count_only,
+                keys_only=keys_only,
+            )
+        )
+
+    async def prefix(self, prefix: bytes, **kwargs) -> rpc_pb2.RangeResponse:
+        return await self.range(prefix, prefix_end(prefix), **kwargs)
+
+    async def delete(self, key: bytes, range_end: bytes = b"") -> int:
+        resp = await self._delete(
+            rpc_pb2.DeleteRangeRequest(key=key, range_end=range_end)
+        )
+        return resp.deleted
+
+    async def txn_cas(
+        self,
+        key: bytes,
+        value: bytes | None,
+        *,
+        required_mod: int | None = None,
+        required_version: int | None = None,
+        lease: int = 0,
+        want_current_on_failure: bool = True,
+    ) -> rpc_pb2.TxnResponse:
+        """The Kubernetes Txn shape: compare mod/version, put-or-delete."""
+        if (required_mod is None) == (required_version is None):
+            raise ValueError("exactly one of required_mod/required_version")
+        if required_mod is not None:
+            cmp = rpc_pb2.Compare(
+                result=rpc_pb2.Compare.EQUAL,
+                target=rpc_pb2.Compare.MOD,
+                key=key,
+                mod_revision=required_mod,
+            )
+        else:
+            cmp = rpc_pb2.Compare(
+                result=rpc_pb2.Compare.EQUAL,
+                target=rpc_pb2.Compare.VERSION,
+                key=key,
+                version=required_version,
+            )
+        op = rpc_pb2.RequestOp()
+        if value is None:
+            op.request_delete_range.key = key
+        else:
+            op.request_put.key = key
+            op.request_put.value = value
+            op.request_put.lease = lease
+        req = rpc_pb2.TxnRequest(compare=[cmp], success=[op])
+        if want_current_on_failure:
+            fail = rpc_pb2.RequestOp()
+            fail.request_range.key = key
+            req.failure.append(fail)
+        return await self._txn(req)
+
+    async def compact(self, revision: int) -> None:
+        await self._compact(rpc_pb2.CompactionRequest(revision=revision))
+
+    # ---- Lease / Maintenance ------------------------------------------
+
+    async def lease_grant(self, ttl: int) -> int:
+        resp = await self._lease_grant(rpc_pb2.LeaseGrantRequest(TTL=ttl))
+        return resp.ID
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        await self._lease_revoke(rpc_pb2.LeaseRevokeRequest(ID=lease_id))
+
+    async def status(self) -> rpc_pb2.StatusResponse:
+        return await self._status(rpc_pb2.StatusRequest())
+
+    # ---- Watch ---------------------------------------------------------
+
+    def watch(
+        self,
+        key: bytes,
+        range_end: bytes = b"",
+        *,
+        start_revision: int = 0,
+        prev_kv: bool = False,
+    ) -> "WatchSession":
+        return WatchSession(self, key, range_end, start_revision, prev_kv)
+
+
+class WatchSession:
+    """One watch on its own bidi stream; iterate for WatchBatch objects."""
+
+    def __init__(self, client: EtcdClient, key, range_end, start_revision, prev_kv):
+        self._client = client
+        self._req = rpc_pb2.WatchRequest(
+            create_request=rpc_pb2.WatchCreateRequest(
+                key=key,
+                range_end=range_end,
+                start_revision=start_revision,
+                prev_kv=prev_kv,
+            )
+        )
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._call = None
+        self.watch_id = None
+        self.compact_revision = 0
+
+    async def __aenter__(self):
+        self._call = self._client._watch_stream()
+        await self._call.write(self._req)
+        first = await self._call.read()
+        self.watch_id = first.watch_id
+        self.compact_revision = first.compact_revision
+        self.canceled = first.canceled
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.cancel()
+
+    async def cancel(self):
+        if self._call is not None:
+            try:
+                await self._call.write(
+                    rpc_pb2.WatchRequest(
+                        cancel_request=rpc_pb2.WatchCancelRequest(
+                            watch_id=self.watch_id or 0
+                        )
+                    )
+                )
+                await self._call.done_writing()
+            except Exception:
+                pass
+            self._call.cancel()
+            self._call = None
+
+    def _live_call(self):
+        if self._call is None:
+            raise RuntimeError("watch session is closed")
+        return self._call
+
+    async def request_progress(self) -> None:
+        await self._live_call().write(
+            rpc_pb2.WatchRequest(progress_request=rpc_pb2.WatchProgressRequest())
+        )
+
+    async def next(self, timeout: float | None = None) -> WatchBatch:
+        resp = await asyncio.wait_for(self._live_call().read(), timeout)
+        return WatchBatch(
+            events=list(resp.events),
+            revision=resp.header.revision,
+            compact_revision=resp.compact_revision,
+            created=resp.created,
+            canceled=resp.canceled,
+        )

@@ -1,0 +1,60 @@
+"""CLI bootstrap for the etcd-compatible store server.
+
+Mirrors the reference's flags (reference mem_etcd/src/main.rs:60-81):
+
+    python -m k8s1m_tpu.store.server_main \
+        --port 2379 --metrics-port 9000 \
+        --wal-dir /var/lib/memstore --wal-default buffered \
+        --wal-no-write-prefix /registry/leases/
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from k8s1m_tpu.store.etcd_server import serve
+from k8s1m_tpu.store.native import MemStore
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="etcd-compatible in-memory store")
+    ap.add_argument("--port", type=int, default=2379)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--metrics-port", type=int, default=9000)
+    ap.add_argument("--wal-dir", default=None)
+    ap.add_argument(
+        "--wal-default",
+        choices=["none", "buffered", "fsync"],
+        default="buffered",
+    )
+    ap.add_argument(
+        "--wal-no-write-prefix",
+        action="append",
+        default=[],
+        help="prefixes whose writes skip the WAL (e.g. /registry/leases/)",
+    )
+    return ap.parse_args(argv)
+
+
+async def amain(args):
+    store = MemStore(
+        wal_dir=args.wal_dir,
+        wal_mode=args.wal_default,
+        no_write_prefixes=tuple(args.wal_no_write_prefix),
+    )
+    server, port = await serve(
+        store, port=args.port, host=args.host, metrics_port=args.metrics_port
+    )
+    logging.info("memstore serving etcd API on :%d (metrics :%d)", port, args.metrics_port)
+    await server.wait_for_termination()
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(amain(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,259 @@
+"""gRPC-level tests of the etcd wire layer, mirroring the reference's
+kv_service_test.rs / watch_service_test.rs coverage (Put/Range/limit+count/
+Txn success+failure/Compaction; watch created msg, past batch, live events,
+compact_revision response, prev_kv)."""
+
+import asyncio
+
+import grpc
+import pytest
+
+from k8s1m_tpu.store.etcd_client import EtcdClient
+from k8s1m_tpu.store.etcd_server import serve
+from k8s1m_tpu.store.native import MemStore, prefix_end
+from k8s1m_tpu.store.proto import mvcc_pb2, rpc_pb2
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture()
+def env(loop):
+    """(client, store) against a live in-process server on a random port."""
+    store = MemStore()
+    server, client = loop.run_until_complete(_start(store))
+    yield loop, client, store
+    loop.run_until_complete(client.close())
+    loop.run_until_complete(server.stop(None))
+    store.close()
+
+
+async def _start(store):
+    server, port = await serve(store, port=0)
+    client = EtcdClient(f"127.0.0.1:{port}")
+    return server, client
+
+
+def test_revisions_start_at_one_like_etcd(env):
+    loop, client, store = env
+    # The dummy "~" boot key (reference main.rs:103-104) makes the first
+    # header revision 1 even on an empty store.
+    status = loop.run_until_complete(client.status())
+    assert status.header.revision == 1
+    assert status.version == "3.5.16"
+
+
+def test_put_get_roundtrip_and_header_revision(env):
+    loop, client, _ = env
+
+    async def go():
+        r1 = await client.put(b"/registry/pods/default/a", b"v1")
+        r2 = await client.put(b"/registry/pods/default/a", b"v2")
+        assert r2 == r1 + 1
+        kv = await client.get(b"/registry/pods/default/a")
+        assert kv.value == b"v2"
+        assert kv.mod_revision == r2
+        assert kv.create_revision == r1
+        assert kv.version == 2
+
+    loop.run_until_complete(go())
+
+
+def test_range_limit_count_keysonly(env):
+    loop, client, _ = env
+
+    async def go():
+        for i in range(10):
+            await client.put(b"/registry/pods/ns/p%03d" % i, b"x" * 10)
+        resp = await client.prefix(b"/registry/pods/", limit=3)
+        assert len(resp.kvs) == 3 and resp.more
+        assert resp.count == 10
+        assert resp.kvs[0].key == b"/registry/pods/ns/p000"
+        ko = await client.prefix(b"/registry/pods/", keys_only=True)
+        assert all(kv.value == b"" for kv in ko.kvs) and len(ko.kvs) == 10
+        co = await client.prefix(b"/registry/pods/", count_only=True)
+        assert co.count == 10 and not co.kvs
+
+    loop.run_until_complete(go())
+
+
+def test_txn_cas_success_and_failure(env):
+    loop, client, _ = env
+
+    async def go():
+        # Create: compare mod_revision == 0.
+        resp = await client.txn_cas(b"/registry/pods/ns/p", b"v1", required_mod=0)
+        assert resp.succeeded
+        rev1 = resp.header.revision
+        # Conflicting create fails and returns the current kv in the
+        # failure Range (the shape kube-apiserver relies on).
+        resp = await client.txn_cas(b"/registry/pods/ns/p", b"v2", required_mod=0)
+        assert not resp.succeeded
+        assert resp.responses[0].response_range.kvs[0].value == b"v1"
+        assert resp.responses[0].response_range.kvs[0].mod_revision == rev1
+        # Update at the right revision succeeds.
+        resp = await client.txn_cas(b"/registry/pods/ns/p", b"v2", required_mod=rev1)
+        assert resp.succeeded
+        # CAS-delete via VERSION compare.
+        resp = await client.txn_cas(b"/registry/pods/ns/p", None, required_version=2)
+        assert resp.succeeded
+        assert (await client.get(b"/registry/pods/ns/p")) is None
+
+    loop.run_until_complete(go())
+
+
+def test_txn_rejects_non_kubernetes_shapes(env):
+    loop, client, _ = env
+
+    async def go():
+        # Two success ops -> InvalidArgument (reference kv_service.rs
+        # rejects anything but the single-op shape).
+        op1, op2 = rpc_pb2.RequestOp(), rpc_pb2.RequestOp()
+        op1.request_put.key = b"k"
+        op2.request_put.key = b"k"
+        req = rpc_pb2.TxnRequest(
+            compare=[
+                rpc_pb2.Compare(
+                    result=rpc_pb2.Compare.EQUAL,
+                    target=rpc_pb2.Compare.MOD,
+                    key=b"k",
+                    mod_revision=0,
+                )
+            ],
+            success=[op1, op2],
+        )
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await client._txn(req)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    loop.run_until_complete(go())
+
+
+def test_compaction_errors_over_wire(env):
+    loop, client, _ = env
+
+    async def go():
+        for i in range(5):
+            await client.put(b"/registry/x", b"%d" % i)
+        await client.compact(4)
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await client.range(b"/registry/x", revision=2)
+        assert ei.value.code() == grpc.StatusCode.OUT_OF_RANGE
+        assert "compacted" in ei.value.details()
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await client.range(b"/registry/x", revision=10**9)
+        assert "future" in ei.value.details()
+
+    loop.run_until_complete(go())
+
+
+def test_delete_range_over_wire(env):
+    loop, client, _ = env
+
+    async def go():
+        for i in range(4):
+            await client.put(b"/registry/leases/ns/l%d" % i, b"x")
+        n = await client.delete(
+            b"/registry/leases/", prefix_end(b"/registry/leases/")
+        )
+        assert n == 4
+        resp = await client.prefix(b"/registry/leases/")
+        assert resp.count == 0
+
+    loop.run_until_complete(go())
+
+
+def test_watch_stream_protocol(env):
+    loop, client, _ = env
+
+    async def go():
+        rev0 = await client.put(b"/registry/pods/ns/before", b"old")
+        async with client.watch(
+            b"/registry/pods/", prefix_end(b"/registry/pods/"),
+            start_revision=rev0, prev_kv=True,
+        ) as w:
+            # Past-changes batch first (reference watch_service.rs:119-146).
+            batch = await w.next(timeout=5)
+            assert [e.kv.key for e in batch.events] == [b"/registry/pods/ns/before"]
+            # Live events, in revision order, PUT then DELETE with prev_kv.
+            await client.put(b"/registry/pods/ns/a", b"v1")
+            await client.put(b"/registry/pods/ns/a", b"v2")
+            await client.delete(b"/registry/pods/ns/a")
+            got = []
+            while len(got) < 3:
+                batch = await w.next(timeout=5)
+                got.extend(batch.events)
+            assert [e.type for e in got] == [
+                mvcc_pb2.Event.PUT, mvcc_pb2.Event.PUT, mvcc_pb2.Event.DELETE,
+            ]
+            assert got[1].prev_kv.value == b"v1"
+            revs = [e.kv.mod_revision for e in got]
+            assert revs == sorted(revs)
+
+    loop.run_until_complete(go())
+
+
+def test_watch_compacted_start_revision(env):
+    loop, client, _ = env
+
+    async def go():
+        for i in range(5):
+            await client.put(b"/registry/x", b"%d" % i)
+        await client.compact(5)
+        async with client.watch(b"/registry/x", start_revision=2) as w:
+            # Response with compact_revision set (watch_service.rs:63-75).
+            assert w.compact_revision == 5
+
+    loop.run_until_complete(go())
+
+
+def test_watch_progress_request(env):
+    loop, client, _ = env
+
+    async def go():
+        async with client.watch(b"/registry/pods/") as w:
+            rev = await client.put(b"/registry/other", b"x")
+            await w.request_progress()
+            batch = await w.next(timeout=5)
+            assert not batch.events
+            assert batch.revision >= rev
+
+    loop.run_until_complete(go())
+
+
+def test_lease_fake_semantics(env):
+    loop, client, _ = env
+
+    async def go():
+        # Incrementing ids, never expire (reference lease_service.rs:33-137).
+        l1 = await client.lease_grant(10)
+        l2 = await client.lease_grant(10)
+        assert l2 == l1 + 1
+        await client.put(b"/registry/events/ns/e1", b"x", lease=l1)
+        kv = await client.get(b"/registry/events/ns/e1")
+        assert kv.lease == l1
+        await client.lease_revoke(l1)
+        # Revocation does NOT delete keys — leases are fake.
+        assert (await client.get(b"/registry/events/ns/e1")) is not None
+
+    loop.run_until_complete(go())
+
+
+def test_unimplemented_maintenance_like_reference(env):
+    loop, client, _ = env
+
+    async def go():
+        hash_call = client.channel.unary_unary(
+            "/etcdserverpb.Maintenance/Hash",
+            request_serializer=rpc_pb2.HashRequest.SerializeToString,
+            response_deserializer=rpc_pb2.HashResponse.FromString,
+        )
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await hash_call(rpc_pb2.HashRequest())
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+    loop.run_until_complete(go())

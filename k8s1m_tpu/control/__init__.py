@@ -1,0 +1,9 @@
+from k8s1m_tpu.control.objects import (  # noqa: F401
+    decode_node,
+    decode_pod,
+    encode_node,
+    encode_pod,
+    lease_key,
+    node_key,
+    pod_key,
+)

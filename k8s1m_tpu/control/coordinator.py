@@ -1,0 +1,510 @@
+"""The host coordinator: store watches -> snapshot deltas -> TPU cycle -> binds.
+
+This is the process the reference runs as dist-scheduler (289 replicas of
+it): watch nodes and pods, keep a node cache current, schedule pending
+pods, write binds back (reference SURVEY.md §3.2).  Here one coordinator
+drives the whole cluster:
+
+- **Intake** — a store watch on /registry/pods/ replaces both intake paths
+  of the reference (the ValidatingWebhook and the fieldSelector pod watch,
+  reference pkg/webhook/webhook.go:71-126, cmd/dist-scheduler/pod_watcher.go:20-71):
+  every Pending pod with schedulerName=dist-scheduler enters the queue.
+- **Node cache** — a watch on /registry/minions/ streams adds/updates/
+  removes into NodeTableHost and scatters compiled rows to the device
+  table (the informer-cache equivalent, reference scheduler.go:201-219).
+  Bound-pod resource accounting is folded in the same way a scheduler
+  cache assumes pods.
+- **Cycle** — pending pods are drained in batches of PodSpec.batch, padded,
+  encoded, and run through engine.schedule_batch; winners are written back
+  as spec.nodeName via Txn CAS on the pod's mod revision — the optimistic
+  concurrency of the reference's DefaultBinder (conflict -> pod re-queued,
+  reference README.adoc:558-560).
+- **Ordering** — watch events are applied in revision order (the native
+  store's watch dispatch is revision-ordered by construction, like
+  mem_etcd's notify thread, reference store.rs:444-533), and binds are
+  CAS-guarded, so a concurrent pod update between intake and bind loses
+  nothing: the CAS fails and the newer pod revision re-enters via watch.
+
+A pod whose bind CAS fails or that finds no feasible node is retried up to
+``max_attempts`` times (the reference admits first-attempt failures are
+not reliably retried, reference RUNNING.adoc:206 — this does better).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import time
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.objects import (
+    decode_node,
+    decode_pod,
+    node_key,
+    pod_key,
+)
+from k8s1m_tpu.engine.cycle import (
+    adjust_constraints,
+    commit_fields_of,
+    schedule_batch,
+)
+from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram
+from k8s1m_tpu.obs.trace import FlightRecorder
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
+from k8s1m_tpu.snapshot.node_table import NodeTableHost
+from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
+from k8s1m_tpu.store.native import MemStore, Watcher, prefix_end
+
+log = logging.getLogger("k8s1m.coordinator")
+
+NODES_PREFIX = b"/registry/minions/"
+PODS_PREFIX = b"/registry/pods/"
+
+_PODS_SCHEDULED = Counter(
+    "coordinator_pods_scheduled_total", "Pods bound, by outcome", ("outcome",)
+)
+_CYCLE_TIME = Histogram(
+    "coordinator_cycle_seconds", "Scheduling cycle latency by stage", ("stage",)
+)
+_QUEUE_DEPTH = Gauge("coordinator_queue_depth", "Pending pods queued", ())
+_NODE_COUNT = Gauge("coordinator_node_count", "Nodes in the snapshot", ())
+_BIND_LATENCY = Histogram(
+    "coordinator_schedule_to_bind_seconds",
+    "Intake-to-bind latency per pod",
+    (),
+)
+
+
+@dataclasses.dataclass
+class PendingPod:
+    pod: PodInfo
+    mod_revision: int
+    enqueued_at: float
+    attempts: int = 0
+
+
+class Coordinator:
+    """Single-process scheduling coordinator over an in-process store."""
+
+    def __init__(
+        self,
+        store: MemStore,
+        table_spec: TableSpec,
+        pod_spec: PodSpec,
+        profile: Profile,
+        *,
+        chunk: int = 16384,
+        k: int = 4,
+        with_constraints: bool = True,
+        max_attempts: int = 5,
+        scheduler_name: str = "dist-scheduler",
+        seed: int = 0,
+        flight_recorder: FlightRecorder | None = None,
+    ):
+        self.store = store
+        self.table_spec = table_spec
+        self.pod_spec = pod_spec
+        self.profile = profile
+        self.chunk = chunk
+        self.k = k
+        self.max_attempts = max_attempts
+        self.scheduler_name = scheduler_name
+        self.flight = flight_recorder
+
+        self.host = NodeTableHost(table_spec)
+        self.tracker = ConstraintTracker(table_spec)
+        self.encoder = PodBatchHost(pod_spec, table_spec, self.host.vocab)
+        self.table = None           # device NodeTable, built lazily
+        self.constraints = (
+            empty_constraints(table_spec) if with_constraints else None
+        )
+        self.key = jax.random.key(seed)
+
+        self.queue: collections.deque[PendingPod] = collections.deque()
+        self._queued_keys: set[str] = set()
+        # Bound-pod record per pod key: (node, cpu, mem, zone, region, pod?).
+        # The PodInfo is retained only for constraint-carrying pods — it is
+        # needed to decrement count tables on deletion; plain pods stay
+        # compact (the 1M-pod case must not hold 1M PodInfos).
+        self._bound: dict[str, tuple] = {}
+        # Constraint-count corrections awaiting a batched device scatter:
+        # (pod, node_name, zone, region, sign).  sign=+1 for externally
+        # bound pods entering the snapshot, -1 for deletions.
+        self._pending_adjusts: list[tuple[PodInfo, str, int, int, int]] = []
+        # Bound pods whose node is not in the snapshot yet (bootstrap
+        # list/watch interleaving); accounted when the node arrives.
+        self._orphan_bound: dict[str, PodInfo] = {}
+        self._dirty_rows: set[int] = set()
+        self._nodes_watch: Watcher | None = None
+        self._pods_watch: Watcher | None = None
+        self.unschedulable: dict[str, PodInfo] = {}
+
+        # weakref so module-level gauges never pin a discarded Coordinator
+        # (and its full node table) in memory.
+        wr = weakref.ref(self)
+        _NODE_COUNT.set_function(lambda: c.host.num_nodes if (c := wr()) else 0)
+        _QUEUE_DEPTH.set_function(lambda: len(c.queue) if (c := wr()) else 0)
+
+    # ---- bootstrap -----------------------------------------------------
+
+    def bootstrap(self) -> None:
+        """List+watch: load current state, then stream deltas from there.
+
+        The watch starts at the list revision + 1, the same
+        resourceVersion handoff kube informers perform.
+        """
+        with _CYCLE_TIME.time(stage="bootstrap"):
+            res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
+            for kv in res.kvs:
+                self.host.upsert(decode_node(kv.value))
+            self._nodes_watch = self.store.watch(
+                NODES_PREFIX, prefix_end(NODES_PREFIX),
+                start_revision=res.revision + 1,
+            )
+            pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
+            for kv in pods.kvs:
+                self._on_pod_put(kv.value, kv.mod_revision)
+            self._pods_watch = self.store.watch(
+                PODS_PREFIX, prefix_end(PODS_PREFIX),
+                start_revision=pods.revision + 1,
+            )
+            self.table = self.host.to_device()
+
+    # ---- watch delta application --------------------------------------
+
+    @staticmethod
+    def _constraintful(pod: PodInfo) -> bool:
+        return bool(
+            pod.spread_incs
+            or pod.ipa_incs
+            or any(r.required and r.anti for r in pod.affinity_refs)
+        )
+
+    def _note_bound(self, pod: PodInfo, node_name: str, *, external: bool) -> None:
+        row = self.host.row_of(node_name)
+        zone, region = int(self.host.zone[row]), int(self.host.region[row])
+        keep = pod if self._constraintful(pod) else None
+        self._bound[pod.key] = (node_name, pod.cpu_milli, pod.mem_kib, zone, region, keep)
+        if external and keep is not None and self.constraints is not None:
+            # An externally bound pod contributes to domain counts exactly
+            # like upstream's cache AddPod feeds plugin pre-state.
+            self._pending_adjusts.append((keep, node_name, zone, region, 1))
+
+    def _on_pod_put(self, data: bytes, mod_revision: int) -> None:
+        pod = decode_pod(data, self.tracker)
+        if pod.node_name:
+            # Someone's bind (ours echoing back, or an external writer):
+            # account it if we haven't already.
+            if pod.key not in self._bound:
+                if pod.node_name in self.host._row_of:
+                    self.host.add_pod(pod.node_name, pod.cpu_milli, pod.mem_kib)
+                    self._dirty_rows.add(self.host.row_of(pod.node_name))
+                    self._note_bound(pod, pod.node_name, external=True)
+                else:
+                    # Bound to a node we have not seen yet (list/watch
+                    # interleaving at bootstrap); account when it arrives.
+                    self._orphan_bound[pod.key] = pod
+            self._queued_keys.discard(pod.key)
+            return
+        if pod.key in self._queued_keys:
+            return
+        self._queued_keys.add(pod.key)
+        self.queue.append(PendingPod(pod, mod_revision, time.perf_counter()))
+
+    def _on_pod_delete(self, key: bytes) -> None:
+        pod_key_str = key[len(PODS_PREFIX):].decode()
+        self._queued_keys.discard(pod_key_str)
+        self._orphan_bound.pop(pod_key_str, None)
+        bound = self._bound.pop(pod_key_str, None)
+        if bound is not None:
+            node_name, cpu, mem, zone, region, keep = bound
+            if node_name in self.host._row_of:
+                self.host.remove_pod(node_name, cpu, mem)
+                self._dirty_rows.add(self.host.row_of(node_name))
+            if keep is not None and self.constraints is not None:
+                self._pending_adjusts.append((keep, node_name, zone, region, -1))
+
+    def _adopt_orphans(self, node_name: str) -> None:
+        for key, pod in list(self._orphan_bound.items()):
+            if pod.node_name == node_name:
+                del self._orphan_bound[key]
+                self.host.add_pod(node_name, pod.cpu_milli, pod.mem_kib)
+                self._dirty_rows.add(self.host.row_of(node_name))
+                self._note_bound(pod, node_name, external=True)
+
+    def drain_watches(self, max_events: int = 10000) -> int:
+        """Apply pending node/pod deltas; returns number of events.
+
+        A watcher that overflowed its native queue (10,000 events) has
+        silently lost deltas — the snapshot would diverge from the store
+        forever.  Detect it and relist, the same way a kube reflector
+        handles 410 Gone.
+        """
+        if self._nodes_watch.dropped or self._pods_watch.dropped:
+            log.warning(
+                "watch overflow (nodes dropped=%d pods dropped=%d); resyncing",
+                self._nodes_watch.dropped, self._pods_watch.dropped,
+            )
+            return self.resync()
+        n = 0
+        with _CYCLE_TIME.time(stage="drain"):
+            for ev in self._nodes_watch.poll(max_events):
+                n += 1
+                if ev.type == "PUT":
+                    node = decode_node(ev.kv.value)
+                    self._dirty_rows.add(self.host.upsert(node))
+                    self._adopt_orphans(node.name)
+                else:
+                    name = ev.kv.key[len(NODES_PREFIX):].decode()
+                    if name in self.host._row_of:
+                        self._dirty_rows.add(self.host.remove(name))
+            for ev in self._pods_watch.poll(max_events):
+                n += 1
+                if ev.type == "PUT":
+                    self._on_pod_put(ev.kv.value, ev.kv.mod_revision)
+                else:
+                    self._on_pod_delete(ev.kv.key)
+        return n
+
+    def resync(self) -> int:
+        """Full relist after watch overflow: reconcile host state against
+        the store and restart both watches from the list revisions."""
+        with _CYCLE_TIME.time(stage="resync"):
+            self._nodes_watch.cancel()
+            self._pods_watch.cancel()
+
+            res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
+            listed = set()
+            for kv in res.kvs:
+                node = decode_node(kv.value)
+                listed.add(node.name)
+                self._dirty_rows.add(self.host.upsert(node))
+            for name in list(self.host._row_of):
+                if name not in listed:
+                    self._dirty_rows.add(self.host.remove(name))
+            self._nodes_watch = self.store.watch(
+                NODES_PREFIX, prefix_end(NODES_PREFIX),
+                start_revision=res.revision + 1,
+            )
+
+            pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
+            seen = set()
+            for kv in pods.kvs:
+                seen.add(kv.key[len(PODS_PREFIX):].decode())
+                self._on_pod_put(kv.value, kv.mod_revision)
+            for key in list(self._bound):
+                if key not in seen:
+                    ns, name = key.split("/", 1)
+                    self._on_pod_delete(pod_key(ns, name))
+            self._orphan_bound = {
+                k: v for k, v in self._orphan_bound.items() if k in seen
+            }
+            self._pods_watch = self.store.watch(
+                PODS_PREFIX, prefix_end(PODS_PREFIX),
+                start_revision=pods.revision + 1,
+            )
+        return len(listed) + len(seen)
+
+    def _sync_table(self) -> None:
+        """Scatter dirty host rows into the device table.
+
+        Row-level apply_delta needs a full NodeTable delta; for host-side
+        simplicity the whole column set for the dirty rows is re-uploaded
+        (tens of bytes per row — cheap at any realistic delta rate).
+        """
+        if self.table is None:
+            self.table = self.host.to_device()
+            self._dirty_rows.clear()
+            return
+        if not self._dirty_rows:
+            return
+        with _CYCLE_TIME.time(stage="sync"):
+            rows = np.fromiter(self._dirty_rows, np.int32)
+            self._dirty_rows.clear()
+            h = self.host
+            delta = {
+                "valid": h.valid[rows], "cpu_alloc": h.cpu_alloc[rows],
+                "mem_alloc": h.mem_alloc[rows], "pods_alloc": h.pods_alloc[rows],
+                "cpu_req": h.cpu_req[rows], "mem_req": h.mem_req[rows],
+                "pods_req": h.pods_req[rows], "label_key": h.label_key[rows],
+                "label_val": h.label_val[rows], "label_num": h.label_num[rows],
+                "taint_id": h.taint_id[rows], "taint_effect": h.taint_effect[rows],
+                "zone": h.zone[rows], "region": h.region[rows],
+                "name_id": h.name_id[rows],
+            }
+            self.table = _scatter_rows(self.table, rows, delta)
+
+    # ---- the cycle -----------------------------------------------------
+
+    def _process_adjusts(self) -> None:
+        """Batch-apply queued constraint-count corrections."""
+        if not self._pending_adjusts or self.constraints is None:
+            return
+        b = self.pod_spec.batch
+        pending, self._pending_adjusts = self._pending_adjusts, []
+        for sign in (1, -1):
+            group = [a for a in pending if a[4] == sign]
+            for off in range(0, len(group), b):
+                chunk = group[off : off + b]
+                batch = self.encoder.encode([g[0] for g in chunk])
+                fields = commit_fields_of(batch)
+                node_row = np.zeros(b, np.int32)
+                zone = np.zeros(b, np.int32)
+                region = np.zeros(b, np.int32)
+                mask_node = np.zeros(b, bool)
+                mask_dom = np.zeros(b, bool)
+                for i, (_, node_name, z, r, _s) in enumerate(chunk):
+                    row = self.host._row_of.get(node_name)
+                    if row is not None:
+                        node_row[i] = row
+                        mask_node[i] = True
+                    zone[i], region[i] = z, r
+                    mask_dom[i] = True
+                self.constraints = adjust_constraints(
+                    self.constraints, fields,
+                    jnp.asarray(node_row), jnp.asarray(zone), jnp.asarray(region),
+                    jnp.asarray(mask_node), jnp.asarray(mask_dom), sign=sign,
+                )
+
+    def step(self) -> int:
+        """One scheduling cycle; returns number of pods bound."""
+        self.drain_watches()
+        self._sync_table()
+        self._process_adjusts()
+        if not self.queue:
+            return 0
+        t_start = time.perf_counter()
+
+        batch_pods: list[PendingPod] = []
+        while self.queue and len(batch_pods) < self.pod_spec.batch:
+            batch_pods.append(self.queue.popleft())
+        for p in batch_pods:
+            self._queued_keys.discard(p.pod.key)
+
+        with _CYCLE_TIME.time(stage="encode"):
+            batch = self.encoder.encode([p.pod for p in batch_pods])
+        self.key, subkey = jax.random.split(self.key)
+        with _CYCLE_TIME.time(stage="device"):
+            self.table, self.constraints, asg = schedule_batch(
+                self.table, batch, subkey,
+                profile=self.profile, constraints=self.constraints,
+                chunk=self.chunk, k=self.k,
+            )
+            node_row = np.asarray(asg.node_row)
+            bound = np.asarray(asg.bound)
+
+        nbound = 0
+        failed = np.zeros(self.pod_spec.batch, bool)
+        with _CYCLE_TIME.time(stage="bind"):
+            for i, p in enumerate(batch_pods):
+                if bound[i]:
+                    name = self.host.vocab.node_names.value(
+                        int(self.host.name_id[node_row[i]])
+                    )
+                    if self._bind(p, name):
+                        nbound += 1
+                        _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
+                        continue
+                    # CAS conflict: the device table already assumed this
+                    # bind (commit_binds), but the host mirror — which is
+                    # authoritative — was never incremented.  Marking the
+                    # row dirty re-uploads the host values, undoing the
+                    # device-side assume; the constraint-count commit is
+                    # rolled back below in one signed scatter.
+                    self._dirty_rows.add(self.host.row_of(name))
+                    failed[i] = True
+                self._retry(p)
+        if failed.any() and self.constraints is not None:
+            m = jnp.asarray(failed)
+            self.constraints = adjust_constraints(
+                self.constraints, commit_fields_of(batch),
+                asg.node_row, asg.zone, asg.region, m, m, sign=-1,
+            )
+
+        if self.flight is not None:
+            self.flight.record(
+                "cycle",
+                time.perf_counter() - t_start,
+                pods=len(batch_pods),
+                bound=nbound,
+                queue=len(self.queue),
+            )
+        return nbound
+
+    def _bind(self, p: PendingPod, node_name: str) -> bool:
+        """CAS spec.nodeName into the pod object; False on conflict."""
+        key = pod_key(p.pod.namespace, p.pod.name)
+        cur = self.store.get(key)
+        if cur is None or cur.mod_revision != p.mod_revision:
+            _PODS_SCHEDULED.inc(outcome="conflict")
+            return False
+        obj = json.loads(cur.value)
+        obj["spec"]["nodeName"] = node_name
+        ok, _, _ = self.store.cas(
+            key,
+            json.dumps(obj, separators=(",", ":")).encode(),
+            required_mod=p.mod_revision,
+        )
+        if not ok:
+            _PODS_SCHEDULED.inc(outcome="conflict")
+            return False
+        # Keep host accounting; the watch echo of our own write is
+        # deduped via _bound.
+        self.host.add_pod(node_name, p.pod.cpu_milli, p.pod.mem_kib)
+        self._note_bound(p.pod, node_name, external=False)
+        _PODS_SCHEDULED.inc(outcome="bound")
+        return True
+
+    def _retry(self, p: PendingPod) -> None:
+        p.attempts += 1
+        if p.attempts >= self.max_attempts:
+            _PODS_SCHEDULED.inc(outcome="unschedulable")
+            self.unschedulable[p.pod.key] = p.pod
+            return
+        _PODS_SCHEDULED.inc(outcome="retry")
+        # Re-read AND re-decode: the CAS may have failed because an external
+        # writer bound the pod (retrying would overwrite their bind and
+        # double-account) or changed its spec (retrying with stale
+        # cpu/mem would overcommit the node).
+        cur = self.store.get(pod_key(p.pod.namespace, p.pod.name))
+        if cur is None:
+            return
+        fresh = decode_pod(cur.value, self.tracker)
+        if fresh.node_name:
+            return  # bound externally; the watch echo handles accounting
+        p.pod = fresh
+        p.mod_revision = cur.mod_revision
+        self._queued_keys.add(p.pod.key)
+        self.queue.append(p)
+
+    def run_until_idle(self, max_cycles: int = 10000) -> int:
+        """Drive cycles until no pending pods remain; returns total binds."""
+        total = 0
+        idle = 0
+        for _ in range(max_cycles):
+            n = self.step()
+            total += n
+            if not self.queue:
+                idle += 1
+                if idle > 1 and self.drain_watches() == 0:
+                    break
+            else:
+                idle = 0
+        return total
+
+
+@jax.jit
+def _scatter_rows(table, rows, delta: dict):
+    updates = {
+        name: getattr(table, name).at[rows].set(arr)
+        for name, arr in delta.items()
+    }
+    return table.replace(**updates)

@@ -1,0 +1,387 @@
+"""Kubernetes-shaped object codec for the control-plane store.
+
+The reference stores real Kubernetes protobuf under /registry/ (written by
+kube-apiserver, reference README.adoc:316-328 for the key layout); this
+framework's control plane stores the same object *shapes* as JSON under
+the same keys, so the store traffic pattern (per-Kind prefixes, Txn CAS
+updates, lease churn) is preserved while staying self-contained.
+
+Key layout (matching kube-apiserver's registry paths):
+- nodes:  /registry/minions/<name>
+- pods:   /registry/pods/<namespace>/<name>
+- leases: /registry/leases/<namespace>/<name>
+
+``decode_pod`` compiles the inline affinity/topologySpreadConstraint
+specs into interned slot references via a ConstraintTracker — the
+host-side half of the feature compiler (SURVEY.md §7 step 1).
+"""
+
+from __future__ import annotations
+
+import json
+
+from k8s1m_tpu.config import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_NONE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    SEL_OP_DOES_NOT_EXIST,
+    SEL_OP_EXISTS,
+    SEL_OP_GT,
+    SEL_OP_IN,
+    SEL_OP_LT,
+    SEL_OP_NOT_IN,
+    SPREAD_DO_NOT_SCHEDULE,
+    SPREAD_SCHEDULE_ANYWAY,
+    TOL_OP_EQUAL,
+    TOL_OP_EXISTS,
+    TOPO_HOSTNAME,
+    TOPO_REGION,
+    TOPO_ZONE,
+)
+from k8s1m_tpu.snapshot.constraints import ConstraintTracker
+from k8s1m_tpu.snapshot.node_table import NodeInfo, Taint
+from k8s1m_tpu.snapshot.pod_encoding import (
+    AffinityTermRef,
+    NodeSelectorTerm,
+    PodInfo,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
+    SpreadConstraintRef,
+    Toleration,
+)
+
+DEFAULT_SCHEDULER = "dist-scheduler"
+
+_EFFECTS = {
+    "": EFFECT_NONE,
+    "NoSchedule": EFFECT_NO_SCHEDULE,
+    "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
+    "NoExecute": EFFECT_NO_EXECUTE,
+}
+_EFFECT_NAMES = {v: k for k, v in _EFFECTS.items()}
+_SEL_OPS = {
+    "In": SEL_OP_IN,
+    "NotIn": SEL_OP_NOT_IN,
+    "Exists": SEL_OP_EXISTS,
+    "DoesNotExist": SEL_OP_DOES_NOT_EXIST,
+    "Gt": SEL_OP_GT,
+    "Lt": SEL_OP_LT,
+}
+_SEL_OP_NAMES = {v: k for k, v in _SEL_OPS.items()}
+_TOPO_KEYS = {
+    "kubernetes.io/hostname": TOPO_HOSTNAME,
+    "topology.kubernetes.io/zone": TOPO_ZONE,
+    "topology.kubernetes.io/region": TOPO_REGION,
+}
+_TOPO_NAMES = {v: k for k, v in _TOPO_KEYS.items()}
+
+_BIN_SUFFIX = {"Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30, "Ti": 1 << 40}
+_DEC_SUFFIX = {"k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12}
+
+
+def node_key(name: str) -> bytes:
+    return f"/registry/minions/{name}".encode()
+
+
+def pod_key(namespace: str, name: str) -> bytes:
+    return f"/registry/pods/{namespace}/{name}".encode()
+
+
+def lease_key(namespace: str, name: str) -> bytes:
+    return f"/registry/leases/{namespace}/{name}".encode()
+
+
+# ---- quantities ------------------------------------------------------------
+
+
+def parse_cpu(q: str | int | float) -> int:
+    """Kubernetes cpu quantity -> milliCPU ("2" -> 2000, "500m" -> 500)."""
+    if isinstance(q, (int, float)):
+        return int(q * 1000)
+    q = q.strip()
+    if q.endswith("m"):
+        return int(q[:-1])
+    return int(float(q) * 1000)
+
+
+def parse_mem(q: str | int | float) -> int:
+    """Kubernetes memory quantity -> KiB ("8Gi" -> 8388608, bare -> bytes)."""
+    if isinstance(q, (int, float)):
+        return int(q) >> 10
+    q = q.strip()
+    for suf, mult in _BIN_SUFFIX.items():
+        if q.endswith(suf):
+            return int(float(q[: -len(suf)]) * mult) >> 10
+    for suf, mult in _DEC_SUFFIX.items():
+        if q.endswith(suf):
+            return int(float(q[: -len(suf)]) * mult) >> 10
+    return int(float(q)) >> 10
+
+
+def cpu_str(milli: int) -> str:
+    return f"{milli}m"
+
+
+def mem_str(kib: int) -> str:
+    return f"{kib}Ki"
+
+
+# ---- Node ------------------------------------------------------------------
+
+
+def encode_node(node: NodeInfo) -> bytes:
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": node.name, "labels": dict(node.labels)},
+        "spec": {},
+        "status": {
+            "allocatable": {
+                "cpu": cpu_str(node.cpu_milli),
+                "memory": mem_str(node.mem_kib),
+                "pods": str(node.pods),
+            },
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+    if node.unschedulable:
+        obj["spec"]["unschedulable"] = True
+    if node.taints:
+        obj["spec"]["taints"] = [
+            {"key": t.key, "value": t.value, "effect": _EFFECT_NAMES[t.effect]}
+            for t in node.taints
+        ]
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def decode_node(data: bytes) -> NodeInfo:
+    obj = json.loads(data)
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    alloc = obj.get("status", {}).get("allocatable", {})
+    return NodeInfo(
+        name=meta["name"],
+        labels=dict(meta.get("labels", {})),
+        cpu_milli=parse_cpu(alloc.get("cpu", "0")),
+        mem_kib=parse_mem(alloc.get("memory", "0")),
+        pods=int(alloc.get("pods", 0)),
+        unschedulable=bool(spec.get("unschedulable", False)),
+        taints=[
+            Taint(t["key"], t.get("value", ""), _EFFECTS[t.get("effect", "")])
+            for t in spec.get("taints", [])
+        ],
+    )
+
+
+# ---- Pod -------------------------------------------------------------------
+
+
+def _encode_term(term: NodeSelectorTerm) -> dict:
+    return {
+        "matchExpressions": [
+            {
+                "key": r.key,
+                "operator": _SEL_OP_NAMES[r.op],
+                **({"values": list(r.values)} if r.values else {}),
+            }
+            for r in term.match_expressions
+        ]
+    }
+
+
+def _decode_term(obj: dict) -> NodeSelectorTerm:
+    return NodeSelectorTerm(
+        match_expressions=[
+            SelectorRequirement(
+                key=e["key"],
+                op=_SEL_OPS[e["operator"]],
+                values=list(e.get("values", [])),
+            )
+            for e in obj.get("matchExpressions", [])
+        ]
+    )
+
+
+def encode_pod(pod: PodInfo, *, scheduler_name: str = DEFAULT_SCHEDULER,
+               raw_affinity: dict | None = None,
+               raw_spread: list | None = None) -> bytes:
+    """PodInfo -> Kubernetes-shaped JSON.
+
+    Slot references (spread_refs/affinity_refs) are a compiled, tracker-
+    relative form, so callers that built the pod from raw constraint specs
+    pass them through ``raw_affinity``/``raw_spread`` for re-encoding.
+    """
+    spec: dict = {
+        "schedulerName": scheduler_name,
+        "containers": [
+            {
+                "name": "app",
+                "image": "img",
+                "resources": {
+                    "requests": {
+                        "cpu": cpu_str(pod.cpu_milli),
+                        "memory": mem_str(pod.mem_kib),
+                    }
+                },
+            }
+        ],
+    }
+    if pod.node_name:
+        spec["nodeName"] = pod.node_name
+    if pod.node_selector:
+        spec["nodeSelector"] = dict(pod.node_selector)
+    if pod.tolerations:
+        spec["tolerations"] = [
+            {
+                **({"key": t.key} if t.key else {}),
+                "operator": "Exists" if t.op == TOL_OP_EXISTS else "Equal",
+                **({"value": t.value} if t.value else {}),
+                **(
+                    {"effect": _EFFECT_NAMES[t.effect]}
+                    if t.effect != EFFECT_NONE
+                    else {}
+                ),
+            }
+            for t in pod.tolerations
+        ]
+    affinity = dict(raw_affinity or {})
+    if pod.required_terms or pod.preferred_terms:
+        node_aff: dict = {}
+        if pod.required_terms:
+            node_aff["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                "nodeSelectorTerms": [_encode_term(t) for t in pod.required_terms]
+            }
+        if pod.preferred_terms:
+            node_aff["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {"weight": p.weight, "preference": _encode_term(p.term)}
+                for p in pod.preferred_terms
+            ]
+        affinity["nodeAffinity"] = node_aff
+    if affinity:
+        spec["affinity"] = affinity
+    if raw_spread:
+        spec["topologySpreadConstraints"] = list(raw_spread)
+    obj = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "labels": dict(pod.labels),
+        },
+        "spec": spec,
+        "status": {"phase": "Pending"},
+    }
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def decode_pod(data: bytes, tracker: ConstraintTracker | None = None) -> PodInfo:
+    """JSON -> PodInfo; inline constraints are interned via ``tracker``.
+
+    Without a tracker, podAffinity/topologySpreadConstraints are ignored
+    (the caller only wants identity/resources — e.g. load accounting).
+    """
+    obj = json.loads(data)
+    meta = obj.get("metadata", {})
+    spec = obj.get("spec", {})
+    namespace = meta.get("namespace", "default")
+    labels = dict(meta.get("labels", {}))
+
+    cpu = mem = 0
+    for c in spec.get("containers", []):
+        req = c.get("resources", {}).get("requests", {})
+        cpu += parse_cpu(req.get("cpu", 0))
+        mem += parse_mem(req.get("memory", 0))
+
+    pod = PodInfo(
+        name=meta["name"],
+        namespace=namespace,
+        labels=labels,
+        cpu_milli=cpu,
+        mem_kib=mem,
+        node_name=spec.get("nodeName"),
+        node_selector=dict(spec.get("nodeSelector", {})),
+        tolerations=[
+            Toleration(
+                key=t.get("key", ""),
+                op=TOL_OP_EXISTS if t.get("operator", "Equal") == "Exists" else TOL_OP_EQUAL,
+                value=t.get("value", ""),
+                effect=_EFFECTS[t.get("effect", "")],
+            )
+            for t in spec.get("tolerations", [])
+        ],
+    )
+
+    aff = spec.get("affinity", {})
+    node_aff = aff.get("nodeAffinity", {})
+    req = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution", {})
+    pod.required_terms = [_decode_term(t) for t in req.get("nodeSelectorTerms", [])]
+    pod.preferred_terms = [
+        PreferredSchedulingTerm(weight=p.get("weight", 1), term=_decode_term(p["preference"]))
+        for p in node_aff.get("preferredDuringSchedulingIgnoredDuringExecution", [])
+    ]
+
+    if tracker is not None:
+        for sc in spec.get("topologySpreadConstraints", []):
+            topo = _TOPO_KEYS.get(sc.get("topologyKey", ""))
+            if topo is None:
+                raise ValueError(
+                    f"pod {pod.key}: unsupported topologyKey {sc.get('topologyKey')!r}"
+                )
+            selector = dict(sc.get("labelSelector", {}).get("matchLabels", {}))
+            cid = tracker.spread_slot(namespace, selector, topo)
+            pod.spread_refs.append(
+                SpreadConstraintRef(
+                    cid=cid,
+                    topo=topo,
+                    max_skew=sc.get("maxSkew", 1),
+                    mode=(
+                        SPREAD_SCHEDULE_ANYWAY
+                        if sc.get("whenUnsatisfiable") == "ScheduleAnyway"
+                        else SPREAD_DO_NOT_SCHEDULE
+                    ),
+                    self_match=ConstraintTracker.selector_matches(selector, labels),
+                )
+            )
+        for kind in ("podAffinity", "podAntiAffinity"):
+            sub = aff.get(kind, {})
+            anti = kind == "podAntiAffinity"
+            for term in sub.get("requiredDuringSchedulingIgnoredDuringExecution", []):
+                pod.affinity_refs.append(
+                    _decode_ipa_term(tracker, namespace, labels, term, True, anti, 1)
+                )
+            for wt in sub.get("preferredDuringSchedulingIgnoredDuringExecution", []):
+                pod.affinity_refs.append(
+                    _decode_ipa_term(
+                        tracker, namespace, labels, wt["podAffinityTerm"],
+                        False, anti, wt.get("weight", 1),
+                    )
+                )
+        pod.spread_incs = tracker.spread_matches(namespace, labels)
+        pod.ipa_incs = tracker.affinity_matches(namespace, labels)
+    return pod
+
+
+def _decode_ipa_term(
+    tracker: ConstraintTracker,
+    namespace: str,
+    labels: dict[str, str],
+    term: dict,
+    required: bool,
+    anti: bool,
+    weight: int,
+) -> AffinityTermRef:
+    topo = _TOPO_KEYS.get(term.get("topologyKey", ""))
+    if topo is None:
+        raise ValueError(f"unsupported podAffinity topologyKey {term.get('topologyKey')!r}")
+    selector = dict(term.get("labelSelector", {}).get("matchLabels", {}))
+    tid = tracker.affinity_slot(namespace, selector, topo)
+    return AffinityTermRef(
+        tid=tid,
+        topo=topo,
+        required=required,
+        anti=anti,
+        weight=weight,
+        self_match=ConstraintTracker.selector_matches(selector, labels),
+    )

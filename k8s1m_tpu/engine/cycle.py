@@ -256,6 +256,35 @@ def finalize_batch(
     return table, constraints, asg
 
 
+@functools.partial(jax.jit, static_argnames=("sign",))
+def adjust_constraints(
+    constraints: ConstraintState,
+    fields: CommitFields,
+    node_row,      # i32[B] (clipped to a valid row where mask_node is off)
+    zone,          # i32[B]
+    region,        # i32[B]
+    mask_node,     # bool[B] gate for node-domain tables
+    mask_domain,   # bool[B] gate for zone/region tables
+    sign: int = -1,
+) -> ConstraintState:
+    """Signed constraint-count correction outside the scheduling step.
+
+    Used by the coordinator for bind-CAS conflicts (sign=-1: the step's
+    optimistic commit must be rolled back for pods whose store write lost)
+    and for pod deletions (sign=-1 against the recorded bind placement;
+    mask_node is off when the node has since been removed, while the
+    zone/region decrement still applies via mask_domain).
+    """
+    return commit_constraint_binds(
+        constraints,
+        mask_node, mask_domain, jnp.where(mask_node, node_row, 0), zone, region,
+        fields.sinc_valid, fields.sinc_cid, fields.sinc_topo,
+        fields.iinc_valid, fields.iinc_tid, fields.iinc_topo,
+        fields.ipa_own_valid, fields.ipa_tid, fields.ipa_topo,
+        sign=sign,
+    )
+
+
 def _schedule_batch_impl(
     table: NodeTable,
     batch: PodBatch,

@@ -1,0 +1,75 @@
+"""Flight recorder: always-on ring of recent spans, dumped on slow ops.
+
+The reference keeps golang.org/x/exp/trace.NewFlightRecorder running and
+dumps /tmp/flight-<pod>-<ts>.perf whenever a pod takes >10ms to schedule
+(reference cmd/dist-scheduler/scheduler.go:333,448,556-565).  This is the
+same idea without the Go runtime: every span lands in a bounded ring; a
+span over ``threshold_s`` dumps the ring as JSON so the events *leading
+up to* the slow op are preserved.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("k8s1m.trace")
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        threshold_s: float = 0.010,
+        capacity: int = 4096,
+        dump_dir: str = "/tmp",
+        max_dumps: int = 16,
+    ):
+        self.threshold_s = threshold_s
+        self.dump_dir = dump_dir
+        self.max_dumps = max_dumps
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._dumps = 0
+
+    def record(self, name: str, duration_s: float, **fields) -> None:
+        span = {"name": name, "t": time.time(), "dur_s": duration_s, **fields}
+        with self._lock:
+            self._ring.append(span)
+        if duration_s > self.threshold_s:
+            self.dump(reason=f"{name} took {duration_s * 1e3:.1f}ms")
+
+    def span(self, name: str, **fields):
+        return _Span(self, name, fields)
+
+    def dump(self, reason: str = "") -> str | None:
+        with self._lock:
+            if self._dumps >= self.max_dumps:
+                return None
+            self._dumps += 1
+            ring = list(self._ring)
+        path = os.path.join(
+            self.dump_dir, f"flight-{int(time.time() * 1e3)}-{self._dumps}.json"
+        )
+        try:
+            with open(path, "w") as f:
+                json.dump({"reason": reason, "spans": ring}, f)
+        except OSError:
+            return None
+        log.warning("flight recorder dump: %s (%s)", path, reason)
+        return path
+
+
+class _Span:
+    def __init__(self, rec: FlightRecorder, name: str, fields: dict):
+        self.rec, self.name, self.fields = rec, name, fields
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.rec.record(self.name, time.perf_counter() - self._t0, **self.fields)

@@ -177,6 +177,7 @@ def commit_constraint_binds(
     own_valid,    # bool[B, AR] pod carries affinity term own_tid[b, j]
     own_tid,      # i32[B, AR]
     own_topo,     # i32[B, AR]
+    sign: int = 1,  # +1 commit, -1 roll back (bind CAS conflict / pod delete)
 ) -> ConstraintState:
     """Fold a batch's binds into the count tables (one scatter per table)."""
 
@@ -185,8 +186,8 @@ def commit_constraint_binds(
 
     def apply(node_tab, zone_tab, region_tab, valid, slot, topo):
         b, w = valid.shape
-        inc_node = (valid & bound_node[:, None]).astype(jnp.int32).reshape(-1)
-        inc_dom = (valid & bound_domain[:, None]).astype(jnp.int32).reshape(-1)
+        inc_node = sign * (valid & bound_node[:, None]).astype(jnp.int32).reshape(-1)
+        inc_dom = sign * (valid & bound_domain[:, None]).astype(jnp.int32).reshape(-1)
         slot, topo = slot.reshape(-1), topo.reshape(-1)
         node_tab = node_tab.at[slot, flat(node_row, w)].add(
             jnp.where(topo == TOPO_HOSTNAME, inc_node, 0)

@@ -289,6 +289,22 @@ class EtcdService:
                     events = await loop.run_in_executor(
                         None, w.poll, _WATCH_BATCH, 0
                     )
+                    if w.dropped:
+                        # Queue overflow lost events; a silently gapped
+                        # stream would corrupt client caches — cancel, as
+                        # the store contract requires, so the client
+                        # re-establishes from its last good revision.
+                        w.cancel()
+                        watchers.pop(wid, None)
+                        await out.put(
+                            rpc_pb2.WatchResponse(
+                                header=self._header(),
+                                watch_id=wid,
+                                canceled=True,
+                                cancel_reason="watcher overflowed; events dropped",
+                            )
+                        )
+                        return
                     if w.canceled and not events:
                         await out.put(
                             rpc_pb2.WatchResponse(
@@ -567,13 +583,16 @@ async def serve(
         raise OSError(f"failed to bind {host}:{port} (port in use?)")
     await server.start()
     if metrics_port:
+        import weakref
+
         from k8s1m_tpu.obs.http import start_metrics_server
 
-        _STORE_GAUGE.set_function(lambda: store.num_keys, stat="num_keys")
-        _STORE_GAUGE.set_function(lambda: store.db_size, stat="db_size")
-        _STORE_GAUGE.set_function(lambda: store.current_revision, stat="revision")
-        _STORE_GAUGE.set_function(
-            lambda: store.compact_revision, stat="compact_revision"
-        )
+        # weakref so the module-level gauge never pins a closed store.
+        wr = weakref.ref(store)
+        for stat in ("num_keys", "db_size", "current_revision", "compact_revision"):
+            _STORE_GAUGE.set_function(
+                lambda stat=stat: getattr(s, stat) if (s := wr()) else 0,
+                stat=stat.replace("current_", ""),
+            )
         start_metrics_server(metrics_port)
     return server, bound

@@ -129,7 +129,7 @@ struct Event {
   KvMeta prev;
 };
 
-constexpr size_t kWatcherQueueCap = 65536;
+constexpr size_t kWatcherQueueCap = 10000;  // reference store.rs:27
 
 struct Watcher {
   int64_t id = 0;

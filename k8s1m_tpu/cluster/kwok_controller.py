@@ -1,0 +1,177 @@
+"""KWOK-style node simulator: fake kubelets at cluster scale.
+
+The reference runs a forked KWOK v0.6.0 as a StatefulSet of 10
+controllers, each adopting nodes with its ``kwok-group=<ordinal>`` label,
+maintaining 40s node leases and driving pod status
+(reference kwok/kwok-controller.yaml:9,53,58; SURVEY.md §2.8).  This is
+the same component against our store: each controller owns a node group,
+renews the group's leases, and moves pods bound to its nodes from
+Pending to Running.
+
+Tick-driven (no wall-clock sleeps): the caller advances simulated time,
+so tests and the bench can run lease churn at any speed.  The fork's
+``kwok_node_lease_delay_percentile`` metric (dashboard.json:7069) is
+reproduced as a histogram of (actual - scheduled) renewal delay.
+"""
+
+from __future__ import annotations
+
+import json
+
+from k8s1m_tpu.control.objects import lease_key, node_key, pod_key
+from k8s1m_tpu.obs.metrics import Counter, Histogram
+from k8s1m_tpu.store.native import MemStore, prefix_end
+
+NODES_PREFIX = b"/registry/minions/"
+PODS_PREFIX = b"/registry/pods/"
+LEASE_NS = "kube-node-lease"
+
+_LEASE_RENEWALS = Counter(
+    "kwok_lease_renewals_total", "Node lease renewals", ("group",)
+)
+_PODS_STARTED = Counter(
+    "kwok_pods_started_total", "Pods moved to Running", ("group",)
+)
+_LEASE_DELAY = Histogram(
+    "kwok_node_lease_delay_seconds",
+    "Delay between scheduled and actual lease renewal",
+    ("group",),
+)
+
+
+class KwokController:
+    """One controller instance owning one kwok-group of nodes."""
+
+    def __init__(
+        self,
+        store: MemStore,
+        group: int = 0,
+        *,
+        lease_duration_s: int = 40,
+        renew_interval_s: float = 10.0,
+    ):
+        self.store = store
+        self.group = str(group)
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        self.nodes: set[str] = set()
+        self._next_renewal: dict[str, float] = {}
+        self._nodes_watch = None
+        self._pods_watch = None
+        self.running_pods: set[str] = set()
+
+    # ---- membership ----------------------------------------------------
+
+    def _owns(self, node_obj: dict) -> bool:
+        labels = node_obj.get("metadata", {}).get("labels", {})
+        return labels.get("kwok-group") == self.group
+
+    def bootstrap(self, now: float = 0.0) -> None:
+        res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
+        for kv in res.kvs:
+            obj = json.loads(kv.value)
+            if self._owns(obj):
+                self._adopt(obj["metadata"]["name"], now)
+        self._nodes_watch = self.store.watch(
+            NODES_PREFIX, prefix_end(NODES_PREFIX),
+            start_revision=res.revision + 1,
+        )
+        pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
+        for kv in pods.kvs:
+            self._maybe_start_pod(kv.value, kv.mod_revision)
+        self._pods_watch = self.store.watch(
+            PODS_PREFIX, prefix_end(PODS_PREFIX),
+            start_revision=pods.revision + 1,
+        )
+
+    def _adopt(self, name: str, now: float) -> None:
+        self.nodes.add(name)
+        # Stagger first renewals across the interval so 1M leases spread
+        # evenly instead of arriving in one spike.
+        offset = (hash(name) % 1000) / 1000.0 * self.renew_interval_s
+        self._next_renewal[name] = now + offset
+
+    # ---- pod lifecycle -------------------------------------------------
+
+    def _maybe_start_pod(self, data: bytes, mod_revision: int) -> None:
+        obj = json.loads(data)
+        node = obj.get("spec", {}).get("nodeName")
+        if not node or node not in self.nodes:
+            return
+        if obj.get("status", {}).get("phase") != "Pending":
+            return
+        key = pod_key(obj["metadata"].get("namespace", "default"),
+                      obj["metadata"]["name"])
+        obj["status"]["phase"] = "Running"
+        obj["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+        ok, _, _ = self.store.cas(
+            key, json.dumps(obj, separators=(",", ":")).encode(),
+            required_mod=mod_revision,
+        )
+        if ok:
+            self.running_pods.add(f"{obj['metadata'].get('namespace', 'default')}/"
+                                  f"{obj['metadata']['name']}")
+            _PODS_STARTED.inc(group=self.group)
+        # CAS failure: someone updated the pod concurrently; the new
+        # revision arrives via the watch and is retried there.
+
+    # ---- tick ----------------------------------------------------------
+
+    def tick(self, now: float) -> dict:
+        """Advance the simulator: drain watches, renew due leases, start
+        newly bound pods.  Returns per-tick stats."""
+        renewed = 0
+        started0 = len(self.running_pods)
+        for ev in self._nodes_watch.poll(10000):
+            name = ev.kv.key[len(NODES_PREFIX):].decode()
+            if ev.type == "PUT":
+                obj = json.loads(ev.kv.value)
+                if self._owns(obj) and name not in self.nodes:
+                    self._adopt(name, now)
+                elif not self._owns(obj) and name in self.nodes:
+                    self._drop(name)
+            elif name in self.nodes:
+                self._drop(name)
+        for ev in self._pods_watch.poll(10000):
+            if ev.type == "PUT":
+                self._maybe_start_pod(ev.kv.value, ev.kv.mod_revision)
+            else:
+                key = ev.kv.key[len(PODS_PREFIX):].decode()
+                self.running_pods.discard(key)
+
+        for name, due in self._next_renewal.items():
+            if due <= now:
+                self._renew_lease(name, now)
+                delay = now - due
+                _LEASE_DELAY.observe(delay, group=self.group)
+                self._next_renewal[name] = now + self.renew_interval_s
+                renewed += 1
+        return {
+            "renewed": renewed,
+            "started": len(self.running_pods) - started0,
+            "nodes": len(self.nodes),
+        }
+
+    def _drop(self, name: str) -> None:
+        self.nodes.discard(name)
+        self._next_renewal.pop(name, None)
+        self.store.delete(lease_key(LEASE_NS, name))
+
+    def _renew_lease(self, name: str, now: float) -> None:
+        self.store.put(
+            lease_key(LEASE_NS, name),
+            json.dumps(
+                {
+                    "apiVersion": "coordination.k8s.io/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": name, "namespace": LEASE_NS},
+                    "spec": {
+                        "holderIdentity": name,
+                        "leaseDurationSeconds": self.lease_duration_s,
+                        "renewTime": now,
+                    },
+                },
+                separators=(",", ":"),
+            ).encode(),
+        )
+        _LEASE_RENEWALS.inc(group=self.group)

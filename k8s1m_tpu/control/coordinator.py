@@ -71,11 +71,20 @@ PODS_PREFIX = b"/registry/pods/"
 _PODS_SCHEDULED = Counter(
     "coordinator_pods_scheduled_total", "Pods bound, by outcome", ("outcome",)
 )
+_DECODE_ERRORS = Counter(
+    "coordinator_decode_errors_total", "Objects that failed to decode", ("kind",)
+)
 _CYCLE_TIME = Histogram(
     "coordinator_cycle_seconds", "Scheduling cycle latency by stage", ("stage",)
 )
 _QUEUE_DEPTH = Gauge("coordinator_queue_depth", "Pending pods queued", ())
 _NODE_COUNT = Gauge("coordinator_node_count", "Nodes in the snapshot", ())
+# All live coordinators in this process; gauges aggregate over them so a
+# discarded instance neither pins memory nor clobbers the live one's stats.
+_LIVE: weakref.WeakSet = weakref.WeakSet()
+_NODE_COUNT.set_function(lambda: sum(c.host.num_nodes for c in _LIVE))
+_QUEUE_DEPTH.set_function(lambda: sum(len(c.queue) for c in _LIVE))
+
 _BIND_LATENCY = Histogram(
     "coordinator_schedule_to_bind_seconds",
     "Intake-to-bind latency per pod",
@@ -147,11 +156,7 @@ class Coordinator:
         self._pods_watch: Watcher | None = None
         self.unschedulable: dict[str, PodInfo] = {}
 
-        # weakref so module-level gauges never pin a discarded Coordinator
-        # (and its full node table) in memory.
-        wr = weakref.ref(self)
-        _NODE_COUNT.set_function(lambda: c.host.num_nodes if (c := wr()) else 0)
-        _QUEUE_DEPTH.set_function(lambda: len(c.queue) if (c := wr()) else 0)
+        _LIVE.add(self)
 
     # ---- bootstrap -----------------------------------------------------
 
@@ -199,12 +204,21 @@ class Coordinator:
             self._pending_adjusts.append((keep, node_name, zone, region, 1))
 
     def _on_pod_put(self, data: bytes, mod_revision: int) -> None:
-        pod = decode_pod(data, self.tracker)
+        try:
+            pod = decode_pod(data, self.tracker)
+        except Exception:
+            # One malformed object must not poison the event stream — the
+            # rest of the polled batch would be lost and the snapshot
+            # would silently diverge.  Quarantine and move on.
+            _DECODE_ERRORS.inc(kind="pod")
+            log.exception("undecodable pod object; skipping")
+            return
         if pod.node_name:
             # Someone's bind (ours echoing back, or an external writer):
             # account it if we haven't already.
             if pod.key not in self._bound:
                 if pod.node_name in self.host._row_of:
+                    self._orphan_bound.pop(pod.key, None)
                     self.host.add_pod(pod.node_name, pod.cpu_milli, pod.mem_kib)
                     self._dirty_rows.add(self.host.row_of(pod.node_name))
                     self._note_bound(pod, pod.node_name, external=True)
@@ -213,6 +227,10 @@ class Coordinator:
                     # interleaving at bootstrap); account when it arrives.
                     self._orphan_bound[pod.key] = pod
             self._queued_keys.discard(pod.key)
+            return
+        if pod.scheduler_name != self.scheduler_name:
+            # Not ours to schedule (the reference's webhook/watch intake
+            # applies the same schedulerName filter, webhook.go:102-125).
             return
         if pod.key in self._queued_keys:
             return
@@ -259,7 +277,12 @@ class Coordinator:
             for ev in self._nodes_watch.poll(max_events):
                 n += 1
                 if ev.type == "PUT":
-                    node = decode_node(ev.kv.value)
+                    try:
+                        node = decode_node(ev.kv.value)
+                    except Exception:
+                        _DECODE_ERRORS.inc(kind="node")
+                        log.exception("undecodable node object; skipping")
+                        continue
                     self._dirty_rows.add(self.host.upsert(node))
                     self._adopt_orphans(node.name)
                 else:
@@ -329,6 +352,15 @@ class Coordinator:
         with _CYCLE_TIME.time(stage="sync"):
             rows = np.fromiter(self._dirty_rows, np.int32)
             self._dirty_rows.clear()
+            # Pad to a power-of-two bucket so jax.jit sees a handful of
+            # shapes, not one trace per distinct dirty-row count.  Padding
+            # repeats the last row: scattering identical values to the
+            # same index is idempotent.
+            cap = 1 << max(0, int(rows.size - 1).bit_length())
+            if cap != rows.size:
+                rows = np.concatenate(
+                    [rows, np.repeat(rows[-1:], cap - rows.size)]
+                )
             h = self.host
             delta = {
                 "valid": h.valid[rows], "cpu_alloc": h.cpu_alloc[rows],

@@ -203,7 +203,7 @@ def _decode_term(obj: dict) -> NodeSelectorTerm:
     )
 
 
-def encode_pod(pod: PodInfo, *, scheduler_name: str = DEFAULT_SCHEDULER,
+def encode_pod(pod: PodInfo, *, scheduler_name: str | None = None,
                raw_affinity: dict | None = None,
                raw_spread: list | None = None) -> bytes:
     """PodInfo -> Kubernetes-shaped JSON.
@@ -213,7 +213,7 @@ def encode_pod(pod: PodInfo, *, scheduler_name: str = DEFAULT_SCHEDULER,
     pass them through ``raw_affinity``/``raw_spread`` for re-encoding.
     """
     spec: dict = {
-        "schedulerName": scheduler_name,
+        "schedulerName": scheduler_name or pod.scheduler_name,
         "containers": [
             {
                 "name": "app",
@@ -300,6 +300,7 @@ def decode_pod(data: bytes, tracker: ConstraintTracker | None = None) -> PodInfo
         labels=labels,
         cpu_milli=cpu,
         mem_kib=mem,
+        scheduler_name=spec.get("schedulerName", DEFAULT_SCHEDULER),
         node_name=spec.get("nodeName"),
         node_selector=dict(spec.get("nodeSelector", {})),
         tolerations=[

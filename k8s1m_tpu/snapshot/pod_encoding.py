@@ -107,6 +107,7 @@ class PodInfo:
     namespace: str = "default"
     cpu_milli: int = 100
     mem_kib: int = 200 << 10       # 200 MiB
+    scheduler_name: str = "dist-scheduler"
     node_name: str | None = None
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
     tolerations: list[Toleration] = dataclasses.field(default_factory=list)

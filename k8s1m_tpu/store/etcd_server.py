@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import weakref
 
 import grpc
 from grpc import aio
@@ -62,6 +63,14 @@ _REQ_LATENCY = Histogram(
     "memstore_request_seconds", "gRPC request latency by method", ("method",)
 )
 _STORE_GAUGE = Gauge("memstore_store", "Store-level gauges by stat", ("stat",))
+# Stores served with metrics enabled; the gauge aggregates over the live
+# ones so a closed store neither pins memory nor clobbers stats.
+_SERVED_STORES: weakref.WeakSet = weakref.WeakSet()
+for _stat in ("num_keys", "db_size", "current_revision", "compact_revision"):
+    _STORE_GAUGE.set_function(
+        (lambda stat: lambda: sum(getattr(s, stat) for s in _SERVED_STORES))(_stat),
+        stat=_stat.replace("current_", ""),
+    )
 
 
 def _kv_to_pb(kv: KeyValue) -> mvcc_pb2.KeyValue:
@@ -583,16 +592,8 @@ async def serve(
         raise OSError(f"failed to bind {host}:{port} (port in use?)")
     await server.start()
     if metrics_port:
-        import weakref
-
         from k8s1m_tpu.obs.http import start_metrics_server
 
-        # weakref so the module-level gauge never pins a closed store.
-        wr = weakref.ref(store)
-        for stat in ("num_keys", "db_size", "current_revision", "compact_revision"):
-            _STORE_GAUGE.set_function(
-                lambda stat=stat: getattr(s, stat) if (s := wr()) else 0,
-                stat=stat.replace("current_", ""),
-            )
+        _SERVED_STORES.add(store)
         start_metrics_server(metrics_port)
     return server, bound

@@ -1,0 +1,97 @@
+"""Shared scaffolding for the load-generator CLIs.
+
+The reference's generators shard work across 10-12 clientsets x 100
+goroutines and report rates to stdout (reference kwok/make_pods/main.go:38,85-102,
+etcd-lease-flood/main.go:88-101); here each tool is an asyncio worker
+pool over one or more gRPC channels with a periodic rate reporter.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from k8s1m_tpu.store.etcd_client import EtcdClient
+
+
+class RateReporter:
+    """Prints ops/sec once per interval, like the reference's stdout logs."""
+
+    def __init__(self, label: str, interval_s: float = 1.0, quiet: bool = False):
+        self.label = label
+        self.interval_s = interval_s
+        self.quiet = quiet
+        self.count = 0
+        self.errors = 0
+        self._t0 = time.perf_counter()
+        self._last = self._t0
+        self._last_count = 0
+
+    def add(self, n: int = 1) -> None:
+        self.count += n
+        now = time.perf_counter()
+        if not self.quiet and now - self._last >= self.interval_s:
+            rate = (self.count - self._last_count) / (now - self._last)
+            print(f"{self.label}: {self.count} total, {rate:,.0f}/s", flush=True)
+            self._last, self._last_count = now, self.count
+
+    def summary(self) -> dict:
+        dt = time.perf_counter() - self._t0
+        return {
+            "label": self.label,
+            "count": self.count,
+            "errors": self.errors,
+            "seconds": round(dt, 3),
+            "rate": round(self.count / dt, 1) if dt > 0 else 0.0,
+        }
+
+
+async def run_sharded(
+    total: int,
+    concurrency: int,
+    make_client,
+    work,
+    *,
+    clients: int = 1,
+    reporter: RateReporter | None = None,
+):
+    """Run ``work(client, index)`` for index in [0, total) across a worker
+    pool; ``clients`` separate channels spread HTTP/2 stream contention
+    the way the reference uses multiple clientsets."""
+    pool = [make_client() for _ in range(max(1, clients))]
+    queue: asyncio.Queue = asyncio.Queue()
+    for i in range(total):
+        queue.put_nowait(i)
+
+    async def worker(wid: int):
+        client = pool[wid % len(pool)]
+        while True:
+            try:
+                i = queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            try:
+                await work(client, i)
+                if reporter:
+                    reporter.add()
+            except Exception:
+                if reporter:
+                    reporter.errors += 1
+                raise
+
+    try:
+        await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    finally:
+        for c in pool:
+            await c.close()
+
+
+def add_common_args(ap):
+    ap.add_argument("--target", default="127.0.0.1:2379", help="etcd server addr")
+    ap.add_argument("--concurrency", type=int, default=100)
+    ap.add_argument("--clients", type=int, default=4, help="separate gRPC channels")
+    ap.add_argument("--quiet", action="store_true")
+
+
+def client_factory(args):
+    return lambda: EtcdClient(args.target)

@@ -1,0 +1,55 @@
+"""Bulk pod deletion (the delete_pods equivalent,
+reference kwok/delete_pods/main.go:80-92).
+
+    python -m k8s1m_tpu.tools.delete_pods --namespace default --prefix bench-pod
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from k8s1m_tpu.store.etcd_client import EtcdClient
+from k8s1m_tpu.store.native import prefix_end
+from k8s1m_tpu.tools.common import (
+    RateReporter,
+    add_common_args,
+    client_factory,
+    run_sharded,
+)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="bulk-delete pods")
+    add_common_args(ap)
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--prefix", default="", help="pod-name prefix filter")
+    return ap.parse_args(argv)
+
+
+async def amain(args) -> dict:
+    lister = EtcdClient(args.target)
+    key_prefix = f"/registry/pods/{args.namespace}/{args.prefix}".encode()
+    resp = await lister.range(key_prefix, prefix_end(key_prefix), keys_only=True)
+    keys = [kv.key for kv in resp.kvs]
+    await lister.close()
+
+    reporter = RateReporter("pods deleted", quiet=args.quiet)
+
+    async def work(client, i):
+        await client.delete(keys[i])
+
+    await run_sharded(
+        len(keys), args.concurrency, client_factory(args), work,
+        clients=args.clients, reporter=reporter,
+    )
+    return reporter.summary()
+
+
+def main(argv=None):
+    print(json.dumps(asyncio.run(amain(parse_args(argv)))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Flood the store with node-lease updates — the dominant write load of a
+1M-node cluster (the etcd-lease-flood equivalent, reference
+etcd-lease-flood/main.go:117-149: 1M kubelets renewing a 40s lease every
+10s is ~100K writes/s, README.adoc:142-151).
+
+    python -m k8s1m_tpu.tools.lease_flood --nodes 10000 --rounds 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+from k8s1m_tpu.control.objects import lease_key
+from k8s1m_tpu.tools.common import (
+    RateReporter,
+    add_common_args,
+    client_factory,
+    run_sharded,
+)
+
+LEASE_NS = "kube-node-lease"
+
+
+def lease_value(node: str, seq: int) -> bytes:
+    # Kubernetes Lease objects are small; model the renewTime bump that
+    # makes every renewal a fresh write.
+    return json.dumps(
+        {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": node, "namespace": LEASE_NS},
+            "spec": {
+                "holderIdentity": node,
+                "leaseDurationSeconds": 40,
+                "renewTime": f"seq-{seq}",
+            },
+        },
+        separators=(",", ":"),
+    ).encode()
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="node-lease write flood")
+    add_common_args(ap)
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--rounds", type=int, default=10,
+                    help="lease renewals per node")
+    ap.add_argument("--prefix", default="kwok-node")
+    return ap.parse_args(argv)
+
+
+async def amain(args) -> dict:
+    reporter = RateReporter("lease puts", quiet=args.quiet)
+    total = args.nodes * args.rounds
+
+    async def work(client, i):
+        node = f"{args.prefix}-{i % args.nodes}"
+        seq = i // args.nodes
+        await client.put(lease_key(LEASE_NS, node), lease_value(node, seq))
+
+    t0 = time.perf_counter()
+    await run_sharded(
+        total, args.concurrency, client_factory(args), work,
+        clients=args.clients, reporter=reporter,
+    )
+    out = reporter.summary()
+    out["puts_per_sec"] = round(total / (time.perf_counter() - t0), 1)
+    return out
+
+
+def main(argv=None):
+    print(json.dumps(asyncio.run(amain(parse_args(argv)))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Bulk-create KWOK-style Node objects (the make_nodes equivalent,
+reference kwok/make_nodes/main.go:116-182).
+
+    python -m k8s1m_tpu.tools.make_nodes --count 100000 --zones 8 --regions 4
+
+Nodes get the same shape the reference gives its KWOK nodes: type=kwok
+annotation-ish label, a kwok-group shard label (10 groups, matching the
+reference's 10-controller StatefulSet, kwok-controller.yaml:9,53),
+topology zone/region labels, and allocatable capacity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from k8s1m_tpu.control.objects import encode_node, node_key
+from k8s1m_tpu.snapshot.node_table import NodeInfo
+from k8s1m_tpu.tools.common import (
+    RateReporter,
+    add_common_args,
+    client_factory,
+    run_sharded,
+)
+
+KWOK_GROUPS = 10
+
+
+def build_node(
+    i: int,
+    *,
+    prefix: str = "kwok-node",
+    zones: int = 8,
+    regions: int = 4,
+    cpu_milli: int = 32000,
+    mem_kib: int = 64 << 20,
+    pods: int = 110,
+) -> NodeInfo:
+    return NodeInfo(
+        name=f"{prefix}-{i}",
+        cpu_milli=cpu_milli,
+        mem_kib=mem_kib,
+        pods=pods,
+        labels={
+            "type": "kwok",
+            "kwok-group": str(i % KWOK_GROUPS),
+            "topology.kubernetes.io/zone": f"zone-{i % zones}",
+            "topology.kubernetes.io/region": f"region-{i % regions}",
+        },
+    )
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="bulk-create KWOK-style nodes")
+    add_common_args(ap)
+    ap.add_argument("--count", type=int, default=1000)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--prefix", default="kwok-node")
+    ap.add_argument("--zones", type=int, default=8)
+    ap.add_argument("--regions", type=int, default=4)
+    ap.add_argument("--cpu", type=int, default=32000, help="milliCPU allocatable")
+    ap.add_argument("--mem-kib", type=int, default=64 << 20)
+    ap.add_argument("--pods", type=int, default=110)
+    return ap.parse_args(argv)
+
+
+async def amain(args) -> dict:
+    reporter = RateReporter("nodes created", quiet=args.quiet)
+
+    async def work(client, i):
+        n = args.start + i
+        node = build_node(
+            n, prefix=args.prefix, zones=args.zones, regions=args.regions,
+            cpu_milli=args.cpu, mem_kib=args.mem_kib, pods=args.pods,
+        )
+        await client.put(node_key(node.name), encode_node(node))
+
+    await run_sharded(
+        args.count, args.concurrency, client_factory(args), work,
+        clients=args.clients, reporter=reporter,
+    )
+    return reporter.summary()
+
+
+def main(argv=None):
+    print(json.dumps(asyncio.run(amain(parse_args(argv)))))
+
+
+if __name__ == "__main__":
+    main()

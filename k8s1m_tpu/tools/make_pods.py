@@ -1,0 +1,80 @@
+"""Bulk-create pending pods for the scheduler (the make_pods equivalent,
+reference kwok/make_pods/main.go:109-172).
+
+    python -m k8s1m_tpu.tools.make_pods --count 100000 --cpu 100 --mem-mib 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+from k8s1m_tpu.control.objects import encode_pod, pod_key
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo, Toleration
+from k8s1m_tpu.tools.common import (
+    RateReporter,
+    add_common_args,
+    client_factory,
+    run_sharded,
+)
+
+
+def build_pod(
+    i: int,
+    *,
+    prefix: str = "bench-pod",
+    namespace: str = "default",
+    cpu_milli: int = 100,
+    mem_kib: int = 200 << 10,
+    tolerate_kwok: bool = True,
+) -> PodInfo:
+    return PodInfo(
+        name=f"{prefix}-{i}",
+        namespace=namespace,
+        cpu_milli=cpu_milli,
+        mem_kib=mem_kib,
+        labels={"app": prefix},
+        # The reference's pods tolerate the kwok taint
+        # (make_pods/main.go sets tolerations for kwok.x-k8s.io/node).
+        tolerations=(
+            [Toleration(key="kwok.x-k8s.io/node")] if tolerate_kwok else []
+        ),
+    )
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="bulk-create pending pods")
+    add_common_args(ap)
+    ap.add_argument("--count", type=int, default=1000)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--prefix", default="bench-pod")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--cpu", type=int, default=100, help="milliCPU request")
+    ap.add_argument("--mem-mib", type=int, default=200)
+    return ap.parse_args(argv)
+
+
+async def amain(args) -> dict:
+    reporter = RateReporter("pods created", quiet=args.quiet)
+
+    async def work(client, i):
+        pod = build_pod(
+            args.start + i, prefix=args.prefix, namespace=args.namespace,
+            cpu_milli=args.cpu, mem_kib=args.mem_mib << 10,
+        )
+        await client.put(pod_key(pod.namespace, pod.name), encode_pod(pod))
+
+    await run_sharded(
+        args.count, args.concurrency, client_factory(args), work,
+        clients=args.clients, reporter=reporter,
+    )
+    return reporter.summary()
+
+
+def main(argv=None):
+    print(json.dumps(asyncio.run(amain(parse_args(argv)))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Put/range throughput benchmark against the store (the stress-client
+equivalent, reference mem_etcd/stress-client/src/main.rs:42-107).
+
+    python -m k8s1m_tpu.tools.store_stress --puts 50000 --ranges 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+from k8s1m_tpu.store.native import prefix_end
+from k8s1m_tpu.tools.common import (
+    RateReporter,
+    add_common_args,
+    client_factory,
+    run_sharded,
+)
+
+PREFIX = b"/stress/keys/"
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="store put/range stress")
+    add_common_args(ap)
+    ap.add_argument("--puts", type=int, default=10000)
+    ap.add_argument("--ranges", type=int, default=100)
+    ap.add_argument("--value-size", type=int, default=256)
+    ap.add_argument("--range-limit", type=int, default=100)
+    return ap.parse_args(argv)
+
+
+async def amain(args) -> dict:
+    value = os.urandom(args.value_size)
+    put_rep = RateReporter("puts", quiet=args.quiet)
+
+    async def put_work(client, i):
+        await client.put(PREFIX + b"%012d" % i, value)
+
+    t0 = time.perf_counter()
+    await run_sharded(
+        args.puts, args.concurrency, client_factory(args), put_work,
+        clients=args.clients, reporter=put_rep,
+    )
+    put_s = time.perf_counter() - t0
+
+    range_rep = RateReporter("ranges", quiet=args.quiet)
+
+    async def range_work(client, i):
+        start = PREFIX + b"%012d" % ((i * 37) % max(1, args.puts))
+        await client.range(start, prefix_end(PREFIX), limit=args.range_limit)
+
+    t1 = time.perf_counter()
+    await run_sharded(
+        args.ranges, args.concurrency, client_factory(args), range_work,
+        clients=args.clients, reporter=range_rep,
+    )
+    range_s = time.perf_counter() - t1
+
+    return {
+        "puts": args.puts,
+        "puts_per_sec": round(args.puts / put_s, 1),
+        "ranges": args.ranges,
+        "ranges_per_sec": round(args.ranges / range_s, 1) if args.ranges else 0,
+    }
+
+
+def main(argv=None):
+    print(json.dumps(asyncio.run(amain(parse_args(argv)))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""N concurrent watches measuring event delivery rates (the
+apiserver-stress equivalent, reference apiserver-stress/src/main.rs:54-97:
+N watchers against the apiserver count events/sec to expose watch
+amplification — 18M watches at 1M nodes, README.adoc:410-416).
+
+    python -m k8s1m_tpu.tools.watch_stress --watchers 100 --writes 10000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+from k8s1m_tpu.store.etcd_client import EtcdClient
+from k8s1m_tpu.store.native import prefix_end
+
+PREFIX = b"/stress/watched/"
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="concurrent watch stress")
+    ap.add_argument("--target", default="127.0.0.1:2379")
+    ap.add_argument("--watchers", type=int, default=50)
+    ap.add_argument("--writes", type=int, default=1000)
+    ap.add_argument("--write-concurrency", type=int, default=50)
+    ap.add_argument("--quiet", action="store_true")
+    return ap.parse_args(argv)
+
+
+async def amain(args) -> dict:
+    # Every watcher sees every write: total deliveries = watchers x writes.
+    watch_client = EtcdClient(args.target)
+    sessions = []
+    for _ in range(args.watchers):
+        s = watch_client.watch(PREFIX, prefix_end(PREFIX))
+        await s.__aenter__()
+        sessions.append(s)
+
+    delivered = 0
+    done = asyncio.Event()
+
+    async def drain(s):
+        nonlocal delivered
+        while delivered < args.watchers * args.writes:
+            try:
+                batch = await s.next(timeout=10)
+            except (asyncio.TimeoutError, Exception):
+                return
+            delivered += len(batch.events)
+            if delivered >= args.watchers * args.writes:
+                done.set()
+
+    drainers = [asyncio.create_task(drain(s)) for s in sessions]
+
+    write_client = EtcdClient(args.target)
+    t0 = time.perf_counter()
+
+    async def writer(wid: int):
+        for i in range(wid, args.writes, args.write_concurrency):
+            await write_client.put(PREFIX + b"key-%06d" % (i % 100), b"x" * 64)
+
+    await asyncio.gather(*(writer(w) for w in range(args.write_concurrency)))
+    write_s = time.perf_counter() - t0
+    try:
+        await asyncio.wait_for(done.wait(), timeout=30)
+    except asyncio.TimeoutError:
+        pass
+    total_s = time.perf_counter() - t0
+
+    for t in drainers:
+        t.cancel()
+    for s in sessions:
+        await s.cancel()
+    await watch_client.close()
+    await write_client.close()
+
+    return {
+        "watchers": args.watchers,
+        "writes": args.writes,
+        "writes_per_sec": round(args.writes / write_s, 1),
+        "events_delivered": delivered,
+        "events_per_sec": round(delivered / total_s, 1),
+        "amplification": args.watchers,
+    }
+
+
+def main(argv=None):
+    print(json.dumps(asyncio.run(amain(parse_args(argv)))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Load generators + KWOK controllers + coordinator, end to end.
+
+The system-level test the reference performs at cluster scale
+(make_nodes -> kwok adoption -> make_pods -> scheduling -> leases,
+SURVEY.md §3.5) run in miniature: tools write through the real gRPC wire,
+the coordinator binds through the same store, KWOK controllers move pods
+to Running and churn leases.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from k8s1m_tpu.cluster.kwok_controller import LEASE_NS, KwokController
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.control.objects import lease_key, pod_key
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.store.etcd_server import serve
+from k8s1m_tpu.store.native import MemStore, prefix_end
+from k8s1m_tpu.tools import (
+    delete_pods,
+    lease_flood,
+    make_nodes,
+    make_pods,
+    store_stress,
+    watch_stress,
+)
+
+PROFILE = Profile(topology_spread=0, interpod_affinity=0)
+
+
+@pytest.fixture()
+def env():
+    loop = asyncio.new_event_loop()
+    store = MemStore()
+
+    async def start():
+        return await serve(store, port=0)
+
+    server, port = loop.run_until_complete(start())
+    yield loop, store, f"127.0.0.1:{port}"
+    loop.run_until_complete(server.stop(None))
+    loop.close()
+    store.close()
+
+
+def run_tool(loop, mod, argv):
+    return loop.run_until_complete(mod.amain(mod.parse_args(argv)))
+
+
+def test_full_system_make_nodes_pods_schedule_run(env):
+    loop, store, target = env
+    base = ["--target", target, "--quiet", "--concurrency", "16", "--clients", "2"]
+
+    out = run_tool(loop, make_nodes, base + ["--count", "40", "--zones", "4"])
+    assert out["count"] == 40 and out["errors"] == 0
+
+    # 10 KWOK groups, matching the reference's StatefulSet of 10.
+    controllers = [KwokController(store, g) for g in range(10)]
+    for c in controllers:
+        c.bootstrap(now=0.0)
+    assert sum(len(c.nodes) for c in controllers) == 40
+
+    out = run_tool(loop, make_pods, base + ["--count", "60"])
+    assert out["count"] == 60 and out["errors"] == 0
+
+    coord = Coordinator(
+        store, TableSpec(max_nodes=64, max_zones=8, max_regions=8),
+        PodSpec(batch=32), PROFILE, chunk=32, k=4, with_constraints=False,
+    )
+    coord.bootstrap()
+    assert coord.run_until_idle() == 60
+
+    # KWOK controllers see the binds and start the pods; leases renew.
+    started = 0
+    for t in (10.0, 20.0):
+        for c in controllers:
+            stats = c.tick(now=t)
+        started = sum(len(c.running_pods) for c in controllers)
+    assert started == 60
+    res = store.range(
+        f"/registry/leases/{LEASE_NS}/".encode(),
+        prefix_end(f"/registry/leases/{LEASE_NS}/".encode()),
+        count_only=True,
+    )
+    assert res.count == 40
+
+    phases = set()
+    for kv in store.range(b"/registry/pods/", b"/registry/pods0").kvs:
+        phases.add(json.loads(kv.value)["status"]["phase"])
+    assert phases == {"Running"}
+
+    # delete_pods drains everything.
+    out = run_tool(loop, delete_pods, base + ["--prefix", "bench-pod"])
+    assert out["count"] == 60
+    assert store.range(b"/registry/pods/", b"/registry/pods0", count_only=True).count == 0
+
+
+def test_lease_flood_and_store_stress(env):
+    loop, store, target = env
+    base = ["--target", target, "--quiet", "--concurrency", "8", "--clients", "2"]
+    out = run_tool(loop, lease_flood, base + ["--nodes", "20", "--rounds", "5"])
+    assert out["count"] == 100 and out["puts_per_sec"] > 0
+    # Renewals are updates of the same 20 keys.
+    res = store.range(
+        f"/registry/leases/{LEASE_NS}/".encode(),
+        prefix_end(f"/registry/leases/{LEASE_NS}/".encode()),
+    )
+    assert res.count == 20
+    assert all(kv.version == 5 for kv in res.kvs)
+
+    out = run_tool(
+        loop, store_stress,
+        base + ["--puts", "200", "--ranges", "20", "--value-size", "64"],
+    )
+    assert out["puts_per_sec"] > 0 and out["ranges_per_sec"] > 0
+
+
+def test_watch_stress_counts_amplification(env):
+    loop, store, target = env
+    out = run_tool(
+        loop, watch_stress,
+        ["--target", target, "--quiet", "--watchers", "5",
+         "--writes", "40", "--write-concurrency", "4"],
+    )
+    assert out["events_delivered"] == 5 * 40
+    assert out["events_per_sec"] > 0
+
+
+def test_kwok_lease_delay_metric(env):
+    loop, store, target = env
+    run_tool(loop, make_nodes,
+             ["--target", target, "--quiet", "--count", "5"])
+    c = KwokController(store, 0)
+    c.bootstrap(now=0.0)
+    # Tick far past the due time: the delay histogram must see it.
+    c.tick(now=100.0)
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    rendered = REGISTRY.render()
+    assert "kwok_node_lease_delay_seconds" in rendered
+    assert "kwok_lease_renewals_total" in rendered

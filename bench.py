@@ -34,12 +34,24 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1 << 20)
     ap.add_argument("--batch", type=int, default=512)
-    ap.add_argument("--chunk", type=int, default=1 << 14)
+    ap.add_argument(
+        "--chunk", type=int, default=None,
+        help="node-chunk size (default: per-backend sweet spot)",
+    )
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument(
+        "--backend", choices=("xla", "pallas"), default="pallas",
+        help="filter+score+top-k backend; pallas is the fused kernel "
+        "(ops/pallas_topk.py), xla the scan path (engine/cycle.py)",
+    )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+    if args.chunk is None:
+        # Sweet spots: VMEM-sized tiles for the fused kernel, bigger scan
+        # chunks for the XLA path.
+        args.chunk = (1 << 12) if args.backend == "pallas" else (1 << 14)
 
     spec = TableSpec(max_nodes=args.nodes)
     host = NodeTableHost(spec)
@@ -48,7 +60,12 @@ def main():
     build_s = time.perf_counter() - t0
 
     enc = PodBatchHost(PodSpec(batch=args.batch), spec, host.vocab)
-    profile = Profile(topology_spread=0, interpod_affinity=0)
+    # Uniform KWOK pods carry no affinity/spread terms, so the base profile
+    # is exact for this workload (affinity plugins would contribute
+    # identically-zero scores); it is also what the pallas backend covers.
+    profile = Profile(
+        node_affinity=0, topology_spread=0, interpod_affinity=0
+    )
 
     table = host.to_device()
     batch = enc.encode(uniform_pods(args.batch))
@@ -64,7 +81,8 @@ def main():
     def step(table, batch, key):
         k1, k2 = jax.random.split(key)
         table, _, asg = schedule_batch(
-            table, batch, k1, profile=profile, chunk=args.chunk, k=args.k
+            table, batch, k1, profile=profile, chunk=args.chunk, k=args.k,
+            backend=args.backend,
         )
         return table, k2, asg.bound.sum(dtype=jax.numpy.int32)
 

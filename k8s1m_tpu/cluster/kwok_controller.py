@@ -17,6 +17,7 @@ reproduced as a histogram of (actual - scheduled) renewal delay.
 from __future__ import annotations
 
 import json
+import zlib
 
 from k8s1m_tpu.control.objects import lease_key, node_key, pod_key
 from k8s1m_tpu.obs.metrics import Counter, Histogram
@@ -59,6 +60,10 @@ class KwokController:
         self._nodes_watch = None
         self._pods_watch = None
         self.running_pods: set[str] = set()
+        # Pods bound to one of OUR nodes whose adoption event hasn't been
+        # applied yet (node and pod watches are separate queues, so a bind
+        # can be seen before its node) — parked per node, started on adopt.
+        self._waiting: dict[str, dict[str, tuple[bytes, int]]] = {}
 
     # ---- membership ----------------------------------------------------
 
@@ -87,18 +92,34 @@ class KwokController:
     def _adopt(self, name: str, now: float) -> None:
         self.nodes.add(name)
         # Stagger first renewals across the interval so 1M leases spread
-        # evenly instead of arriving in one spike.
-        offset = (hash(name) % 1000) / 1000.0 * self.renew_interval_s
+        # evenly instead of arriving in one spike.  crc32, not hash():
+        # hash() is salted per process, which would make the renewal
+        # schedule (and the delay histogram) nondeterministic across runs.
+        offset = (zlib.crc32(name.encode()) % 1000) / 1000.0 * self.renew_interval_s
         self._next_renewal[name] = now + offset
+        for data, mod in self._waiting.pop(name, {}).values():
+            self._maybe_start_pod(data, mod)
 
     # ---- pod lifecycle -------------------------------------------------
 
     def _maybe_start_pod(self, data: bytes, mod_revision: int) -> None:
         obj = json.loads(data)
         node = obj.get("spec", {}).get("nodeName")
-        if not node or node not in self.nodes:
+        if not node:
             return
         if obj.get("status", {}).get("phase") != "Pending":
+            return
+        if node not in self.nodes:
+            # Our node-adoption event may simply not have been applied yet.
+            # Check ownership against the store directly: if the node is
+            # ours, park the pod until _adopt replays it; if it belongs to
+            # another group (or doesn't exist), it's not ours to start.
+            kv = self.store.get(node_key(node))
+            if kv is None or not self._owns(json.loads(kv.value)):
+                return
+            pk = (f"{obj['metadata'].get('namespace', 'default')}/"
+                  f"{obj['metadata']['name']}")
+            self._waiting.setdefault(node, {})[pk] = (data, mod_revision)
             return
         key = pod_key(obj["metadata"].get("namespace", "default"),
                       obj["metadata"]["name"])
@@ -122,22 +143,32 @@ class KwokController:
         newly bound pods.  Returns per-tick stats."""
         renewed = 0
         started0 = len(self.running_pods)
-        for ev in self._nodes_watch.poll(10000):
-            name = ev.kv.key[len(NODES_PREFIX):].decode()
-            if ev.type == "PUT":
-                obj = json.loads(ev.kv.value)
-                if self._owns(obj) and name not in self.nodes:
-                    self._adopt(name, now)
-                elif not self._owns(obj) and name in self.nodes:
+        while True:  # drain fully — a fixed cap could starve adoption
+            evs = self._nodes_watch.poll(10000)
+            for ev in evs:
+                name = ev.kv.key[len(NODES_PREFIX):].decode()
+                if ev.type == "PUT":
+                    obj = json.loads(ev.kv.value)
+                    if self._owns(obj) and name not in self.nodes:
+                        self._adopt(name, now)
+                    elif not self._owns(obj) and name in self.nodes:
+                        self._drop(name)
+                elif name in self.nodes:
                     self._drop(name)
-            elif name in self.nodes:
-                self._drop(name)
-        for ev in self._pods_watch.poll(10000):
-            if ev.type == "PUT":
-                self._maybe_start_pod(ev.kv.value, ev.kv.mod_revision)
-            else:
-                key = ev.kv.key[len(PODS_PREFIX):].decode()
-                self.running_pods.discard(key)
+            if len(evs) < 10000:
+                break
+        while True:
+            evs = self._pods_watch.poll(10000)
+            for ev in evs:
+                if ev.type == "PUT":
+                    self._maybe_start_pod(ev.kv.value, ev.kv.mod_revision)
+                else:
+                    key = ev.kv.key[len(PODS_PREFIX):].decode()
+                    self.running_pods.discard(key)
+                    for waiting in self._waiting.values():
+                        waiting.pop(key, None)
+            if len(evs) < 10000:
+                break
 
         for name, due in self._next_renewal.items():
             if due <= now:
@@ -155,6 +186,7 @@ class KwokController:
     def _drop(self, name: str) -> None:
         self.nodes.discard(name)
         self._next_renewal.pop(name, None)
+        self._waiting.pop(name, None)
         self.store.delete(lease_key(LEASE_NS, name))
 
     def _renew_lease(self, name: str, now: float) -> None:

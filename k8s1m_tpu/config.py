@@ -59,6 +59,12 @@ class PodSpec:
 # at 1 so zero-initialised padding is automatically "absent".
 NONE_ID = 0
 
+# The schedulerName this framework answers to (the reference's intake
+# filter, webhook.go:102-125).  Single source of truth — the coordinator
+# ignores every pod whose schedulerName differs, so a drifted copy would
+# silently schedule nothing.
+DEFAULT_SCHEDULER = "dist-scheduler"
+
 # Taint / toleration effects (reference mem of upstream v1.Taint effects).
 EFFECT_NONE = 0                # toleration with no effect: matches all
 EFFECT_NO_SCHEDULE = 1

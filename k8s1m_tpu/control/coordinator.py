@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.config import DEFAULT_SCHEDULER, PodSpec, TableSpec
 from k8s1m_tpu.control.objects import (
     decode_node,
     decode_pod,
@@ -114,7 +114,7 @@ class Coordinator:
         k: int = 4,
         with_constraints: bool = True,
         max_attempts: int = 5,
-        scheduler_name: str = "dist-scheduler",
+        scheduler_name: str = DEFAULT_SCHEDULER,
         seed: int = 0,
         flight_recorder: FlightRecorder | None = None,
     ):

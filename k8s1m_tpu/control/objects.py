@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 
 from k8s1m_tpu.config import (
+    DEFAULT_SCHEDULER,
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
     EFFECT_NONE,
@@ -51,7 +52,6 @@ from k8s1m_tpu.snapshot.pod_encoding import (
     Toleration,
 )
 
-DEFAULT_SCHEDULER = "dist-scheduler"
 
 _EFFECTS = {
     "": EFFECT_NONE,

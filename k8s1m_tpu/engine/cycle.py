@@ -293,16 +293,27 @@ def _schedule_batch_impl(
     profile: Profile,
     chunk: int,
     k: int,
+    backend: str = "xla",
 ):
-    cand = filter_score_topk(
-        table, batch, key, profile,
-        chunk=chunk, k=k, constraints=constraints,
-    )
+    if backend == "pallas":
+        from k8s1m_tpu.ops.pallas_topk import pallas_candidates
+
+        cand = pallas_candidates(
+            table, batch, key, profile, chunk=chunk, k=k
+        )
+    else:
+        cand = filter_score_topk(
+            table, batch, key, profile,
+            chunk=chunk, k=k, constraints=constraints,
+        )
     return finalize_batch(table, constraints, cand, commit_fields_of(batch))
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_schedule(profile: Profile, chunk: int, k: int, with_constraints: bool):
+def _jitted_schedule(
+    profile: Profile, chunk: int, k: int, with_constraints: bool,
+    backend: str = "xla",
+):
     # One jax.jit function object per static configuration.  Routing every
     # configuration through a single jitted function trips a pjit fast-path
     # cache bug in this environment once the function owns several
@@ -310,11 +321,11 @@ def _jitted_schedule(profile: Profile, chunk: int, k: int, with_constraints: boo
     # expected 67 buffers"); distinct function identities sidestep it.
     if with_constraints:
         fn = lambda table, batch, key, constraints: _schedule_batch_impl(
-            table, batch, key, constraints, profile, chunk, k
+            table, batch, key, constraints, profile, chunk, k, backend
         )
     else:
         fn = lambda table, batch, key: _schedule_batch_impl(
-            table, batch, key, None, profile, chunk, k
+            table, batch, key, None, profile, chunk, k, backend
         )
     return jax.jit(fn)
 
@@ -328,14 +339,26 @@ def schedule_batch(
     constraints: ConstraintState | None = None,
     chunk: int = 16384,
     k: int = 4,
+    backend: str = "xla",
 ):
     """Schedule one pod batch end-to-end on a single device.
 
     Returns (new_table, new_constraints, Assignment).  The table and
     constraint counts come back with this batch's binds already folded in
     (the assume step), so back-to-back batches see each other's placements.
+
+    ``backend="pallas"`` routes filter+score+top-k through the fused
+    Pallas kernel (ops/pallas_topk.py) — base profile only.
     """
-    step = _jitted_schedule(profile, chunk, k, constraints is not None)
+    if backend == "pallas":
+        from k8s1m_tpu.ops import pallas_topk
+
+        if constraints is not None or not pallas_topk.supports(profile):
+            raise ValueError(
+                "backend='pallas' requires the base profile and no "
+                "constraint state (see ops/pallas_topk.py)"
+            )
+    step = _jitted_schedule(profile, chunk, k, constraints is not None, backend)
     if constraints is None:
         table, cons, asg = step(table, batch, key)
     else:

@@ -1,0 +1,463 @@
+"""Pallas TPU kernel: fused filter+score+pack+top-k over the node table.
+
+This is the hot loop of the whole framework — the work the reference
+spreads over 8,670 CPU cores (256 scheduler shards x filter+score per pod,
+~560us/pod, reference README.adoc:783-787) — as one Pallas kernel:
+
+- streams the node table HBM -> VMEM once per batch (grid over node
+  chunks), never materializing any [B, N] intermediate in HBM; the XLA
+  scan path writes the packed-priority matrix per chunk and re-reads it
+  inside ``lax.top_k``;
+- recasts the taint-toleration gather (``tolerated[b, taint_id[n, t]]``,
+  awkward on TPU) as a one-hot matmul on the MXU: per chunk a dense
+  [max_taint_ids, C] taint-incidence matrix is built from the (TS, C)
+  taint slots, and ``untolerated @ incidence`` yields per-(pod, node)
+  untolerated-taint counts for both the hard filter and the soft score;
+- carries a running top-k per pod in VMEM across the chunk grid
+  (accumulator-output pattern), merged by K max-extract passes — no sort.
+
+Plugin coverage (the base profile; BASELINE.json configs 1-2 resource
+path): NodeResourcesFit + NodeName + TaintToleration(+NodeUnschedulable)
+filters; LeastAllocated + BalancedAllocation + TaintToleration scores.
+Label-selector plugins (NodeAffinity) and constraint plugins
+(PodTopologySpread, InterPodAffinity) stay on the XLA path — their
+vocab-sized gathers don't fit the dense-kernel mold; the engine picks the
+backend per batch (engine/cycle.py schedule_batch).
+
+Tie-break parity: priorities pack ``score << JITTER_BITS | jitter`` like
+ops/priority.py, but jitter comes from a stateless integer hash of
+(seed, pod, node) — identical in compiled and interpreter mode, so tests
+can compare CPU-interpreted and TPU-compiled runs bit for bit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from k8s1m_tpu.config import (
+    EFFECT_NO_EXECUTE,
+    EFFECT_NO_SCHEDULE,
+    EFFECT_PREFER_NO_SCHEDULE,
+    NONE_ID,
+)
+from k8s1m_tpu.ops.priority import JITTER_BITS, MAX_SCORE
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.node_table import NodeTable
+from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+def supports(profile: Profile) -> bool:
+    """True if the fused kernel computes this profile exactly."""
+    return (
+        profile.node_affinity == 0
+        and profile.topology_spread == 0
+        and profile.interpod_affinity == 0
+    )
+
+
+def _hash_jitter(seed, row_ids, col_ids):
+    """Stateless uniform bits in [0, 2^JITTER_BITS) per (pod, node).
+
+    A murmur3-style finalizer over (seed, pod index, global node index):
+    multiplicative mixing in uint32 wraps identically everywhere, so the
+    same seed gives the same tie-breaks on TPU and in interpret mode.
+    """
+    h = (
+        seed.astype(jnp.uint32)
+        ^ (row_ids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+        ^ (col_ids.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    )
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x7FEB352D)
+    h = h ^ (h >> 15)
+    h = h * jnp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return (h & jnp.uint32((1 << JITTER_BITS) - 1)).astype(jnp.int32)
+
+
+def _kernel(
+    seed_ref,      # i32[1, 1] SMEM
+    cpu_alloc,     # i32[1, C]
+    mem_alloc,     # i32[1, C]
+    pods_alloc,    # i32[1, C]
+    cpu_req,       # i32[1, C]
+    mem_req,       # i32[1, C]
+    pods_req,      # i32[1, C]
+    name_id,       # i32[1, C]
+    taint_id,      # i32[TS, C]
+    taint_eff,     # i32[TS, C]
+    p_cpu,         # i32[TB, 1]
+    p_mem,         # i32[TB, 1]
+    p_valid,       # i32[TB, 1]
+    p_nnid,        # i32[TB, 1]
+    untol,         # f32[TB, M]  1.0 where pod does NOT tolerate taint id m
+    out_idx,       # i32[TB, K] accumulator output
+    out_prio,      # i32[TB, K] accumulator output
+    run_prio,      # i32[TB, 128] VMEM scratch: lane-aligned running top-k
+    run_idx,       # i32[TB, 128] (slots k..127 stay -1)
+    *,
+    chunk: int,
+    k: int,
+    w_la: int,
+    w_ba: int,
+    w_tt: int,
+):
+    b_i = pl.program_id(0)
+    c_i = pl.program_id(1)
+
+    @pl.when(c_i == 0)
+    def _():
+        run_prio[:] = jnp.full(run_prio.shape, -1, jnp.int32)
+        run_idx[:] = jnp.full(run_idx.shape, -1, jnp.int32)
+
+    tb = p_cpu.shape[0]
+    ts, c = taint_id.shape
+    m = untol.shape[1]
+
+    # ---- NodeResourcesFit (+ row validity via pods_alloc==0 on dead rows).
+    free_cpu = cpu_alloc[:] - cpu_req[:]              # [1, C]
+    free_mem = mem_alloc[:] - mem_req[:]
+    free_pods = pods_alloc[:] - pods_req[:]
+    fits = (
+        (p_cpu[:] <= free_cpu)                        # [TB, C]
+        & (p_mem[:] <= free_mem)
+        & (free_pods >= 1)
+    )
+
+    # ---- NodeName.
+    nn_ok = (p_nnid[:] == NONE_ID) | (p_nnid[:] == name_id[:])
+
+    # ---- TaintToleration via one-hot matmul (see module doc).
+    tid = taint_id[:]                                 # [TS, C]
+    teff = taint_eff[:]
+    live = tid != NONE_ID
+    hard = live & (
+        (teff == EFFECT_NO_SCHEDULE) | (teff == EFFECT_NO_EXECUTE)
+    )
+    soft = live & (teff == EFFECT_PREFER_NO_SCHEDULE)
+    iota_m = lax.broadcasted_iota(jnp.int32, (m, c), 0)
+    inc_hard = jnp.zeros((m, c), jnp.float32)
+    inc_soft = jnp.zeros((m, c), jnp.float32)
+    for t in range(ts):
+        onehot = iota_m == tid[t : t + 1, :]          # [M, C]
+        inc_hard += jnp.where(onehot & hard[t : t + 1, :], 1.0, 0.0)
+        inc_soft += jnp.where(onehot & soft[t : t + 1, :], 1.0, 0.0)
+    hard_cnt = jnp.dot(untol[:], inc_hard, preferred_element_type=jnp.float32)
+    soft_cnt = jnp.dot(untol[:], inc_soft, preferred_element_type=jnp.float32)
+    taint_ok = hard_cnt < 0.5
+    tt_score = 100.0 * (1.0 - soft_cnt / ts)
+
+    # ---- LeastAllocated / BalancedAllocation (formulas mirror
+    # plugins/scores.py so the two backends agree digit for digit).
+    cpu_after = (cpu_req[:] + p_cpu[:]).astype(jnp.float32)       # [TB, C]
+    mem_after = (mem_req[:] + p_mem[:]).astype(jnp.float32)
+    alloc_cpu = jnp.maximum(cpu_alloc[:], 1).astype(jnp.float32)  # [1, C]
+    alloc_mem = jnp.maximum(mem_alloc[:], 1).astype(jnp.float32)
+    la = 50.0 * (
+        jnp.clip((alloc_cpu - cpu_after) / alloc_cpu, 0.0)
+        + jnp.clip((alloc_mem - mem_after) / alloc_mem, 0.0)
+    )
+    f_cpu = jnp.clip(cpu_after / alloc_cpu, 0.0, 1.0)
+    f_mem = jnp.clip(mem_after / alloc_mem, 0.0, 1.0)
+    ba = 100.0 * (1.0 - jnp.abs(f_cpu - f_mem) / 2.0)
+
+    score = jnp.zeros((tb, c), jnp.int32)
+    if w_la:
+        score += jnp.floor(la).astype(jnp.int32) * w_la
+    if w_ba:
+        score += jnp.floor(ba).astype(jnp.int32) * w_ba
+    if w_tt:
+        score += jnp.floor(tt_score).astype(jnp.int32) * w_tt
+
+    # ---- pack priority (ops/priority.py semantics, hash jitter).
+    rows = lax.broadcasted_iota(jnp.int32, (tb, c), 0) + b_i * tb
+    cols = lax.broadcasted_iota(jnp.int32, (tb, c), 1) + c_i * chunk
+    jitter = _hash_jitter(seed_ref[0, 0], rows, cols)
+    mask = fits & nn_ok & taint_ok & (p_valid[:] != 0)
+    prio = jnp.where(
+        mask,
+        (jnp.clip(score, 0, MAX_SCORE) << JITTER_BITS) | jitter,
+        -1,
+    )
+
+    # ---- merge chunk into the running top-k: K max-extract passes, all
+    # shapes lane-aligned (the running list lives in a 128-wide scratch so
+    # the concat below is 128-aligned; a (K+C)-wide ragged concat relayouts
+    # every op in the loop and dominated the kernel's runtime).
+    all_prio = jnp.concatenate([run_prio[:], prio], axis=1)       # [TB, 128+C]
+    all_idx = jnp.concatenate([run_idx[:], cols], axis=1)
+    width = 128 + c
+    pos_iota = lax.broadcasted_iota(jnp.int32, (tb, width), 1)
+    big = jnp.int32(width)
+    top_p = []
+    top_i = []
+    for _ in range(k):
+        mx = jnp.max(all_prio, axis=1, keepdims=True)             # [TB, 1]
+        at_max = all_prio == mx
+        pos = jnp.min(
+            jnp.where(at_max, pos_iota, big), axis=1, keepdims=True
+        )
+        first = pos_iota == pos                                   # one-hot
+        chosen = jnp.sum(jnp.where(first, all_idx, 0), axis=1)    # [TB]
+        top_p.append(mx[:, 0])
+        top_i.append(jnp.where(mx[:, 0] >= 0, chosen, -1))
+        all_prio = jnp.where(first, -2, all_prio)
+    new_p = jnp.stack(top_p, axis=1)                              # [TB, K]
+    new_i = jnp.stack(top_i, axis=1)
+    pad = jnp.full((tb, 128 - k), -1, jnp.int32)
+    run_prio[:] = jnp.concatenate([new_p, pad], axis=1)
+    run_idx[:] = jnp.concatenate([new_i, pad], axis=1)
+    last = pl.num_programs(1) - 1
+
+    @pl.when(c_i == last)
+    def _():
+        out_prio[:] = new_p
+        out_idx[:] = new_i
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk", "k", "w_la", "w_ba", "w_tt", "interpret"),
+)
+def _call(
+    seed,
+    cpu_alloc, mem_alloc, pods_alloc, cpu_req, mem_req, pods_req, name_id,
+    taint_id_t, taint_eff_t,
+    p_cpu, p_mem, p_valid, p_nnid, untol,
+    *,
+    chunk: int,
+    k: int,
+    w_la: int,
+    w_ba: int,
+    w_tt: int,
+    interpret: bool,
+):
+    n = cpu_alloc.shape[0]
+    b = p_cpu.shape[0]
+    ts = taint_id_t.shape[0]
+    m = untol.shape[1]
+    tb = b if (b <= 256 or b % 256) else 256
+    grid = (b // tb, n // chunk)
+
+    col = pl.BlockSpec(
+        (1, chunk), lambda bi, ci: (0, ci), memory_space=pltpu.VMEM
+    )
+    taint = pl.BlockSpec(
+        (ts, chunk), lambda bi, ci: (0, ci), memory_space=pltpu.VMEM
+    )
+    pod = pl.BlockSpec(
+        (tb, 1), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.BlockSpec((tb, k), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(
+        _kernel, chunk=chunk, k=k, w_la=w_la, w_ba=w_ba, w_tt=w_tt
+    )
+    idx, prio = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bi, ci: (0, 0), memory_space=pltpu.SMEM),
+            col, col, col, col, col, col, col,
+            taint, taint,
+            pod, pod, pod, pod,
+            pl.BlockSpec((tb, m), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(out, out),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tb, 128), jnp.int32),
+            pltpu.VMEM((tb, 128), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(
+        seed.reshape(1, 1),
+        cpu_alloc.reshape(1, n), mem_alloc.reshape(1, n),
+        pods_alloc.reshape(1, n),
+        cpu_req.reshape(1, n), mem_req.reshape(1, n), pods_req.reshape(1, n),
+        name_id.reshape(1, n),
+        taint_id_t, taint_eff_t,
+        p_cpu.reshape(b, 1), p_mem.reshape(b, 1),
+        p_valid.reshape(b, 1).astype(jnp.int32),
+        p_nnid.reshape(b, 1),
+        untol,
+    )
+    return idx, prio
+
+
+def fused_topk(
+    table: NodeTable,
+    batch: PodBatch,
+    seed: jax.Array,
+    profile: Profile,
+    *,
+    chunk: int,
+    k: int,
+    interpret: bool | None = None,
+):
+    """(idx i32[B,K], prio i32[B,K]) — global-row candidates, -1 = none.
+
+    ``seed`` is an i32 scalar (fold the batch counter in host-side).
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    tests run on the CPU mesh.
+    """
+    if not supports(profile):
+        raise ValueError(
+            "pallas backend supports only the base profile "
+            "(node_affinity/topology_spread/interpod_affinity weights 0); "
+            f"got {profile}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = table.num_rows
+    if n % chunk:
+        raise ValueError(f"table rows {n} not divisible by chunk {chunk}")
+    return _call(
+        jnp.asarray(seed, jnp.int32),
+        table.cpu_alloc, table.mem_alloc, table.pods_alloc,
+        table.cpu_req, table.mem_req, table.pods_req, table.name_id,
+        jnp.transpose(table.taint_id), jnp.transpose(table.taint_effect),
+        batch.cpu, batch.mem, batch.valid, batch.node_name_id,
+        1.0 - batch.tolerated.astype(jnp.float32),
+        chunk=chunk, k=k,
+        w_la=profile.least_allocated,
+        w_ba=profile.balanced_allocation,
+        w_tt=profile.taint_toleration,
+        interpret=interpret,
+    )
+
+
+def seed_of(key: jax.Array) -> jax.Array:
+    """Derive an i32 kernel seed from a jax PRNG key (host or traced)."""
+    return jax.random.randint(key, (), -(1 << 31), (1 << 31) - 1, jnp.int32)
+
+
+def pallas_candidates(
+    table: NodeTable,
+    batch: PodBatch,
+    key: jax.Array,
+    profile: Profile,
+    *,
+    chunk: int,
+    k: int,
+    row_offset=0,
+    interpret: bool | None = None,
+):
+    """Drop-in for engine.filter_score_topk on the base profile.
+
+    Returns engine.cycle.Candidates with the same payload columns (free
+    capacity + topology domains gathered at the candidate rows).
+    """
+    from k8s1m_tpu.engine.cycle import Candidates
+
+    idx, prio = fused_topk(
+        table, batch, seed_of(key), profile,
+        chunk=chunk, k=k, interpret=interpret,
+    )
+    safe = jnp.clip(idx, 0)
+    free_cpu, free_mem, free_pods = table.free()
+    feasible = prio >= 0
+    return Candidates(
+        idx=jnp.where(feasible, idx + row_offset, -1),
+        prio=prio,
+        cpu=jnp.take(free_cpu, safe),
+        mem=jnp.take(free_mem, safe),
+        pods=jnp.take(free_pods, safe),
+        zone=jnp.take(table.zone, safe),
+        region=jnp.take(table.region, safe),
+    )
+
+
+def np_reference_topk(table, batch, seed: int, profile: Profile, k: int):
+    """Pure-numpy oracle of the kernel (for differential tests): same
+    filters, scores, hash jitter, and first-position tie rule."""
+    ca = np.asarray(table.cpu_alloc, np.int64)
+    ma = np.asarray(table.mem_alloc, np.int64)
+    pa = np.asarray(table.pods_alloc, np.int64)
+    cr = np.asarray(table.cpu_req, np.int64)
+    mr = np.asarray(table.mem_req, np.int64)
+    pr = np.asarray(table.pods_req, np.int64)
+    nid = np.asarray(table.name_id)
+    tid = np.asarray(table.taint_id)
+    teff = np.asarray(table.taint_effect)
+    pc = np.asarray(batch.cpu, np.int64)[:, None]
+    pm = np.asarray(batch.mem, np.int64)[:, None]
+    pv = np.asarray(batch.valid)[:, None]
+    nn = np.asarray(batch.node_name_id)[:, None]
+    tol = np.asarray(batch.tolerated)
+
+    fits = (pc <= (ca - cr)) & (pm <= (ma - mr)) & ((pa - pr) >= 1)
+    nn_ok = (nn == NONE_ID) | (nn == nid[None, :])
+    live = tid != NONE_ID
+    hard = live & np.isin(teff, (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE))
+    soft = live & (teff == EFFECT_PREFER_NO_SCHEDULE)
+    untol = ~tol[:, tid]                                  # [B, N, TS]
+    hard_cnt = (untol & hard[None]).sum(-1)
+    soft_cnt = (untol & soft[None]).sum(-1)
+    ts = tid.shape[1]
+
+    cpu_after = (cr[None] + pc).astype(np.float32)
+    mem_after = (mr[None] + pm).astype(np.float32)
+    f_ca = np.maximum(ca, 1).astype(np.float32)[None]
+    f_ma = np.maximum(ma, 1).astype(np.float32)[None]
+    la = 50.0 * (
+        np.clip((f_ca - cpu_after) / f_ca, 0.0, None)
+        + np.clip((f_ma - mem_after) / f_ma, 0.0, None)
+    )
+    ba = 100.0 * (
+        1.0
+        - np.abs(
+            np.clip(cpu_after / f_ca, 0, 1) - np.clip(mem_after / f_ma, 0, 1)
+        )
+        / 2.0
+    )
+    tt = 100.0 * (1.0 - soft_cnt.astype(np.float32) / ts)
+    score = (
+        np.floor(la).astype(np.int64) * profile.least_allocated
+        + np.floor(ba).astype(np.int64) * profile.balanced_allocation
+        + np.floor(tt).astype(np.int64) * profile.taint_toleration
+    )
+
+    b, n = score.shape
+    rows = np.arange(b, dtype=np.uint32)[:, None]
+    cols = np.arange(n, dtype=np.uint32)[None, :]
+    h = (
+        np.uint32(seed & 0xFFFFFFFF)   # seed_of() draws negatives too
+        ^ (rows * np.uint32(0x9E3779B9))
+        ^ (cols * np.uint32(0x85EBCA6B))
+    )
+    h ^= h >> np.uint32(16)
+    h *= np.uint32(0x7FEB352D)
+    h ^= h >> np.uint32(15)
+    h *= np.uint32(0x846CA68B)
+    h ^= h >> np.uint32(16)
+    jitter = (h & np.uint32((1 << JITTER_BITS) - 1)).astype(np.int64)
+
+    mask = fits & nn_ok & (hard_cnt == 0) & pv
+    prio = np.where(
+        mask, (np.clip(score, 0, MAX_SCORE) << JITTER_BITS) | jitter, -1
+    ).astype(np.int64)
+
+    out_i = np.full((b, k), -1, np.int32)
+    out_p = np.full((b, k), -1, np.int32)
+    work = prio.copy()
+    for j in range(k):
+        best = work.argmax(axis=1)
+        mx = work[np.arange(b), best]
+        out_p[:, j] = mx
+        out_i[:, j] = np.where(mx >= 0, best, -1)
+        work[np.arange(b), best] = -2
+    return out_i, out_p

@@ -34,6 +34,7 @@ import numpy as np
 from flax import struct
 
 from k8s1m_tpu.config import (
+    DEFAULT_SCHEDULER,
     EFFECT_NONE,
     NO_NUMERIC,
     NONE_ID,
@@ -107,7 +108,7 @@ class PodInfo:
     namespace: str = "default"
     cpu_milli: int = 100
     mem_kib: int = 200 << 10       # 200 MiB
-    scheduler_name: str = "dist-scheduler"
+    scheduler_name: str = DEFAULT_SCHEDULER
     node_name: str | None = None
     node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
     tolerations: list[Toleration] = dataclasses.field(default_factory=list)

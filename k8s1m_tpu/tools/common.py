@@ -9,6 +9,7 @@ pool over one or more gRPC channels with a periodic rate reporter.
 from __future__ import annotations
 
 import asyncio
+import sys
 import time
 
 from k8s1m_tpu.store.etcd_client import EtcdClient
@@ -54,30 +55,54 @@ async def run_sharded(
     *,
     clients: int = 1,
     reporter: RateReporter | None = None,
+    retries: int = 2,
+    max_errors: int | None = None,
 ):
     """Run ``work(client, index)`` for index in [0, total) across a worker
     pool; ``clients`` separate channels spread HTTP/2 stream contention
-    the way the reference uses multiple clientsets."""
+    the way the reference uses multiple clientsets.
+
+    A failing item is retried ``retries`` times, then counted in
+    ``reporter.errors`` and skipped — one transient gRPC error must not
+    abort an hours-long load run.  ``max_errors`` (default: 1% of total,
+    at least 100) aborts runs where the target is actually down.
+    """
+    if max_errors is None:
+        max_errors = max(100, total // 100)
     pool = [make_client() for _ in range(max(1, clients))]
     queue: asyncio.Queue = asyncio.Queue()
     for i in range(total):
         queue.put_nowait(i)
+    errors = 0
 
     async def worker(wid: int):
+        nonlocal errors
         client = pool[wid % len(pool)]
         while True:
             try:
                 i = queue.get_nowait()
             except asyncio.QueueEmpty:
                 return
-            try:
-                await work(client, i)
-                if reporter:
-                    reporter.add()
-            except Exception:
-                if reporter:
-                    reporter.errors += 1
-                raise
+            for attempt in range(retries + 1):
+                try:
+                    await work(client, i)
+                    if reporter:
+                        reporter.add()
+                    break
+                except Exception as e:
+                    if attempt == retries:
+                        errors += 1
+                        if reporter:
+                            reporter.errors += 1
+                        print(
+                            f"work item {i} failed after {retries + 1} "
+                            f"attempts: {e!r}",
+                            file=sys.stderr,
+                        )
+                        if errors > max_errors:
+                            raise
+            if errors > max_errors:
+                return
 
     try:
         await asyncio.gather(*(worker(w) for w in range(concurrency)))

@@ -39,14 +39,20 @@ async def amain(args) -> dict:
         sessions.append(s)
 
     delivered = 0
+    stream_errors = 0
     done = asyncio.Event()
 
     async def drain(s):
-        nonlocal delivered
+        nonlocal delivered, stream_errors
         while delivered < args.watchers * args.writes:
             try:
                 batch = await s.next(timeout=10)
-            except (asyncio.TimeoutError, Exception):
+            except asyncio.TimeoutError:
+                return
+            except Exception:
+                # A failed stream must not masquerade as slow delivery:
+                # count it so the summary distinguishes error from lag.
+                stream_errors += 1
                 return
             delivered += len(batch.events)
             if delivered >= args.watchers * args.writes:
@@ -83,6 +89,7 @@ async def amain(args) -> dict:
         "events_delivered": delivered,
         "events_per_sec": round(delivered / total_s, 1),
         "amplification": args.watchers,
+        "stream_errors": stream_errors,
     }
 
 

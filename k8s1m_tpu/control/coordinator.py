@@ -36,6 +36,7 @@ import collections
 import dataclasses
 import json
 import logging
+import threading
 import time
 import weakref
 
@@ -47,6 +48,7 @@ from k8s1m_tpu.config import DEFAULT_SCHEDULER, PodSpec, TableSpec
 from k8s1m_tpu.control.objects import (
     decode_node,
     decode_pod,
+    decode_pod_obj,
     node_key,
     pod_key,
 )
@@ -95,7 +97,9 @@ _BIND_LATENCY = Histogram(
 @dataclasses.dataclass
 class PendingPod:
     pod: PodInfo
-    mod_revision: int
+    # None = webhook intake: the object wasn't persisted at admission
+    # time, so the bind path resolves the live revision instead.
+    mod_revision: int | None
     enqueued_at: float
     attempts: int = 0
 
@@ -139,6 +143,11 @@ class Coordinator:
 
         self.queue: collections.deque[PendingPod] = collections.deque()
         self._queued_keys: set[str] = set()
+        # Webhook-intake staging: appended from server threads, drained
+        # into the queue at the top of each cycle (deque+set aren't
+        # thread-safe to mutate from the handler directly).
+        self._external: list[dict] = []
+        self._external_lock = threading.Lock()
         # Bound-pod record per pod key: (node, cpu, mem, zone, region, pod?).
         # The PodInfo is retained only for constraint-carrying pods — it is
         # needed to decrement count tables on deletion; plain pods stay
@@ -232,7 +241,12 @@ class Coordinator:
             # Not ours to schedule (the reference's webhook/watch intake
             # applies the same schedulerName filter, webhook.go:102-125).
             return
-        if pod.key in self._queued_keys:
+        if pod.key in self._queued_keys or pod.key in self._bound:
+            # _bound: a webhook-intake pod can bind before its original
+            # create event arrives via watch; re-enqueuing that stale
+            # revision would double-account the pod in the batch it rides
+            # (commit_binds assumes, CAS rolls back — but batch-mates
+            # would have been placed against inflated usage meanwhile).
             return
         self._queued_keys.add(pod.key)
         self.queue.append(PendingPod(pod, mod_revision, time.perf_counter()))
@@ -406,8 +420,37 @@ class Coordinator:
                     jnp.asarray(mask_node), jnp.asarray(mask_dom), sign=sign,
                 )
 
+    def submit_external(self, obj: dict) -> None:
+        """Thread-safe webhook-intake sink (control/webhook.py).
+
+        The pod is staged and enters the queue at the next cycle; the
+        store watch remains the fallback intake, deduplicated by key.
+        """
+        with self._external_lock:
+            self._external.append(obj)
+
+    def _drain_external(self) -> None:
+        if not self._external:
+            return
+        with self._external_lock:
+            staged, self._external = self._external, []
+        for obj in staged:
+            try:
+                pod = decode_pod_obj(obj, self.tracker)
+            except Exception:
+                _DECODE_ERRORS.inc(kind="pod")
+                log.exception("undecodable webhook pod; skipping")
+                continue
+            if pod.node_name or pod.scheduler_name != self.scheduler_name:
+                continue
+            if pod.key in self._queued_keys or pod.key in self._bound:
+                continue
+            self._queued_keys.add(pod.key)
+            self.queue.append(PendingPod(pod, None, time.perf_counter()))
+
     def step(self) -> int:
         """One scheduling cycle; returns number of pods bound."""
+        self._drain_external()
         self.drain_watches()
         self._sync_table()
         self._process_adjusts()
@@ -475,15 +518,28 @@ class Coordinator:
         """CAS spec.nodeName into the pod object; False on conflict."""
         key = pod_key(p.pod.namespace, p.pod.name)
         cur = self.store.get(key)
-        if cur is None or cur.mod_revision != p.mod_revision:
+        if cur is None:
             _PODS_SCHEDULED.inc(outcome="conflict")
             return False
-        obj = json.loads(cur.value)
+        if p.mod_revision is None:
+            # Webhook intake: no revision was observed at admission.  Bind
+            # against the live revision — unless someone already bound it.
+            obj = json.loads(cur.value)
+            if obj.get("spec", {}).get("nodeName"):
+                _PODS_SCHEDULED.inc(outcome="conflict")
+                return False
+            required = cur.mod_revision
+        elif cur.mod_revision != p.mod_revision:
+            _PODS_SCHEDULED.inc(outcome="conflict")
+            return False
+        else:
+            obj = json.loads(cur.value)
+            required = p.mod_revision
         obj["spec"]["nodeName"] = node_name
         ok, _, _ = self.store.cas(
             key,
             json.dumps(obj, separators=(",", ":")).encode(),
-            required_mod=p.mod_revision,
+            required_mod=required,
         )
         if not ok:
             _PODS_SCHEDULED.inc(outcome="conflict")
@@ -517,6 +573,15 @@ class Coordinator:
         self._queued_keys.add(p.pod.key)
         self.queue.append(p)
 
+    def close(self) -> None:
+        """Cancel store watches (native watchers are registered until
+        explicitly cancelled — dropping the object alone would leave the
+        store dispatching into a 10,000-event queue forever)."""
+        for w in (self._nodes_watch, self._pods_watch):
+            if w is not None:
+                w.cancel()
+        self._nodes_watch = self._pods_watch = None
+
     def run_until_idle(self, max_cycles: int = 10000) -> int:
         """Drive cycles until no pending pods remain; returns total binds."""
         total = 0
@@ -526,7 +591,7 @@ class Coordinator:
             total += n
             if not self.queue:
                 idle += 1
-                if idle > 1 and self.drain_watches() == 0:
+                if idle > 1 and self.drain_watches() == 0 and not self._external:
                     break
             else:
                 idle = 0

@@ -1,0 +1,236 @@
+"""Store-backed leader election + coordinator failover.
+
+The reference elects a dist-scheduler leader through client-go's Lease
+leaderelection (15s lease / 10s renew / 2s retry, reference
+cmd/dist-scheduler/leader_activities.go:34-98); the leader runs the
+webhook intake, node labeler, and webhook-Endpoints management, and a
+replica that loses the lease steps down so a standby takes over.
+
+Here the same contract runs against the native store: the election
+object is a Lease under ``/registry/leases/<ns>/<name>`` and every
+transition is a Txn CAS on its mod revision, so two candidates can never
+both believe they acquired it (the store is the single arbiter exactly
+as the apiserver+etcd pair is upstream).  Time is injected (``now``)
+rather than read from the clock — elections are tick-driven like the
+KWOK simulator, so failover paths are deterministically testable.
+
+``HACoordinator`` pairs an elector with a Coordinator: only the current
+leader bootstraps and drives scheduling cycles; on lease loss it tears
+its watches down, and a standby's elector acquires and bootstraps fresh
+(scheduler state is all soft — rebuilt from store watches, the same
+"reconcile or rebuild" stance as the reference, README.adoc:184-214).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+
+from k8s1m_tpu.control.objects import lease_key
+from k8s1m_tpu.obs.metrics import Counter, Gauge
+from k8s1m_tpu.store.native import MemStore
+
+log = logging.getLogger("k8s1m.leader")
+
+_TRANSITIONS = Counter(
+    "leader_transitions_total", "Leadership acquisitions", ("identity",)
+)
+_IS_LEADER = Gauge("leader_is_leader", "1 if this elector holds the lease",
+                   ("identity",))
+
+
+@dataclasses.dataclass
+class LeaseRecord:
+    holder: str
+    acquire_time: float
+    renew_time: float
+    lease_duration_s: float
+    transitions: int
+
+    def encode(self) -> bytes:
+        return json.dumps(
+            {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "spec": {
+                    "holderIdentity": self.holder,
+                    "acquireTime": self.acquire_time,
+                    "renewTime": self.renew_time,
+                    "leaseDurationSeconds": self.lease_duration_s,
+                    "leaseTransitions": self.transitions,
+                },
+            },
+            separators=(",", ":"),
+        ).encode()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "LeaseRecord":
+        spec = json.loads(data)["spec"]
+        return cls(
+            holder=spec["holderIdentity"],
+            acquire_time=spec["acquireTime"],
+            renew_time=spec["renewTime"],
+            lease_duration_s=spec["leaseDurationSeconds"],
+            transitions=spec.get("leaseTransitions", 0),
+        )
+
+
+class LeaderElector:
+    """One candidate's view of a named election.
+
+    Call ``tick(now)`` at least every ``retry_period_s``; it returns True
+    while this candidate holds the lease.  Semantics mirror client-go:
+    - acquire when the lease is absent, expired, or already ours;
+    - renew every ``renew_period_s`` via CAS on the observed revision;
+    - a failed CAS (someone else wrote) re-reads and backs off;
+    - ``release()`` clears holderIdentity for fast handover on clean
+      shutdown (leader_activities.go clears the webhook Endpoints the
+      same way).
+    """
+
+    def __init__(
+        self,
+        store: MemStore,
+        identity: str,
+        *,
+        name: str = "dist-scheduler-tpu",
+        namespace: str = "kube-system",
+        lease_duration_s: float = 15.0,
+        renew_period_s: float = 10.0,
+        retry_period_s: float = 2.0,
+    ):
+        self.store = store
+        self.identity = identity
+        self.key = lease_key(namespace, name)
+        self.lease_duration_s = lease_duration_s
+        self.renew_period_s = renew_period_s
+        self.retry_period_s = retry_period_s
+        self.is_leader = False
+        self._observed_rev = 0
+        self._observed: LeaseRecord | None = None
+        self._last_attempt = -1e18
+
+    # ---- internals -----------------------------------------------------
+
+    def _observe(self) -> None:
+        kv = self.store.get(self.key)
+        if kv is None:
+            self._observed, self._observed_rev = None, 0
+        else:
+            self._observed = LeaseRecord.decode(kv.value)
+            self._observed_rev = kv.mod_revision
+
+    def _try_write(self, record: LeaseRecord) -> bool:
+        if self._observed_rev == 0:
+            ok, rev, _ = self.store.cas(
+                self.key, record.encode(), required_mod=0
+            )
+        else:
+            ok, rev, _ = self.store.cas(
+                self.key, record.encode(), required_mod=self._observed_rev
+            )
+        if ok:
+            self._observed, self._observed_rev = record, rev
+        else:
+            self._observe()
+        return ok
+
+    # ---- public --------------------------------------------------------
+
+    def tick(self, now: float) -> bool:
+        """Advance the election; returns current leadership."""
+        if self.is_leader:
+            if now - self._observed.renew_time >= self.renew_period_s:
+                renewed = self._try_write(
+                    dataclasses.replace(self._observed, renew_time=now)
+                )
+                if not renewed:
+                    # Someone stole the lease (we must have been expired).
+                    log.warning("%s: lost leadership to %s", self.identity,
+                                self._observed.holder if self._observed else "?")
+                    self.is_leader = False
+                    _IS_LEADER.set(0, identity=self.identity)
+            return self.is_leader
+
+        if now - self._last_attempt < self.retry_period_s:
+            return False
+        self._last_attempt = now
+        self._observe()
+        rec = self._observed
+        expired = rec is None or not rec.holder or (
+            now - rec.renew_time >= rec.lease_duration_s
+        )
+        if not expired and rec.holder != self.identity:
+            return False
+        acquired = self._try_write(
+            LeaseRecord(
+                holder=self.identity,
+                acquire_time=now,
+                renew_time=now,
+                lease_duration_s=self.lease_duration_s,
+                transitions=(rec.transitions + 1) if rec else 0,
+            )
+        )
+        if acquired:
+            self.is_leader = True
+            _TRANSITIONS.inc(identity=self.identity)
+            _IS_LEADER.set(1, identity=self.identity)
+            log.info("%s: acquired leadership", self.identity)
+        return self.is_leader
+
+    def release(self) -> None:
+        """Voluntarily give up the lease (clean shutdown handover)."""
+        if not self.is_leader:
+            return
+        self.is_leader = False
+        _IS_LEADER.set(0, identity=self.identity)
+        self._try_write(dataclasses.replace(self._observed, holder=""))
+
+
+class HACoordinator:
+    """Leader-gated coordinator: standby until elected, step while leading.
+
+    The coordinator's watches/table are built on acquisition and torn
+    down (watches cancelled) on loss — state is soft, the store is
+    authoritative.  ``make_coord`` builds a fresh Coordinator, so a
+    re-election never reuses stale snapshot state from a previous reign.
+
+    Webhook intake goes through ``submit_external`` on *this* object —
+    a reign-stable sink.  While standby (or between reigns) admitted pods
+    are dropped: their store writes arrive via the next leader's watch
+    bootstrap, which is exactly the webhook-miss fallback path.
+    """
+
+    def __init__(self, elector: LeaderElector, make_coord):
+        self.elector = elector
+        self.make_coord = make_coord
+        self.coord = None
+
+    def submit_external(self, obj: dict) -> None:
+        """Reign-stable webhook sink: forwards to the current reign's
+        coordinator; safe to wire into a long-lived WebhookServer."""
+        coord = self.coord
+        if coord is not None:
+            coord.submit_external(obj)
+
+    def tick(self, now: float) -> int:
+        """Run one election step and (if leading) one scheduling cycle.
+        Returns pods bound this tick."""
+        was_leader = self.elector.is_leader
+        leading = self.elector.tick(now)
+        if leading and not was_leader:
+            self.coord = self.make_coord()
+            self.coord.bootstrap()
+        elif not leading and was_leader:
+            self.coord.close()
+            self.coord = None
+        if not leading:
+            return 0
+        return self.coord.step()
+
+    def stop(self) -> None:
+        self.elector.release()
+        if self.coord is not None:
+            self.coord.close()
+            self.coord = None

@@ -282,7 +282,11 @@ def decode_pod(data: bytes, tracker: ConstraintTracker | None = None) -> PodInfo
     Without a tracker, podAffinity/topologySpreadConstraints are ignored
     (the caller only wants identity/resources — e.g. load accounting).
     """
-    obj = json.loads(data)
+    return decode_pod_obj(json.loads(data), tracker)
+
+
+def decode_pod_obj(obj: dict, tracker: ConstraintTracker | None = None) -> PodInfo:
+    """dict -> PodInfo (webhook intake already holds the parsed object)."""
     meta = obj.get("metadata", {})
     spec = obj.get("spec", {})
     namespace = meta.get("namespace", "default")

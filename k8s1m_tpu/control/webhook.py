@@ -1,0 +1,136 @@
+"""Admission-webhook pod intake: the reference's primary intake path.
+
+The reference feeds pods to the scheduler through a ValidatingWebhook —
+the apiserver POSTs an AdmissionReview to the leader's ``/validate``
+endpoint, which always allows and enqueues pods whose schedulerName
+matches (reference pkg/webhook/webhook.go:71-126).  It exists because
+the fieldSelector pod watch stalled for tens of seconds above ~5K pods/s
+(reference README.adoc:684-695): admission fires *before* the write is
+persisted, shaving the store round-trip off schedule latency.
+
+Same contract here: ``WebhookServer`` accepts AdmissionReview v1 JSON,
+always allows, and hands matching pods to a sink (the coordinator's
+``submit_external``).  A webhook-intake pod carries no mod revision yet
+(the object isn't persisted at admission time), so the bind path resolves
+the current revision at bind time; the store-watch intake remains the
+fallback — a pod whose webhook delivery was lost still arrives via watch
+(intake is deduplicated by pod key).
+
+TLS: the reference terminates TLS with terraform-provisioned certs
+(dist-scheduler.tf:713-740); pass ``ssl_context`` to match, or run plain
+HTTP behind a trusted boundary.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k8s1m_tpu.config import DEFAULT_SCHEDULER
+from k8s1m_tpu.obs.metrics import Counter
+
+log = logging.getLogger("k8s1m.webhook")
+
+_REQUESTS = Counter(
+    "webhook_requests_total", "AdmissionReview requests", ("outcome",)
+)
+
+
+def review_response(uid: str) -> bytes:
+    return json.dumps(
+        {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "response": {"uid": uid, "allowed": True},
+        },
+        separators=(",", ":"),
+    ).encode()
+
+
+class WebhookServer:
+    """Threaded HTTP server for ``POST /validate``.
+
+    ``sink(pod_obj: dict)`` is called for every admitted pod with our
+    schedulerName and no nodeName; it must be thread-safe (the
+    coordinator's submit_external only appends to a locked queue).
+    """
+
+    def __init__(
+        self,
+        sink,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        scheduler_name: str = DEFAULT_SCHEDULER,
+        ssl_context=None,
+    ):
+        self.sink = sink
+        self.scheduler_name = scheduler_name
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug(fmt, *args)
+
+            def do_POST(self):
+                if self.path.split("?")[0] != "/validate":
+                    self.send_error(404)
+                    _REQUESTS.inc(outcome="not_found")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    review = json.loads(self.rfile.read(length))
+                    req = review["request"]
+                    uid = req.get("uid", "")
+                    obj = req.get("object") or {}
+                except Exception:
+                    self.send_error(400)
+                    _REQUESTS.inc(outcome="bad_request")
+                    return
+                # Always allow — admission must never block the write path
+                # (the reference responds before even parsing the pod,
+                # webhook.go:102-125).
+                body = review_response(uid)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                spec = obj.get("spec", {})
+                if (
+                    obj.get("kind") == "Pod"
+                    and spec.get("schedulerName", DEFAULT_SCHEDULER)
+                    == outer.scheduler_name
+                    and not spec.get("nodeName")
+                ):
+                    _REQUESTS.inc(outcome="enqueued")
+                    try:
+                        outer.sink(obj)
+                    except Exception:
+                        log.exception("webhook sink failed")
+                else:
+                    _REQUESTS.inc(outcome="ignored")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        if ssl_context is not None:
+            self._httpd.socket = ssl_context.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="webhook", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "WebhookServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

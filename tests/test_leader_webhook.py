@@ -1,0 +1,251 @@
+"""Leader election, coordinator failover, and webhook intake.
+
+Mirrors the reference's HA surface (leader_activities.go:34-98 lease
+election, webhook.go:71-126 intake): acquisition, renewal, expiry
+takeover, clean-release handover, and full failover where a standby
+coordinator reschedules the backlog after the leader dies mid-run.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.control.leader import HACoordinator, LeaderElector, LeaseRecord
+from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
+from k8s1m_tpu.control.webhook import WebhookServer
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.node_table import NodeInfo
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+from k8s1m_tpu.store.native import MemStore
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = MemStore(wal_dir=str(tmp_path / "wal"), wal_mode="none")
+    yield s
+    s.close()
+
+
+def put_nodes(store, n=8):
+    for i in range(n):
+        node = NodeInfo(f"node-{i}", cpu_milli=4000, mem_kib=8 << 20, pods=16)
+        store.put(node_key(node.name), encode_node(node))
+
+
+def put_pods(store, n, prefix="pod"):
+    for i in range(n):
+        p = PodInfo(f"{prefix}-{i}", cpu_milli=100, mem_kib=1 << 10)
+        store.put(pod_key("default", p.name), encode_pod(p))
+
+
+def make_coord(store):
+    return Coordinator(
+        store,
+        TableSpec(max_nodes=64, max_zones=16, max_regions=8),
+        PodSpec(batch=16),
+        Profile(topology_spread=0, interpod_affinity=0),
+        chunk=64, k=4, with_constraints=False,
+    )
+
+
+# ---- LeaderElector ------------------------------------------------------
+
+
+def test_single_candidate_acquires_and_renews(store):
+    e = LeaderElector(store, "a")
+    assert e.tick(0.0)
+    assert e.tick(5.0)          # within renew period: no write needed
+    rec = LeaseRecord.decode(store.get(e.key).value)
+    assert rec.holder == "a" and rec.renew_time == 0.0
+    assert e.tick(11.0)         # past renew period: renews
+    rec = LeaseRecord.decode(store.get(e.key).value)
+    assert rec.renew_time == 11.0
+
+
+def test_second_candidate_waits_then_takes_over_on_expiry(store):
+    a = LeaderElector(store, "a")
+    b = LeaderElector(store, "b")
+    assert a.tick(0.0)
+    assert not b.tick(1.0)      # lease held and fresh
+    # a dies (stops ticking); b retries every 2s and wins at expiry.
+    t = 1.0
+    while t < 12.9:
+        t += 2.0
+        assert not b.tick(t)    # ticks at 3..13, all inside the 15s lease
+    assert b.tick(15.1)         # 15s lease duration elapsed
+    # a comes back: its renew CAS must fail and it must step down.
+    assert not a.tick(16.0)
+    assert not a.is_leader
+
+
+def test_clean_release_allows_fast_handover(store):
+    a = LeaderElector(store, "a")
+    b = LeaderElector(store, "b")
+    assert a.tick(0.0)
+    a.release()
+    assert b.tick(2.5)          # no need to wait out the 15s duration
+
+
+def test_reacquire_own_lease_after_restart(store):
+    a1 = LeaderElector(store, "a")
+    assert a1.tick(0.0)
+    a2 = LeaderElector(store, "a")   # same identity, fresh process
+    assert a2.tick(1.0)
+
+
+# ---- HACoordinator failover --------------------------------------------
+
+
+def test_failover_reschedules_backlog(store):
+    put_nodes(store)
+    put_pods(store, 12, prefix="early")
+
+    ha_a = HACoordinator(
+        LeaderElector(store, "a"), lambda: make_coord(store)
+    )
+    ha_b = HACoordinator(
+        LeaderElector(store, "b", retry_period_s=1.0),
+        lambda: make_coord(store),
+    )
+    bound = ha_a.tick(0.0)
+    assert ha_a.elector.is_leader
+    assert bound == 12           # leader schedules the backlog
+    assert ha_b.tick(0.5) == 0   # standby does nothing
+
+    # More pods arrive, then the leader dies without releasing.
+    put_pods(store, 7, prefix="late")
+    t = 1.0
+    total_b = 0
+    while t < 30.0:
+        t += 1.0
+        total_b += ha_b.tick(t)
+    assert ha_b.elector.is_leader
+    assert total_b == 7          # standby took over and drained the rest
+    # Every pod is bound exactly once.
+    for prefix, n in (("early", 12), ("late", 7)):
+        for i in range(n):
+            obj = json.loads(store.get(pod_key("default", f"{prefix}-{i}")).value)
+            assert obj["spec"].get("nodeName"), f"{prefix}-{i} unbound"
+
+
+# ---- Webhook intake -----------------------------------------------------
+
+
+def post_review(port, pod_obj, uid="u1"):
+    review = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "object": pod_obj},
+    }
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/validate",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_webhook_allows_and_enqueues(store):
+    got = []
+    srv = WebhookServer(got.append).start()
+    try:
+        pod = json.loads(encode_pod(PodInfo("web-0")))
+        out = post_review(srv.port, pod)
+        assert out["response"]["allowed"] is True
+        assert out["response"]["uid"] == "u1"
+        # Foreign scheduler and already-bound pods are allowed but ignored.
+        foreign = json.loads(encode_pod(PodInfo("web-1", scheduler_name="other")))
+        assert post_review(srv.port, foreign)["response"]["allowed"] is True
+        bound = json.loads(encode_pod(PodInfo("web-2", node_name="n1")))
+        assert post_review(srv.port, bound)["response"]["allowed"] is True
+    finally:
+        srv.stop()
+    assert [p["metadata"]["name"] for p in got] == ["web-0"]
+
+
+def test_webhook_intake_binds_before_watch(store):
+    """A pod submitted via webhook is bound even though the store write
+    lands after admission (the reference's whole point: admission fires
+    before persistence)."""
+    put_nodes(store)
+    coord = make_coord(store)
+    coord.bootstrap()
+    srv = WebhookServer(coord.submit_external).start()
+    try:
+        p = PodInfo("hooked", cpu_milli=100, mem_kib=1 << 10)
+        post_review(srv.port, json.loads(encode_pod(p)))
+        # Admission happened; now the apiserver persists the object.
+        store.put(pod_key("default", p.name), encode_pod(p))
+        assert coord.step() == 1
+        obj = json.loads(store.get(pod_key("default", "hooked")).value)
+        assert obj["spec"]["nodeName"]
+        # The watch echo of the original create must not double-schedule.
+        assert coord.run_until_idle() == 0
+    finally:
+        srv.stop()
+
+
+def test_ha_sink_survives_failover(store):
+    """A WebhookServer wired to the HACoordinator keeps feeding whichever
+    coordinator currently reigns."""
+    put_nodes(store)
+    ha_a = HACoordinator(LeaderElector(store, "a"), lambda: make_coord(store))
+    ha_b = HACoordinator(
+        LeaderElector(store, "b", retry_period_s=1.0), lambda: make_coord(store)
+    )
+    srv_a = WebhookServer(ha_a.submit_external).start()
+    srv_b = WebhookServer(ha_b.submit_external).start()
+    try:
+        ha_a.tick(0.0)
+        assert ha_a.elector.is_leader
+        old_coord = ha_a.coord
+        # a dies; b takes over after lease expiry.
+        t, bound = 0.0, 0
+        while t < 30.0:
+            t += 1.0
+            bound += ha_b.tick(t)
+        assert ha_b.elector.is_leader
+        # Pods admitted via b's sink during b's reign get scheduled.
+        p = PodInfo("after-failover", cpu_milli=10, mem_kib=1 << 10)
+        post_review(srv_b.port, json.loads(encode_pod(p)))
+        store.put(pod_key("default", p.name), encode_pod(p))
+        assert ha_b.tick(t + 1.0) == 1
+        # a comes back, discovers the loss, and tears its reign down
+        # (watches cancelled); its sink now drops instead of staging into
+        # the dead coordinator forever.
+        assert ha_a.tick(t + 2.0) == 0
+        assert ha_a.coord is None
+        assert old_coord._nodes_watch is None
+        post_review(srv_a.port, json.loads(encode_pod(PodInfo("to-standby"))))
+        assert not old_coord._external
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+def test_coordinator_close_cancels_watches(store):
+    put_nodes(store)
+    coord = make_coord(store)
+    coord.bootstrap()
+    assert coord._nodes_watch is not None
+    coord.close()
+    assert coord._nodes_watch is None and coord._pods_watch is None
+
+
+def test_webhook_pod_never_persisted_is_dropped(store):
+    """A webhook pod whose store write never lands binds nothing (if the
+    write arrives later, the watch intake reschedules it)."""
+    put_nodes(store)
+    coord = make_coord(store)
+    coord.bootstrap()
+    coord.submit_external(json.loads(encode_pod(PodInfo("ghost"))))
+    assert coord.run_until_idle() == 0
+    assert not coord.queue
+    # The slow write finally lands -> watch intake picks it up.
+    store.put(pod_key("default", "ghost"), encode_pod(PodInfo("ghost")))
+    assert coord.run_until_idle() == 1

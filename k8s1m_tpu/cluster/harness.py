@@ -1,0 +1,283 @@
+"""Cluster bring-up harness: the terraform/RUNNING-equivalent rig.
+
+The reference deploys its control plane with ~3,100 lines of terraform
+(mem_etcd systemd unit, k3s servers, dist-scheduler Deployments, kwok
+StatefulSet, load-gen VMs — reference SURVEY.md §2.4); the experiment
+recipe is a tfvars file per cluster shape.  Here the same topology is a
+declarative ``ClusterSpec`` and one supervisor:
+
+- the native store runs as a real subprocess serving the etcd v3 wire
+  (store/server_main.py), WAL modes and no-write prefixes configured the
+  way the reference's systemd unit passes --wal-default /
+  --wal-no-write-prefix (etcd.tf:1-38);
+- ``coordinators`` HACoordinator replicas (leader + standbys) and
+  ``kwok_groups`` KWOK controllers connect over gRPC via RemoteStore —
+  every component crosses a process boundary exactly as deployed;
+- the webhook intake server fronts the current leader.
+
+``tick(now)`` advances the whole cluster one step (tick-driven like the
+KWOK simulator, so integration tests control time); ``run_pods`` is the
+make_pods + wait-for-binds experiment loop (reference README.adoc:732-738).
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import json
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+from k8s1m_tpu.cluster.kwok_controller import KwokController
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.control.leader import HACoordinator, LeaderElector
+from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
+from k8s1m_tpu.control.webhook import WebhookServer
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+from k8s1m_tpu.store.remote import RemoteStore
+from k8s1m_tpu.tools.make_nodes import build_node
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """One cluster shape — the tfvars equivalent."""
+
+    nodes: int = 1000
+    kwok_groups: int = 2
+    coordinators: int = 2          # leader + standbys
+    zones: int = 8
+    regions: int = 4
+    wal_mode: str = "buffered"
+    # The reference skips the WAL for the lease-flood prefix
+    # (--wal-no-write-prefix; leases are 100K writes/s of pure churn).
+    no_write_prefixes: tuple[str, ...] = ("/registry/leases/",)
+    table: TableSpec | None = None
+    pod_batch: int = 256
+    profile: Profile = dataclasses.field(
+        default_factory=lambda: Profile(topology_spread=0, interpod_affinity=0)
+    )
+    chunk: int = 1 << 10
+    backend: str = "xla"
+
+    def table_spec(self) -> TableSpec:
+        if self.table is not None:
+            return self.table
+        cap = 1 << max(6, (self.nodes - 1).bit_length())
+        return TableSpec(
+            max_nodes=cap,
+            max_zones=max(16, self.zones + 1),
+            max_regions=max(8, self.regions + 1),
+        )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for_port(port: int, timeout_s: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"store server did not listen on :{port}")
+
+
+class Cluster:
+    """Running instance of a ClusterSpec."""
+
+    def __init__(self, spec: ClusterSpec, *, wal_dir: str | None = None):
+        self.spec = spec
+        self.wal_dir = wal_dir or tempfile.mkdtemp(prefix="k8s1m-wal-")
+        # Everything shutdown() touches exists before anything can fail,
+        # so a partial-init crash still tears the subprocess down cleanly
+        # at exit.
+        self._server = None
+        self._clients: list[RemoteStore] = []
+        self.coordinators: list[HACoordinator] = []
+        self.kwoks: list[KwokController] = []
+        self.webhook: WebhookServer | None = None
+        self.port = _free_port()
+        cmd = [
+            sys.executable, "-m", "k8s1m_tpu.store.server_main",
+            "--host", "127.0.0.1", "--port", str(self.port),
+            "--metrics-port", "0",
+            "--wal-dir", self.wal_dir, "--wal-default", spec.wal_mode,
+        ]
+        for p in spec.no_write_prefixes:
+            cmd += ["--wal-no-write-prefix", p]
+        self._server = subprocess.Popen(cmd)
+        atexit.register(self.shutdown)
+        wait_for_port(self.port)
+
+        for i in range(spec.coordinators):
+            store = self._client()
+            self.coordinators.append(
+                HACoordinator(
+                    LeaderElector(store, f"coordinator-{i}"),
+                    lambda store=store: Coordinator(
+                        store, spec.table_spec(), PodSpec(batch=spec.pod_batch),
+                        spec.profile, chunk=spec.chunk, backend=spec.backend,
+                        with_constraints=spec.profile.topology_spread > 0
+                        or spec.profile.interpod_affinity > 0,
+                    ),
+                )
+            )
+        self.kwoks = [
+            KwokController(self._client(), group=g)
+            for g in range(spec.kwok_groups)
+        ]
+        self.webhook = WebhookServer(self._webhook_sink).start()
+        self._kwok_bootstrapped = False
+        self.now = 0.0  # simulated time, monotonic across run_pods calls
+
+    # ---- plumbing ------------------------------------------------------
+
+    def _client(self) -> RemoteStore:
+        c = RemoteStore(f"127.0.0.1:{self.port}")
+        self._clients.append(c)
+        return c
+
+    def _webhook_sink(self, obj: dict) -> None:
+        for ha in self.coordinators:
+            if ha.elector.is_leader:
+                ha.submit_external(obj)
+                return
+
+    @property
+    def leader(self) -> HACoordinator | None:
+        for ha in self.coordinators:
+            if ha.elector.is_leader:
+                return ha
+        return None
+
+    # ---- lifecycle -----------------------------------------------------
+
+    def make_nodes(self, count: int | None = None) -> None:
+        """Bulk-create KWOK nodes (make_nodes equivalent, in-harness)."""
+        store = self._clients[0]
+        n = count if count is not None else self.spec.nodes
+        for i in range(n):
+            node = build_node(
+                i, zones=self.spec.zones, regions=self.spec.regions
+            )
+            node.labels["kwok-group"] = str(i % self.spec.kwok_groups)
+            store.put(node_key(node.name), encode_node(node))
+
+    def tick(self, now: float | None = None) -> dict:
+        """Advance every component one step.  ``now=None`` advances the
+        cluster's simulated clock by one second; an explicit ``now`` only
+        moves it forward (time never rewinds across run_pods calls)."""
+        self.now = self.now + 1.0 if now is None else max(self.now, now)
+        now = self.now
+        if not self._kwok_bootstrapped:
+            for k in self.kwoks:
+                k.bootstrap(now)
+            self._kwok_bootstrapped = True
+        bound = sum(ha.tick(now) for ha in self.coordinators)
+        kwok = [k.tick(now) for k in self.kwoks]
+        return {
+            "bound": bound,
+            "leases_renewed": sum(s["renewed"] for s in kwok),
+            "pods_started": sum(s["started"] for s in kwok),
+        }
+
+    _run_seq = 0
+
+    def run_pods(
+        self,
+        count: int,
+        *,
+        max_ticks: int = 1000,
+        tick_s: float = 1.0,
+        via_webhook: bool = False,
+        prefix: str | None = None,
+    ) -> dict:
+        """The make_pods experiment: create pods, tick until all bound and
+        Running; returns timing/throughput stats (wall-clock based — this
+        is the measurement loop, not the simulator).  Pod names get a
+        per-run prefix: pod names are unique for the object's lifetime in
+        Kubernetes, so runs must not reuse live names."""
+        if prefix is None:
+            Cluster._run_seq += 1
+            prefix = f"bench{Cluster._run_seq}"
+        store = self._clients[0]
+        t0 = time.perf_counter()
+        for i in range(count):
+            pod = encode_pod(
+                PodInfo(f"{prefix}-{i}", cpu_milli=100, mem_kib=200 << 10)
+            )
+            if via_webhook:
+                # Over real HTTP — the admission path under test is the
+                # WebhookServer, not its sink function.
+                review = {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": f"{prefix}-{i}", "object": json.loads(pod)},
+                }
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{self.webhook.port}/validate",
+                    data=json.dumps(review).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    assert json.loads(resp.read())["response"]["allowed"]
+            store.put(pod_key("default", f"{prefix}-{i}"), pod)
+        created_s = time.perf_counter() - t0
+
+        bound = started = 0
+        for _ in range(max_ticks):
+            stats = self.tick(self.now + tick_s)
+            bound += stats["bound"]
+            started += stats["pods_started"]
+            if bound >= count and started >= count:
+                break
+        total_s = time.perf_counter() - t0
+        return {
+            "pods": count,
+            "prefix": prefix,
+            "created_s": round(created_s, 3),
+            "bound": bound,
+            "running": started,
+            "total_s": round(total_s, 3),
+            "binds_per_sec": round(bound / total_s, 1),
+        }
+
+    def shutdown(self) -> None:
+        if self._server is None:
+            return
+        if self.webhook is not None:
+            self.webhook.stop()
+        for ha in self.coordinators:
+            ha.stop()
+        for k in self.kwoks:
+            k.close()
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._server.terminate()
+        try:
+            self._server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._server.kill()
+            self._server.wait()
+        self._server = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

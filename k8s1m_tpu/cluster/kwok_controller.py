@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import zlib
 
-from k8s1m_tpu.control.objects import lease_key, node_key, pod_key
+from k8s1m_tpu.control.objects import lease_key, pod_key
 from k8s1m_tpu.obs.metrics import Counter, Histogram
 from k8s1m_tpu.store.native import MemStore, prefix_end
 
@@ -64,6 +64,14 @@ class KwokController:
         # applied yet (node and pod watches are separate queues, so a bind
         # can be seen before its node) — parked per node, started on adopt.
         self._waiting: dict[str, dict[str, tuple[bytes, int]]] = {}
+        # Nodes known to belong to other groups.  The controller already
+        # lists+watches ALL nodes (it must, to discover label moves), so
+        # ownership is answered locally instead of with a store round trip
+        # per bound-pod event (which over gRPC would be (groups-1) x pods
+        # extra blocking RPCs on the bind hot path).  ~60 bytes/node of
+        # interned strings — the same order as the reference controller's
+        # own node cache.
+        self._foreign: set[str] = set()
 
     # ---- membership ----------------------------------------------------
 
@@ -77,6 +85,8 @@ class KwokController:
             obj = json.loads(kv.value)
             if self._owns(obj):
                 self._adopt(obj["metadata"]["name"], now)
+            else:
+                self._foreign.add(obj["metadata"]["name"])
         self._nodes_watch = self.store.watch(
             NODES_PREFIX, prefix_end(NODES_PREFIX),
             start_revision=res.revision + 1,
@@ -97,6 +107,7 @@ class KwokController:
         # schedule (and the delay histogram) nondeterministic across runs.
         offset = (zlib.crc32(name.encode()) % 1000) / 1000.0 * self.renew_interval_s
         self._next_renewal[name] = now + offset
+        self._foreign.discard(name)
         for data, mod in self._waiting.pop(name, {}).values():
             self._maybe_start_pod(data, mod)
 
@@ -109,14 +120,13 @@ class KwokController:
             return
         if obj.get("status", {}).get("phase") != "Pending":
             return
+        if node in self._foreign:
+            return            # another group's node — not ours to start
         if node not in self.nodes:
-            # Our node-adoption event may simply not have been applied yet.
-            # Check ownership against the store directly: if the node is
-            # ours, park the pod until _adopt replays it; if it belongs to
-            # another group (or doesn't exist), it's not ours to start.
-            kv = self.store.get(node_key(node))
-            if kv is None or not self._owns(json.loads(kv.value)):
-                return
+            # Unknown node: its watch event hasn't been applied yet (node
+            # and pod watches are separate streams).  Park the pod; the
+            # node's PUT resolves it — _adopt replays if ours, the
+            # foreign branch in tick() discards if not.
             pk = (f"{obj['metadata'].get('namespace', 'default')}/"
                   f"{obj['metadata']['name']}")
             self._waiting.setdefault(node, {})[pk] = (data, mod_revision)
@@ -149,12 +159,18 @@ class KwokController:
                 name = ev.kv.key[len(NODES_PREFIX):].decode()
                 if ev.type == "PUT":
                     obj = json.loads(ev.kv.value)
-                    if self._owns(obj) and name not in self.nodes:
-                        self._adopt(name, now)
-                    elif not self._owns(obj) and name in self.nodes:
+                    if self._owns(obj):
+                        if name not in self.nodes:
+                            self._adopt(name, now)
+                    else:
+                        if name in self.nodes:
+                            self._drop(name)
+                        self._foreign.add(name)
+                        self._waiting.pop(name, None)
+                else:
+                    self._foreign.discard(name)
+                    if name in self.nodes:
                         self._drop(name)
-                elif name in self.nodes:
-                    self._drop(name)
             if len(evs) < 10000:
                 break
         while True:
@@ -182,6 +198,13 @@ class KwokController:
             "started": len(self.running_pods) - started0,
             "nodes": len(self.nodes),
         }
+
+    def close(self) -> None:
+        """Cancel store watches (deregisters native/remote watchers)."""
+        for w in (self._nodes_watch, self._pods_watch):
+            if w is not None:
+                w.cancel()
+        self._nodes_watch = self._pods_watch = None
 
     def _drop(self, name: str) -> None:
         self.nodes.discard(name)

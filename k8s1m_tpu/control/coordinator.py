@@ -121,6 +121,7 @@ class Coordinator:
         scheduler_name: str = DEFAULT_SCHEDULER,
         seed: int = 0,
         flight_recorder: FlightRecorder | None = None,
+        backend: str = "xla",
     ):
         self.store = store
         self.table_spec = table_spec
@@ -131,6 +132,7 @@ class Coordinator:
         self.max_attempts = max_attempts
         self.scheduler_name = scheduler_name
         self.flight = flight_recorder
+        self.backend = backend
 
         self.host = NodeTableHost(table_spec)
         self.tracker = ConstraintTracker(table_spec)
@@ -471,7 +473,7 @@ class Coordinator:
             self.table, self.constraints, asg = schedule_batch(
                 self.table, batch, subkey,
                 profile=self.profile, constraints=self.constraints,
-                chunk=self.chunk, k=self.k,
+                chunk=self.chunk, k=self.k, backend=self.backend,
             )
             node_row = np.asarray(asg.node_row)
             bound = np.asarray(asg.bound)

@@ -1,0 +1,310 @@
+"""Synchronous etcd-wire store client with the in-process MemStore surface.
+
+The coordinator, KWOK controllers, and leader electors are written
+against the MemStore read/write/watch API.  In a single-process rig they
+share the native store directly; in a *deployed* topology (the
+reference's shape: scheduler and kwok talk to the apiserver/mem_etcd over
+gRPC, SURVEY.md §1) they need the same surface over the wire.  This
+adapter provides it against any etcd v3 server — ours
+(store/server_main.py) or a real etcd.
+
+Watch mapping: the wire protocol has no overflow signal, so ``dropped``
+is set when the stream errors or the server cancels (compaction) — the
+coordinator reacts with a relist+rewatch, exactly its response to an
+in-process overflow (control/coordinator.py resync), which also covers
+whatever events the broken stream lost.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import queue
+import threading
+
+import grpc
+
+from k8s1m_tpu.store.native import (
+    CompactedError,
+    FutureRevError,
+    KeyValue,
+    RangeResult,
+    WatchEvent,
+)
+from k8s1m_tpu.store.proto import mvcc_pb2, rpc_pb2
+
+log = logging.getLogger("k8s1m.remote_store")
+
+_M = "etcdserverpb"
+
+
+def _kv(pb) -> KeyValue:
+    return KeyValue(
+        key=pb.key,
+        value=pb.value,
+        create_revision=pb.create_revision,
+        mod_revision=pb.mod_revision,
+        version=pb.version,
+        lease=pb.lease,
+    )
+
+
+class RemoteWatcher:
+    """MemStore-Watcher-shaped handle over a Watch stream.
+
+    A dedicated reader thread drains the stream into a locked deque;
+    ``poll`` is non-blocking like the native watcher's.
+    """
+
+    def __init__(self, store: "RemoteStore", key, end, start_revision, prev_kv):
+        self._events: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self.canceled = False
+        # The request side must stay open for the watch's lifetime — a
+        # finite iterator half-closes the stream and the server cancels
+        # the watch.  Requests flow through a queue; cancel() enqueues a
+        # sentinel to end it.
+        self._requests: queue.Queue = queue.Queue()
+        self._requests.put(
+            rpc_pb2.WatchRequest(
+                create_request=rpc_pb2.WatchCreateRequest(
+                    key=key,
+                    range_end=end or b"",
+                    start_revision=start_revision,
+                    prev_kv=prev_kv,
+                )
+            )
+        )
+
+        def request_iter():
+            while True:
+                req = self._requests.get()
+                if req is None:
+                    return
+                yield req
+
+        self._call = store._watch_stream(request_iter())
+        self._thread = threading.Thread(
+            target=self._reader, name="remote-watch", daemon=True
+        )
+        self._thread.start()
+
+    def _reader(self):
+        try:
+            for resp in self._call:
+                if resp.compact_revision:
+                    raise CompactedError(resp.compact_revision)
+                if resp.canceled:
+                    if not self.canceled:
+                        # Server-initiated cancel (overflow, compaction):
+                        # events were lost — the owner must resync, the
+                        # same contract as a native-watcher overflow.
+                        log.warning(
+                            "watch canceled by server: %s", resp.cancel_reason
+                        )
+                        self._dropped += 1
+                    break
+                if not resp.events:
+                    continue
+                with self._lock:
+                    for ev in resp.events:
+                        kind = (
+                            "DELETE"
+                            if ev.type == mvcc_pb2.Event.DELETE
+                            else "PUT"
+                        )
+                        prev = (
+                            _kv(ev.prev_kv) if ev.HasField("prev_kv") else None
+                        )
+                        self._events.append(WatchEvent(kind, _kv(ev.kv), prev))
+        except grpc.RpcError as e:
+            if not self.canceled:
+                log.warning("watch stream broke: %s", e)
+                self._dropped += 1
+        except CompactedError:
+            self._dropped += 1
+        finally:
+            self.canceled = True
+            # Unblock gRPC's request-consumer thread even when the stream
+            # died server-side (cancel() will never enqueue the sentinel
+            # once self.canceled is set).
+            self._requests.put(None)
+
+    def poll(self, max_events: int = 1000, timeout_ms: int = 0) -> list[WatchEvent]:
+        out = []
+        with self._lock:
+            while self._events and len(out) < max_events:
+                out.append(self._events.popleft())
+        return out
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def cancel(self) -> None:
+        if not self.canceled:
+            self.canceled = True
+            self._requests.put(None)
+            self._call.cancel()
+
+
+class RemoteStore:
+    """Blocking etcd v3 client exposing the MemStore surface."""
+
+    def __init__(self, target: str, channel: grpc.Channel | None = None):
+        self.channel = channel or grpc.insecure_channel(target)
+        c = self.channel
+        pb = rpc_pb2
+
+        def u(svc, name, req, resp):
+            return c.unary_unary(
+                f"/{_M}.{svc}/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+
+        self._range = u("KV", "Range", pb.RangeRequest, pb.RangeResponse)
+        self._put = u("KV", "Put", pb.PutRequest, pb.PutResponse)
+        self._delete_rpc = u(
+            "KV", "DeleteRange", pb.DeleteRangeRequest, pb.DeleteRangeResponse
+        )
+        self._txn = u("KV", "Txn", pb.TxnRequest, pb.TxnResponse)
+        self._compact_rpc = u(
+            "KV", "Compact", pb.CompactionRequest, pb.CompactionResponse
+        )
+        self._status = u("Maintenance", "Status", pb.StatusRequest, pb.StatusResponse)
+        self._watch_stream = c.stream_stream(
+            f"/{_M}.Watch/Watch",
+            request_serializer=pb.WatchRequest.SerializeToString,
+            response_deserializer=pb.WatchResponse.FromString,
+        )
+
+    def close(self) -> None:
+        self.channel.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- writes --------------------------------------------------------
+
+    def put(self, key: bytes, value: bytes, lease: int = 0) -> int:
+        resp = self._put(rpc_pb2.PutRequest(key=key, value=value, lease=lease))
+        return resp.header.revision
+
+    def delete(self, key: bytes) -> tuple[int, bool]:
+        resp = self._delete_rpc(rpc_pb2.DeleteRangeRequest(key=key))
+        if resp.deleted:
+            return resp.header.revision, True
+        return 0, False
+
+    def cas(
+        self,
+        key: bytes,
+        value: bytes | None,
+        *,
+        required_mod: int | None = None,
+        required_version: int | None = None,
+        lease: int = 0,
+    ) -> tuple[bool, int, KeyValue | None]:
+        if (required_mod is None) == (required_version is None):
+            raise ValueError("exactly one of required_mod/required_version")
+        if required_mod is not None:
+            cmp = rpc_pb2.Compare(
+                result=rpc_pb2.Compare.EQUAL,
+                target=rpc_pb2.Compare.MOD,
+                key=key,
+                mod_revision=required_mod,
+            )
+        else:
+            cmp = rpc_pb2.Compare(
+                result=rpc_pb2.Compare.EQUAL,
+                target=rpc_pb2.Compare.VERSION,
+                key=key,
+                version=required_version,
+            )
+        op = rpc_pb2.RequestOp()
+        if value is None:
+            op.request_delete_range.key = key
+        else:
+            op.request_put.key = key
+            op.request_put.value = value
+            op.request_put.lease = lease
+        fail = rpc_pb2.RequestOp()
+        fail.request_range.key = key
+        resp = self._txn(
+            rpc_pb2.TxnRequest(compare=[cmp], success=[op], failure=[fail])
+        )
+        if resp.succeeded:
+            return True, resp.header.revision, None
+        cur = None
+        for r in resp.responses:
+            kvs = r.response_range.kvs
+            if kvs:
+                cur = _kv(kvs[0])
+        return False, resp.header.revision, cur
+
+    # ---- reads ---------------------------------------------------------
+
+    def range(
+        self,
+        start: bytes,
+        end: bytes | None = None,
+        *,
+        revision: int = 0,
+        limit: int = 0,
+        count_only: bool = False,
+        keys_only: bool = False,
+    ) -> RangeResult:
+        try:
+            resp = self._range(
+                rpc_pb2.RangeRequest(
+                    key=start,
+                    range_end=end or b"",
+                    revision=revision,
+                    limit=limit,
+                    count_only=count_only,
+                    keys_only=keys_only,
+                )
+            )
+        except grpc.RpcError as e:
+            detail = e.details() or ""
+            if "compacted" in detail:
+                raise CompactedError(detail) from None
+            if "future revision" in detail or "required revision" in detail:
+                raise FutureRevError(detail) from None
+            raise
+        return RangeResult(
+            revision=resp.header.revision,
+            count=resp.count,
+            more=resp.more,
+            kvs=[_kv(kv) for kv in resp.kvs],
+        )
+
+    def get(self, key: bytes, revision: int = 0) -> KeyValue | None:
+        res = self.range(key, revision=revision)
+        return res.kvs[0] if res.kvs else None
+
+    # ---- watch ---------------------------------------------------------
+
+    def watch(
+        self,
+        start: bytes,
+        end: bytes | None = None,
+        *,
+        start_revision: int = 0,
+        prev_kv: bool = False,
+    ) -> RemoteWatcher:
+        return RemoteWatcher(self, start, end, start_revision, prev_kv)
+
+    # ---- maintenance ---------------------------------------------------
+
+    def compact(self, revision: int) -> None:
+        self._compact_rpc(rpc_pb2.CompactionRequest(revision=revision))
+
+    @property
+    def current_revision(self) -> int:
+        return self._status(rpc_pb2.StatusRequest()).header.revision

@@ -1,0 +1,144 @@
+"""Grafana dashboard generator — the grafana-dashboard/ equivalent.
+
+The reference ships a hand-maintained 66-panel dashboard JSON with
+dedicated Scheduler / etcd / apiserver / kwok rows
+(reference grafana-dashboard/dashboard.json; panels like "Scheduling
+attempt rate" and "kwok_node_lease_delay_percentile max").  Hand-written
+dashboards drift as metrics change, so here the dashboard is *generated*
+from the metric registry: every Counter becomes a rate panel, every
+Gauge a timeseries, every Histogram a p50/p99 percentile panel, grouped
+into rows by subsystem prefix.
+
+    python -m k8s1m_tpu.obs.dashboard > dashboard.json
+
+imports the subsystems first so their metrics register, then emits a
+Grafana v10 schema dashboard for a Prometheus datasource scraping
+obs.http.start_metrics_server / the store server's --metrics-port.
+"""
+
+from __future__ import annotations
+
+import json
+
+from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram, REGISTRY
+
+# Row layout mirrors the reference dashboard's subsystem rows.
+ROWS = [
+    ("Scheduler", ("coordinator_", "leader_", "webhook_")),
+    ("Store (mem-etcd)", ("store_", "etcd_", "memstore_")),
+    ("KWOK nodes", ("kwok_",)),
+    ("Load generators", ("loadgen_", "stress_")),
+]
+
+_PANEL_W = 8
+_PANEL_H = 7
+
+
+def _target(expr: str, legend: str = "") -> dict:
+    return {"expr": expr, "legendFormat": legend or "{{instance}}"}
+
+
+def _panel(pid: int, title: str, targets: list[dict], x: int, y: int) -> dict:
+    return {
+        "id": pid,
+        "title": title,
+        "type": "timeseries",
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+        "gridPos": {"h": _PANEL_H, "w": _PANEL_W, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": "short"}, "overrides": []},
+        "targets": targets,
+    }
+
+
+def _panels_for(metric) -> list[tuple[str, list[dict]]]:
+    name = metric.name
+    labels = "by (%s) " % ", ".join(metric.labelnames) if metric.labelnames else ""
+    if isinstance(metric, Counter):
+        return [(
+            f"{name} rate",
+            [_target(f"sum {labels}(rate({name}[1m]))",
+                     "-".join("{{%s}}" % l for l in metric.labelnames))],
+        )]
+    if isinstance(metric, Histogram):
+        return [(
+            f"{name} p50/p99",
+            [
+                _target(
+                    f"histogram_quantile(0.5, sum by (le) (rate({name}_bucket[1m])))",
+                    "p50",
+                ),
+                _target(
+                    f"histogram_quantile(0.99, sum by (le) (rate({name}_bucket[1m])))",
+                    "p99",
+                ),
+            ],
+        )]
+    if isinstance(metric, Gauge):
+        return [(
+            name,
+            [_target(f"sum {labels}({name})",
+                     "-".join("{{%s}}" % l for l in metric.labelnames))],
+        )]
+    return []
+
+
+def build_dashboard(registry=None) -> dict:
+    registry = registry or REGISTRY
+    panels = []
+    pid = 1
+    y = 0
+    for row_title, prefixes in ROWS:
+        row_metrics = [
+            m for m in registry.metrics()
+            if any(m.name.startswith(p) for p in prefixes)
+        ]
+        if not row_metrics:
+            continue
+        panels.append({
+            "id": pid, "type": "row", "title": row_title,
+            "collapsed": False,
+            "gridPos": {"h": 1, "w": 24, "x": 0, "y": y},
+        })
+        pid += 1
+        y += 1
+        x = 0
+        for m in sorted(row_metrics, key=lambda m: m.name):
+            for title, targets in _panels_for(m):
+                panels.append(_panel(pid, title, targets, x, y))
+                pid += 1
+                x += _PANEL_W
+                if x >= 24:
+                    x = 0
+                    y += _PANEL_H
+        if x:
+            y += _PANEL_H
+    return {
+        "title": "k8s1m-tpu",
+        "uid": "k8s1m-tpu",
+        "schemaVersion": 39,
+        "refresh": "10s",
+        "time": {"from": "now-30m", "to": "now"},
+        "templating": {
+            "list": [{
+                "name": "datasource", "type": "datasource",
+                "query": "prometheus",
+            }]
+        },
+        "panels": panels,
+    }
+
+
+def main() -> None:
+    # Import the subsystems for their metric registrations — the
+    # dashboard covers whatever the code actually exports.
+    import k8s1m_tpu.cluster.kwok_controller  # noqa: F401
+    import k8s1m_tpu.control.coordinator  # noqa: F401
+    import k8s1m_tpu.control.leader  # noqa: F401
+    import k8s1m_tpu.control.webhook  # noqa: F401
+    import k8s1m_tpu.store.etcd_server  # noqa: F401
+
+    print(json.dumps(build_dashboard(), indent=1))
+
+
+if __name__ == "__main__":
+    main()

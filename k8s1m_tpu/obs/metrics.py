@@ -218,6 +218,10 @@ class Registry:
     def get(self, name: str) -> Metric | None:
         return self._metrics.get(name)
 
+    def metrics(self) -> list[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
     def render(self) -> str:
         lines: list[str] = []
         with self._lock:

@@ -1,0 +1,106 @@
+"""Multi-host (DCN + ICI) deployment of the sharded scheduling cycle.
+
+The reference scales by adding scheduler VMs — 289 replicas across hosts
+coordinated through gRPC relay trees and EndpointSlices (reference
+SURVEY.md §2.5-2.6).  The TPU equivalent is a multi-host mesh: each host
+process drives its local chips, ``jax.distributed`` links the processes,
+and XLA routes collectives over ICI within a slice and DCN across
+slices.  No relay tree, no membership controller — the mesh IS the
+membership, fixed at initialization.
+
+Axis placement matters for traffic shape (scaling-book recipe):
+
+- ``sp`` (node-table rows) goes on the *fastest, largest* axis — the
+  per-cycle all-gather of per-shard top-k candidates crosses it.  Within
+  one slice that's ICI; the candidate payload is O(batch x k) records,
+  tiny, so sp can also safely span DCN.
+- ``dp`` (pod batch) carries one all-gather of commit fields per cycle —
+  also O(batch).  Either axis tolerates DCN; we put ``dp`` outermost
+  (across hosts) so the node table — the only large resident — never
+  crosses hosts: each host holds table rows for its local ``sp`` range.
+
+Usage, one process per host:
+
+    from k8s1m_tpu.parallel import multihost
+    multihost.initialize(coordinator, num_processes, process_id)
+    mesh = multihost.make_global_mesh()          # dp=hosts, sp=local chips
+    step = make_sharded_step(mesh, profile, chunk=..., k=...)
+
+The driver validates the single-process shape of this path via
+``__graft_entry__.dryrun_multichip`` on a virtual device mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from k8s1m_tpu.parallel.mesh import make_mesh
+
+log = logging.getLogger("k8s1m.multihost")
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """jax.distributed bootstrap.
+
+    Mirrors the reference's POD_NAME/EndpointSlice identity wiring
+    (reference cmd/dist-scheduler/scheduler.go:143-167): identity comes
+    from the launcher's env/args, and every process must call this
+    before any jax computation.  With no arguments JAX auto-detects the
+    TPU-pod topology — the natural multi-host call.  Only an explicit
+    ``num_processes=1`` short-circuits (single-process rigs and tests);
+    silently skipping on missing args would leave each pod host running
+    an independent scheduler over its own table copy.
+    """
+    if num_processes == 1:
+        log.info("single-process: skipping jax.distributed")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_global_mesh(dp: int | None = None, sp: int | None = None) -> jax.sharding.Mesh:
+    """Mesh over every device of every process.
+
+    Default: ``dp`` = number of processes (hosts), ``sp`` = chips per
+    host, so the sp all-gather rides ICI and only O(batch)-sized dp
+    traffic crosses DCN.  Explicit dp/sp override for asymmetric
+    topologies; devices are ordered so each process's local devices are
+    contiguous along sp.
+    """
+    devices = jax.devices()
+    n_proc = jax.process_count()
+    local = len(devices) // n_proc
+    if dp is None and sp is None:
+        dp, sp = n_proc, local
+    elif sp is None:
+        sp = len(devices) // dp
+    elif dp is None:
+        dp = len(devices) // sp
+    if dp * sp != len(devices):
+        raise ValueError(
+            f"mesh {dp}x{sp} != {len(devices)} global devices"
+        )
+    # jax.devices() orders by (process, local id), so [dp, sp] keeps one
+    # process's devices contiguous in sp whenever sp divides the
+    # per-process device count.
+    return make_mesh(dp, sp, devices)
+
+
+def shard_table_to_mesh(host, mesh) -> object:
+    """Upload a NodeTableHost to the mesh with rows sharded over sp.
+
+    Each process only materializes its addressable shard — at 1M nodes
+    the full table is ~250MB, so per-host HBM cost is 250MB/sp.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return host.to_device(NamedSharding(mesh, P("sp")))

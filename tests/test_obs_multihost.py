@@ -1,0 +1,70 @@
+"""Dashboard generation and multi-host mesh helpers."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from k8s1m_tpu.cluster import populate_kwok_nodes, uniform_pods
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.obs.dashboard import build_dashboard
+from k8s1m_tpu.obs.metrics import REGISTRY
+from k8s1m_tpu.parallel import make_sharded_step, multihost
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot import NodeTableHost, PodBatchHost
+
+
+def test_dashboard_covers_registered_metrics():
+    import k8s1m_tpu.cluster.kwok_controller  # noqa: F401 — register metrics
+    import k8s1m_tpu.control.coordinator  # noqa: F401
+    import k8s1m_tpu.store.etcd_server  # noqa: F401
+
+    d = build_dashboard()
+    json.dumps(d)  # must be serializable
+    rows = [p for p in d["panels"] if p["type"] == "row"]
+    panels = [p for p in d["panels"] if p["type"] != "row"]
+    assert {"Scheduler", "Store (mem-etcd)", "KWOK nodes"} <= {
+        r["title"] for r in rows
+    }
+    # Every panel's expr references a registered metric.
+    names = {m.name for m in REGISTRY.metrics()}
+    for p in panels:
+        for t in p["targets"]:
+            assert any(n in t["expr"] for n in names), t["expr"]
+    # No two panels occupy the same grid position.
+    positions = [(p["gridPos"]["x"], p["gridPos"]["y"]) for p in d["panels"]]
+    assert len(positions) == len(set(positions))
+
+
+def test_multihost_single_process_mesh_and_step():
+    """On one process, make_global_mesh = dp=1 x sp=all-devices; the
+    sharded step runs on it end to end."""
+    multihost.initialize(num_processes=1)  # explicit single-process: no-op
+    mesh = multihost.make_global_mesh()
+    assert mesh.shape["dp"] == 1
+    assert mesh.shape["sp"] == len(jax.devices())
+
+    sp = mesh.shape["sp"]
+    chunk = 8
+    num_nodes = sp * 2 * chunk
+    spec = TableSpec(max_nodes=num_nodes, max_zones=16, max_regions=8)
+    host = NodeTableHost(spec)
+    populate_kwok_nodes(host, num_nodes, zones=8, regions=4)
+    table = multihost.shard_table_to_mesh(host, mesh)
+    enc = PodBatchHost(PodSpec(batch=4), spec, host.vocab)
+    batch = enc.encode(uniform_pods(4))
+
+    step = make_sharded_step(
+        mesh, Profile(topology_spread=0, interpod_affinity=0), chunk=chunk, k=2
+    )
+    new_table, _, asg = step(table, batch, jax.random.key(0))
+    assert int(np.asarray(asg.bound).sum()) == 4
+
+
+def test_global_mesh_explicit_shape():
+    n = len(jax.devices())
+    if n < 4:
+        pytest.skip("needs >=4 devices (conftest forces 8 virtual)")
+    mesh = multihost.make_global_mesh(dp=2)
+    assert mesh.shape["dp"] == 2 and mesh.shape["sp"] == n // 2

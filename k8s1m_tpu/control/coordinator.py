@@ -102,6 +102,28 @@ class PendingPod:
     mod_revision: int | None
     enqueued_at: float
     attempts: int = 0
+    # Raw stored bytes at intake revision — lets the bind CAS splice
+    # nodeName into the bytes without a JSON decode/encode round trip.
+    raw: bytes | None = None
+
+
+# Structural splice marker: encode_pod always opens spec with
+# schedulerName, and this byte pattern cannot occur inside any JSON
+# string literal (the quotes would be \"-escaped), so its first
+# occurrence is the real spec object.
+_SPEC_MARK = b'"spec":{"schedulerName":'
+
+
+def splice_node_name(raw: bytes, node_name: str) -> bytes | None:
+    """Insert spec.nodeName into encoded pod bytes; None if the object
+    isn't in our canonical shape (caller falls back to the JSON path)."""
+    idx = raw.find(_SPEC_MARK)
+    if idx < 0 or b'"nodeName"' in raw:
+        return None
+    cut = idx + 8  # len(b'"spec":{')
+    return b'%s"nodeName":%s,%s' % (
+        raw[:cut], json.dumps(node_name).encode(), raw[cut:]
+    )
 
 
 class Coordinator:
@@ -122,6 +144,7 @@ class Coordinator:
         seed: int = 0,
         flight_recorder: FlightRecorder | None = None,
         backend: str = "xla",
+        pipeline: bool = False,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -133,6 +156,8 @@ class Coordinator:
         self.scheduler_name = scheduler_name
         self.flight = flight_recorder
         self.backend = backend
+        self.pipeline = pipeline
+        self._inflight = None
 
         self.host = NodeTableHost(table_spec)
         self.tracker = ConstraintTracker(table_spec)
@@ -214,7 +239,17 @@ class Coordinator:
             # like upstream's cache AddPod feeds plugin pre-state.
             self._pending_adjusts.append((keep, node_name, zone, region, 1))
 
-    def _on_pod_put(self, data: bytes, mod_revision: int) -> None:
+    def _on_pod_put(self, data: bytes, mod_revision: int, key: bytes = b"") -> None:
+        # Fast path for the watch echo of our own binds: the object has a
+        # nodeName and its key is in _bound — half of all pod events in
+        # steady state.  Skip the JSON decode entirely (the byte pattern
+        # check is conservative: a false positive just takes the slow
+        # path below).
+        if key and b'"nodeName"' in data:
+            pod_key_str = key[len(PODS_PREFIX):].decode()
+            if pod_key_str in self._bound:
+                self._queued_keys.discard(pod_key_str)
+                return
         try:
             pod = decode_pod(data, self.tracker)
         except Exception:
@@ -251,7 +286,9 @@ class Coordinator:
             # would have been placed against inflated usage meanwhile).
             return
         self._queued_keys.add(pod.key)
-        self.queue.append(PendingPod(pod, mod_revision, time.perf_counter()))
+        self.queue.append(
+            PendingPod(pod, mod_revision, time.perf_counter(), raw=data)
+        )
 
     def _on_pod_delete(self, key: bytes) -> None:
         pod_key_str = key[len(PODS_PREFIX):].decode()
@@ -308,7 +345,7 @@ class Coordinator:
             for ev in self._pods_watch.poll(max_events):
                 n += 1
                 if ev.type == "PUT":
-                    self._on_pod_put(ev.kv.value, ev.kv.mod_revision)
+                    self._on_pod_put(ev.kv.value, ev.kv.mod_revision, ev.kv.key)
                 else:
                     self._on_pod_delete(ev.kv.key)
         return n
@@ -450,14 +487,18 @@ class Coordinator:
             self._queued_keys.add(pod.key)
             self.queue.append(PendingPod(pod, None, time.perf_counter()))
 
-    def step(self) -> int:
-        """One scheduling cycle; returns number of pods bound."""
+    def _dispatch(self):
+        """Intake + device half of a cycle: drain deltas, encode a batch,
+        enqueue the device step.  Returns an in-flight record (or None if
+        nothing is pending) without forcing any device→host transfer, so
+        a pipelined caller overlaps this batch's device work with the
+        previous batch's bind writes."""
         self._drain_external()
         self.drain_watches()
         self._sync_table()
         self._process_adjusts()
         if not self.queue:
-            return 0
+            return None
         t_start = time.perf_counter()
 
         batch_pods: list[PendingPod] = []
@@ -475,8 +516,16 @@ class Coordinator:
                 profile=self.profile, constraints=self.constraints,
                 chunk=self.chunk, k=self.k, backend=self.backend,
             )
-            node_row = np.asarray(asg.node_row)
-            bound = np.asarray(asg.bound)
+        return (batch_pods, batch, asg, t_start)
+
+    def _complete(self, inflight) -> int:
+        """Bind half: sync the assignment to host, CAS the binds back,
+        roll back conflicts."""
+        batch_pods, batch, asg, t_start = inflight
+        with _CYCLE_TIME.time(stage="sync_out"):
+            # One transfer for both arrays — each device_get through a
+            # remote relay pays per-call latency.
+            node_row, bound = jax.device_get((asg.node_row, asg.bound))
 
         nbound = 0
         failed = np.zeros(self.pod_spec.batch, bool)
@@ -516,9 +565,53 @@ class Coordinator:
             )
         return nbound
 
+    def step(self) -> int:
+        """One scheduling cycle; returns number of pods bound.
+
+        With ``pipeline=True`` the returned count is the *previous*
+        dispatch's binds: batch N's device work executes while the
+        caller does its inter-step work (producers, kwok ticks), hiding
+        the device→host sync latency.  The in-flight batch is completed
+        BEFORE the next dispatch so its bind accounting lands in the
+        host mirror ahead of any dirty-row re-upload — dispatching first
+        would let _sync_table overwrite a device row with host values
+        that lack the in-flight batch's binds.  Call ``flush()`` (or
+        ``run_until_idle``) to retire the tail.
+        """
+        if not self.pipeline:
+            disp = self._dispatch()
+            return self._complete(disp) if disp is not None else 0
+        done = 0
+        if self._inflight is not None:
+            prev, self._inflight = self._inflight, None
+            done = self._complete(prev)
+        self._inflight = self._dispatch()
+        return done
+
+    def flush(self) -> int:
+        """Retire any in-flight pipelined batch."""
+        prev, self._inflight = self._inflight, None
+        return self._complete(prev) if prev is not None else 0
+
     def _bind(self, p: PendingPod, node_name: str) -> bool:
         """CAS spec.nodeName into the pod object; False on conflict."""
         key = pod_key(p.pod.namespace, p.pod.name)
+        if p.mod_revision is not None and p.raw is not None:
+            # Fast path: splice nodeName into the intake-revision bytes.
+            # The CAS itself proves the object hasn't changed since, so
+            # no re-read or JSON round trip is needed.
+            value = splice_node_name(p.raw, node_name)
+            if value is not None:
+                ok, _, _ = self.store.cas(
+                    key, value, required_mod=p.mod_revision
+                )
+                if not ok:
+                    _PODS_SCHEDULED.inc(outcome="conflict")
+                    return False
+                self.host.add_pod(node_name, p.pod.cpu_milli, p.pod.mem_kib)
+                self._note_bound(p.pod, node_name, external=False)
+                _PODS_SCHEDULED.inc(outcome="bound")
+                return True
         cur = self.store.get(key)
         if cur is None:
             _PODS_SCHEDULED.inc(outcome="conflict")
@@ -572,6 +665,10 @@ class Coordinator:
             return  # bound externally; the watch echo handles accounting
         p.pod = fresh
         p.mod_revision = cur.mod_revision
+        # Refresh the splice-source bytes too — stale raw at the new
+        # revision would CAS the OLD object body back in, silently
+        # reverting whatever spec change made the first CAS fail.
+        p.raw = cur.value
         self._queued_keys.add(p.pod.key)
         self.queue.append(p)
 
@@ -591,12 +688,13 @@ class Coordinator:
         for _ in range(max_cycles):
             n = self.step()
             total += n
-            if not self.queue:
+            if not self.queue and self._inflight is None:
                 idle += 1
                 if idle > 1 and self.drain_watches() == 0 and not self._external:
                     break
             else:
                 idle = 0
+        total += self.flush()
         return total
 
 

@@ -259,24 +259,36 @@ class PodBatchHost:
                 out["qkey"][i] = v.label_keys.lookup(key)
             return i
 
+        # Scalar columns vectorized (one numpy fancy-write per column, not
+        # one per pod): at 10K+ binds/s the per-pod `arr[i] = x` writes in
+        # this loop were a measurable slice of the whole pipeline.
+        n = len(pods)
+        out["valid"][:n] = True
+        out["cpu"][:n] = np.fromiter((p.cpu_milli for p in pods), np.int32, n)
+        out["mem"][:n] = np.fromiter((p.mem_kib for p in pods), np.int32, n)
+        taints = list(v.taints.items())
+
         for i, pod in enumerate(pods):
-            out["valid"][i] = True
-            out["cpu"][i] = pod.cpu_milli
-            out["mem"][i] = pod.mem_kib
             # spec.nodeName naming a node we've never seen must match
             # nothing (not "unset"), hence the -1 sentinel.
-            if pod.node_name is None:
-                out["node_name_id"][i] = NONE_ID
-            else:
+            if pod.node_name is not None:
                 nid = v.node_names.lookup(pod.node_name)
                 out["node_name_id"][i] = nid if nid != NONE_ID else -1
 
             # Evaluate this pod's tolerations against every distinct taint
             # triple (upstream: v1.Toleration.ToleratesTaint per node taint).
-            for tid, (tkey, tval, teffect) in v.taints.items():
-                out["tolerated"][i, tid] = pod_tolerates_taint(
-                    pod.tolerations, Taint(tkey, tval, teffect)
-                )
+            if taints:
+                for tid, (tkey, tval, teffect) in taints:
+                    out["tolerated"][i, tid] = pod_tolerates_taint(
+                        pod.tolerations, Taint(tkey, tval, teffect)
+                    )
+
+            if not (
+                pod.node_selector or pod.required_terms or pod.preferred_terms
+                or pod.spread_refs or pod.affinity_refs or pod.spread_incs
+                or pod.ipa_incs
+            ):
+                continue    # plain pod: everything below stays zero
 
             if len(pod.node_selector) > s.aff_exprs:
                 raise ValueError(f"pod {pod.key}: nodeSelector too large")

@@ -247,3 +247,50 @@ def test_watch_overflow_triggers_resync(store):
     put_pod(store, "after", node_selector={"fresh": "yes"})
     c.run_until_idle()
     assert node_of(store, "default", "after") == "n1"
+
+
+def test_retry_after_spec_change_binds_fresh_bytes(store):
+    """A CAS conflict caused by a spec update must retry with the NEW
+    object bytes — splicing nodeName into the stale intake bytes would
+    silently revert the update (and desync host accounting)."""
+    put_node(store, "n0")
+    put_pod(store, "p0", cpu=100)
+    c = make_coord(store)
+    c.bootstrap()
+    # User updates the pod's requests after intake but before the bind.
+    put_pod(store, "p0", cpu=250)
+    assert c.run_until_idle() == 1
+    obj = json.loads(store.get(pod_key("default", "p0")).value)
+    assert obj["spec"]["nodeName"] == "n0"
+    assert obj["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "250m"
+    assert c.host.cpu_req.sum() == 250
+
+
+def test_pipelined_matches_unpipelined_accounting(store):
+    """pipeline=True must end with identical store + host state: binds
+    complete before the next dispatch's dirty-row sync, so device rows
+    never lose in-flight usage."""
+    for i in range(8):
+        put_node(store, f"n{i}", pods=8)
+    c = make_coord(store, pipeline=True)
+    c.bootstrap()
+    total = 0
+    for wave in range(4):
+        for i in range(16):
+            put_pod(store, f"w{wave}-{i}", cpu=50)
+        # Dirty some rows mid-flight the way kwok heartbeats would.
+        put_node(store, f"n{wave % 8}", pods=8)
+        total += c.step()
+    total += c.run_until_idle()
+    assert total == 64
+    # Host mirror agrees with the store exactly.
+    res = store.range(b"/registry/pods/", prefix_end(b"/registry/pods/"))
+    per_node = {}
+    for kv in res.kvs:
+        node = json.loads(kv.value)["spec"].get("nodeName")
+        assert node, kv.key
+        per_node[node] = per_node.get(node, 0) + 1
+    for name, count in per_node.items():
+        assert c.host.pods_req[c.host.row_of(name)] == count
+    assert c.host.pods_req.sum() == 64
+    assert int(np.asarray(c.table.pods_req).sum()) == 64

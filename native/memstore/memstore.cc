@@ -747,6 +747,11 @@ int ms_range(ms_store* s, const uint8_t* start, size_t start_len,
         TreeItem* item = it->second;
         emit(it->first, KvMeta{item->create_rev, item->mod_rev, item->version,
                                item->lease, item->latest});
+        // Approximate count beyond the limit (the reference allows this,
+        // README.adoc:326-328): one element past the limit proves
+        // more=1, then stop — a paginated list over 1M keys must cost
+        // O(limit), not O(keys).
+        if (limit > 0 && total > limit) break;
       }
     }
   }

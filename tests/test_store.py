@@ -174,7 +174,10 @@ def test_range_limit_and_count(store):
     _fill_nodes(store, 10)
     res = store.range(NODE_PREFIX, prefix_end(NODE_PREFIX), limit=3)
     assert len(res.kvs) == 3
-    assert res.count == 10
+    # Count beyond the limit is approximate (reference README.adoc:326-328):
+    # the scan stops one element past the limit so a paginated list costs
+    # O(limit), not O(keys).  Exact counts come from count_only/no-limit.
+    assert res.count == 4
     assert res.more
 
 

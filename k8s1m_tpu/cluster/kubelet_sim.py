@@ -1,0 +1,268 @@
+"""Kubelet-faithful node agents: the kubelet-as-pod realism rig.
+
+The reference validates that KWOK load is representative by also running
+100K *real* kubelets in containers (k3s agents, one per pod) and
+comparing control-plane load shapes (reference README.adoc:789-861,
+terraform/kubelet-pod/deployment.tf).  Its finding: apiserver request
+rates match, but kubelets add more watches, more Events, and more DB
+size than KWOK's minimal emulation.
+
+This module is that experiment's analogue: a ``KubeletPool`` drives
+nodes with the write pattern of a real kubelet rather than KWOK's
+single status patch —
+
+- node lease renewal every 10s (same as kwok),
+- periodic node status heartbeats (nodeStatusUpdateFrequency, default
+  10s — a full Node object PUT, the pre-lease-era load kwok skips),
+- pod lifecycle in stages: Pending -> ContainerCreating -> Running,
+  one status PUT each (kwok: one),
+- Events per pod: Scheduled/Pulled/Created/Started (4 PUTs into
+  /registry/events/, lease-backed TTL in real clusters — the extra DB
+  weight the reference measured),
+
+so ``tools/fidelity_ab.py`` can A/B the two simulators against the same
+store and report the load-shape delta the reference reports.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+
+from k8s1m_tpu.control.objects import lease_key, node_key, pod_key
+from k8s1m_tpu.obs.metrics import Counter
+from k8s1m_tpu.store.native import MemStore, prefix_end
+
+NODES_PREFIX = b"/registry/minions/"
+PODS_PREFIX = b"/registry/pods/"
+EVENTS_PREFIX = b"/registry/events/"
+LEASE_NS = "kube-node-lease"
+
+_WRITES = Counter(
+    "kubelet_sim_writes_total", "Store writes by kind", ("kind",)
+)
+
+# Pod startup stages a kubelet reports (each is a status PUT).
+_STAGES = ("ContainerCreating", "Running")
+_EVENTS = ("Scheduled", "Pulled", "Created", "Started")
+
+
+def event_key(namespace: str, name: str) -> bytes:
+    return EVENTS_PREFIX + f"{namespace}/{name}".encode()
+
+
+class KubeletPool:
+    """One process's worth of simulated kubelets (the reference packs
+    ~234 kubelet pods per VM; here one pool drives any node subset)."""
+
+    def __init__(
+        self,
+        store: MemStore,
+        *,
+        lease_duration_s: int = 40,
+        renew_interval_s: float = 10.0,
+        status_interval_s: float = 10.0,
+    ):
+        self.store = store
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        self.status_interval_s = status_interval_s
+        self.nodes: dict[str, bytes] = {}       # name -> last node object bytes
+        self._next_renewal: dict[str, float] = {}
+        self._next_status: dict[str, float] = {}
+        self._pods_watch = None
+        self._nodes_watch = None
+        # Pods mid-startup: key -> (stage index, object dict, mod rev).
+        self._starting: dict[str, tuple[int, dict, int]] = {}
+        self.running_pods: set[str] = set()
+
+    def bootstrap(self, now: float = 0.0) -> None:
+        res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
+        for kv in res.kvs:
+            name = kv.key[len(NODES_PREFIX):].decode()
+            self.adopt(name, kv.value, now)
+        self._nodes_watch = self.store.watch(
+            NODES_PREFIX, prefix_end(NODES_PREFIX),
+            start_revision=res.revision + 1,
+        )
+        pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
+        for kv in pods.kvs:
+            self._observe_pod(kv.value, kv.mod_revision)
+        self._pods_watch = self.store.watch(
+            PODS_PREFIX, prefix_end(PODS_PREFIX),
+            start_revision=pods.revision + 1,
+        )
+
+    def adopt(self, name: str, obj_bytes: bytes, now: float) -> None:
+        self.nodes[name] = obj_bytes
+        stagger = (zlib.crc32(name.encode()) % 1000) / 1000.0
+        self._next_renewal[name] = now + stagger * self.renew_interval_s
+        self._next_status[name] = now + stagger * self.status_interval_s
+
+    # ---- pod lifecycle -------------------------------------------------
+
+    def _observe_pod(self, data: bytes, mod_revision: int) -> None:
+        obj = json.loads(data)
+        node = obj.get("spec", {}).get("nodeName")
+        if not node or node not in self.nodes:
+            return
+        key = (f"{obj['metadata'].get('namespace', 'default')}/"
+               f"{obj['metadata']['name']}")
+        if obj.get("status", {}).get("phase") != "Pending":
+            self.running_pods.add(key)
+            return
+        if key in self._starting or key in self.running_pods:
+            return
+        self._starting[key] = (0, obj, mod_revision)
+        self._emit_event(obj, "Scheduled")
+
+    def _emit_event(self, pod_obj: dict, reason: str) -> None:
+        ns = pod_obj["metadata"].get("namespace", "default")
+        name = pod_obj["metadata"]["name"]
+        self.store.put(
+            event_key(ns, f"{name}.{reason.lower()}"),
+            json.dumps(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Event",
+                    "metadata": {"name": f"{name}.{reason.lower()}",
+                                 "namespace": ns},
+                    "reason": reason,
+                    "involvedObject": {"kind": "Pod", "name": name,
+                                       "namespace": ns},
+                },
+                separators=(",", ":"),
+            ).encode(),
+        )
+        _WRITES.inc(kind="event")
+
+    def _advance_pod(self, key: str) -> None:
+        stage, obj, mod = self._starting[key]
+        ns = obj["metadata"].get("namespace", "default")
+        name = obj["metadata"]["name"]
+        phase = _STAGES[stage]
+        status = dict(obj.get("status", {}))
+        if phase == "Running":
+            status["phase"] = "Running"
+            status["conditions"] = [{"type": "Ready", "status": "True"}]
+        else:
+            status["phase"] = "Pending"
+            status["reason"] = phase
+        obj = {**obj, "status": status}
+        ok, rev, cur = self.store.cas(
+            pod_key(ns, name),
+            json.dumps(obj, separators=(",", ":")).encode(),
+            required_mod=mod,
+        )
+        _WRITES.inc(kind="pod_status")
+        if ok:
+            # Events only after the status write lands — a CAS retry must
+            # not re-emit them (they inflate exactly the write counts the
+            # fidelity A/B measures).
+            if phase == "Running":
+                self._emit_event(obj, "Created")
+                self._emit_event(obj, "Started")
+            else:
+                self._emit_event(obj, "Pulled")
+        if not ok:
+            if cur is None:
+                del self._starting[key]     # pod deleted
+                return
+            fresh = json.loads(cur.value)
+            if fresh.get("status", {}).get("phase") == "Running":
+                del self._starting[key]
+                self.running_pods.add(key)
+                return
+            self._starting[key] = (stage, fresh, cur.mod_revision)
+            return
+        if phase == "Running":
+            del self._starting[key]
+            self.running_pods.add(key)
+        else:
+            self._starting[key] = (stage + 1, obj, rev)
+
+    # ---- tick ----------------------------------------------------------
+
+    def tick(self, now: float) -> dict:
+        if self._pods_watch.dropped or self._nodes_watch.dropped:
+            # Watch overflow: events were silently lost (10K native queue)
+            # — relist, the same resync contract as the coordinator's.
+            self.close()
+            self._starting.clear()
+            self.bootstrap(now)
+        while True:
+            evs = self._nodes_watch.poll(10000)
+            for e in evs:
+                name = e.kv.key[len(NODES_PREFIX):].decode()
+                if e.type == "PUT":
+                    if name in self.nodes:
+                        self.nodes[name] = e.kv.value  # track latest object
+                    else:
+                        self.adopt(name, e.kv.value, now)
+                else:
+                    # Node deleted: stop heartbeating — re-PUTting the
+                    # stale object would resurrect a removed node.
+                    self.nodes.pop(name, None)
+                    self._next_renewal.pop(name, None)
+                    self._next_status.pop(name, None)
+                    self.store.delete(lease_key(LEASE_NS, name))
+            if len(evs) < 10000:
+                break
+        while True:
+            evs = self._pods_watch.poll(10000)
+            for e in evs:
+                if e.type == "PUT":
+                    self._observe_pod(e.kv.value, e.kv.mod_revision)
+                else:
+                    key = e.kv.key[len(PODS_PREFIX):].decode()
+                    self._starting.pop(key, None)
+                    self.running_pods.discard(key)
+            if len(evs) < 10000:
+                break
+
+        renewed = statuses = 0
+        for name, due in self._next_renewal.items():
+            if due <= now:
+                self.store.put(
+                    lease_key(LEASE_NS, name),
+                    json.dumps(
+                        {
+                            "apiVersion": "coordination.k8s.io/v1",
+                            "kind": "Lease",
+                            "metadata": {"name": name, "namespace": LEASE_NS},
+                            "spec": {
+                                "holderIdentity": name,
+                                "leaseDurationSeconds": self.lease_duration_s,
+                                "renewTime": now,
+                            },
+                        },
+                        separators=(",", ":"),
+                    ).encode(),
+                )
+                _WRITES.inc(kind="lease")
+                self._next_renewal[name] = now + self.renew_interval_s
+                renewed += 1
+        for name, due in self._next_status.items():
+            if due <= now:
+                # Full Node object heartbeat — the write kwok skips.
+                self.store.put(node_key(name), self.nodes[name])
+                _WRITES.inc(kind="node_status")
+                self._next_status[name] = now + self.status_interval_s
+                statuses += 1
+
+        # Advance every mid-startup pod one stage per tick.
+        for key in list(self._starting):
+            self._advance_pod(key)
+
+        return {
+            "renewed": renewed,
+            "node_statuses": statuses,
+            "starting": len(self._starting),
+            "running": len(self.running_pods),
+        }
+
+    def close(self) -> None:
+        for w in (self._pods_watch, self._nodes_watch):
+            if w is not None:
+                w.cancel()
+        self._pods_watch = self._nodes_watch = None

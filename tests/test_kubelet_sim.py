@@ -1,0 +1,113 @@
+"""Kubelet-faithful node agent: lifecycle stages, events, heartbeats."""
+
+import json
+
+import pytest
+
+from k8s1m_tpu.cluster.kubelet_sim import KubeletPool
+from k8s1m_tpu.control.objects import (
+    encode_node,
+    encode_pod,
+    lease_key,
+    node_key,
+    pod_key,
+)
+from k8s1m_tpu.snapshot.node_table import NodeInfo
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+from k8s1m_tpu.store.native import MemStore, prefix_end
+
+
+@pytest.fixture
+def store():
+    with MemStore() as s:
+        yield s
+
+
+def setup_pool(store, nodes=3):
+    for i in range(nodes):
+        store.put(node_key(f"n{i}"), encode_node(NodeInfo(f"n{i}")))
+    pool = KubeletPool(store)
+    pool.bootstrap(0.0)
+    return pool
+
+
+def bind_pod(store, name, node):
+    store.put(
+        pod_key("default", name),
+        encode_pod(PodInfo(name, node_name=node)),
+    )
+
+
+def test_pod_starts_in_stages_with_events(store):
+    pool = setup_pool(store)
+    bind_pod(store, "p0", "n0")
+    pool.tick(1.0)   # observe + ContainerCreating
+    obj = json.loads(store.get(pod_key("default", "p0")).value)
+    assert obj["status"]["reason"] == "ContainerCreating"
+    assert "default/p0" in pool._starting
+    pool.tick(2.0)   # Running
+    obj = json.loads(store.get(pod_key("default", "p0")).value)
+    assert obj["status"]["phase"] == "Running"
+    assert "default/p0" in pool.running_pods
+    # Events: Scheduled, Pulled, Created, Started.
+    evs = store.range(b"/registry/events/", prefix_end(b"/registry/events/"))
+    reasons = sorted(json.loads(kv.value)["reason"] for kv in evs.kvs)
+    assert reasons == ["Created", "Pulled", "Scheduled", "Started"]
+
+
+def test_node_heartbeats_and_leases(store):
+    pool = setup_pool(store, nodes=2)
+    rev0 = store.current_revision
+    for t in range(1, 22):
+        pool.tick(float(t))
+    # Two 10s intervals elapsed: >=2 lease renewals and >=2 full-Node
+    # heartbeats per node.
+    leases = store.range(
+        b"/registry/leases/kube-node-lease/",
+        prefix_end(b"/registry/leases/kube-node-lease/"),
+    )
+    assert leases.count == 2
+    assert store.current_revision - rev0 >= 8
+    node = json.loads(store.get(node_key("n0")).value)
+    assert node["metadata"]["name"] == "n0"   # heartbeat PUT kept the object
+
+
+def test_status_cas_conflict_rebases(store):
+    pool = setup_pool(store)
+    bind_pod(store, "p0", "n0")
+    pool.tick(1.0)
+    # External writer bumps the pod between stages; the next stage must
+    # rebase onto the fresh revision, not fail forever.
+    kv = store.get(pod_key("default", "p0"))
+    obj = json.loads(kv.value)
+    obj["metadata"]["labels"] = {"touched": "yes"}
+    store.put(pod_key("default", "p0"), json.dumps(obj).encode())
+    pool.tick(2.0)   # CAS fails, rebases
+    pool.tick(3.0)   # succeeds
+    obj = json.loads(store.get(pod_key("default", "p0")).value)
+    assert obj["status"]["phase"] == "Running"
+    assert obj["metadata"]["labels"] == {"touched": "yes"}
+
+
+def test_node_delete_stops_heartbeats(store):
+    """A deleted node must not be resurrected by the status heartbeat."""
+    pool = setup_pool(store, nodes=2)
+    store.delete(node_key("n0"))
+    store.delete(lease_key("kube-node-lease", "n0"))
+    for t in range(1, 25):
+        pool.tick(float(t))
+    assert store.get(node_key("n0")) is None
+    assert store.get(lease_key("kube-node-lease", "n0")) is None
+    assert "n0" not in pool.nodes
+    assert store.get(node_key("n1")) is not None
+
+
+def test_pod_deleted_mid_startup(store):
+    pool = setup_pool(store)
+    bind_pod(store, "p0", "n0")
+    pool.tick(1.0)
+    store.delete(pod_key("default", "p0"))
+    pool.tick(2.0)
+    pool.tick(3.0)
+    assert "default/p0" not in pool._starting
+    assert "default/p0" not in pool.running_pods

@@ -42,12 +42,24 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument(
-        "--backend", choices=("xla", "pallas"), default="pallas",
+        "--backend", choices=("xla", "pallas"), default=None,
         help="filter+score+top-k backend; pallas is the fused kernel "
-        "(ops/pallas_topk.py), xla the scan path (engine/cycle.py)",
+        "(ops/pallas_topk.py), xla the scan path (engine/cycle.py). "
+        "Default: pallas, or xla when --constraints is set.",
+    )
+    ap.add_argument(
+        "--constraints", action="store_true",
+        help="BASELINE configs 3-4: pods carry topologySpread + inter-pod "
+        "(anti)affinity constraints, scheduled under the full default "
+        "profile with live ConstraintState (XLA backend)",
     )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
+    if args.constraints and args.backend == "pallas":
+        ap.error("--constraints requires the XLA backend "
+                 "(constraint plugins live on the XLA path)")
+    if args.backend is None:
+        args.backend = "xla" if args.constraints else "pallas"
     if args.chunk is None:
         # Sweet spots: VMEM-sized tiles for the fused kernel, bigger scan
         # chunks for the XLA path.
@@ -60,15 +72,39 @@ def main():
     build_s = time.perf_counter() - t0
 
     enc = PodBatchHost(PodSpec(batch=args.batch), spec, host.vocab)
-    # Uniform KWOK pods carry no affinity/spread terms, so the base profile
-    # is exact for this workload (affinity plugins would contribute
-    # identically-zero scores); it is also what the pallas backend covers.
-    profile = Profile(
-        node_affinity=0, topology_spread=0, interpod_affinity=0
-    )
+    constraints = None
+    if args.constraints:
+        from k8s1m_tpu.cluster.workload import (
+            affinity_deployment,
+            spread_deployment,
+        )
+        from k8s1m_tpu.snapshot.constraints import (
+            ConstraintTracker,
+            empty_constraints,
+        )
+
+        profile = Profile()      # full default profile
+        tracker = ConstraintTracker(spec)
+        half = args.batch // 2
+        pods = (
+            spread_deployment(tracker, "bench-spread", half, topo=1)
+            + affinity_deployment(
+                tracker, "bench-anti", args.batch - half, anti=True
+            )
+        )
+        constraints = empty_constraints(spec)
+    else:
+        # Uniform KWOK pods carry no affinity/spread terms, so the base
+        # profile is exact for this workload (affinity plugins would
+        # contribute identically-zero scores); it is also what the pallas
+        # backend covers.
+        profile = Profile(
+            node_affinity=0, topology_spread=0, interpod_affinity=0
+        )
+        pods = uniform_pods(args.batch)
 
     table = host.to_device()
-    batch = enc.encode(uniform_pods(args.batch))
+    batch = enc.encode(pods)
     key = jax.random.key(0)
 
     # One jitted step; bind counts stay on-device until the end so the
@@ -78,24 +114,24 @@ def main():
     # captured as jit constants are re-uploaded per call on this backend
     # (~90ms/call through the axon relay).
     @jax.jit
-    def step(table, batch, key):
+    def step(table, constraints, batch, key):
         k1, k2 = jax.random.split(key)
-        table, _, asg = schedule_batch(
-            table, batch, k1, profile=profile, chunk=args.chunk, k=args.k,
-            backend=args.backend,
+        table, constraints, asg = schedule_batch(
+            table, batch, k1, profile=profile, constraints=constraints,
+            chunk=args.chunk, k=args.k, backend=args.backend,
         )
-        return table, k2, asg.bound.sum(dtype=jax.numpy.int32)
+        return table, constraints, k2, asg.bound.sum(dtype=jax.numpy.int32)
 
     t0 = time.perf_counter()
     for _ in range(args.warmup):
-        table, key, bound = step(table, batch, key)
+        table, constraints, key, bound = step(table, constraints, batch, key)
     jax.block_until_ready(table)
     warm_s = time.perf_counter() - t0
 
     counts = []
     t0 = time.perf_counter()
     for _ in range(args.steps):
-        table, key, bound = step(table, batch, key)
+        table, constraints, key, bound = step(table, constraints, batch, key)
         counts.append(bound)
     jax.block_until_ready(table)
     elapsed = time.perf_counter() - t0
@@ -109,8 +145,9 @@ def main():
             f"elapsed={elapsed*1e3:.1f}ms "
             f"({elapsed/args.steps*1e3:.2f}ms/batch)",
         )
+    suffix = "_constrained" if args.constraints else ""
     print(json.dumps({
-        "metric": f"pod_binds_per_sec_{args.nodes}_nodes",
+        "metric": f"pod_binds_per_sec_{args.nodes}_nodes{suffix}",
         "value": round(binds_per_sec, 1),
         "unit": "binds/s",
         "vs_baseline": round(binds_per_sec / BASELINE_BINDS_PER_SEC, 3),

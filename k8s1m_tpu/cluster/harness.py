@@ -57,6 +57,9 @@ class ClusterSpec:
     # The reference skips the WAL for the lease-flood prefix
     # (--wal-no-write-prefix; leases are 100K writes/s of pure churn).
     no_write_prefixes: tuple[str, ...] = ("/registry/leases/",)
+    # Periodic MVCC compaction, the apiserver's --etcd-compaction-interval
+    # (the reference tunes it to 20m, server.tf:28-39; simulated seconds).
+    compact_interval_s: float = 1200.0
     table: TableSpec | None = None
     pod_batch: int = 256
     profile: Profile = dataclasses.field(
@@ -140,6 +143,8 @@ class Cluster:
         self.webhook = WebhookServer(self._webhook_sink).start()
         self._kwok_bootstrapped = False
         self.now = 0.0  # simulated time, monotonic across run_pods calls
+        self._next_compact = spec.compact_interval_s
+        self._compact_target = 0
 
     # ---- plumbing ------------------------------------------------------
 
@@ -186,6 +191,15 @@ class Cluster:
             self._kwok_bootstrapped = True
         bound = sum(ha.tick(now) for ha in self.coordinators)
         kwok = [k.tick(now) for k in self.kwoks]
+        if now >= self._next_compact:
+            # Windowed compaction like the apiserver's: compact away
+            # history older than one full interval.
+            self._next_compact = now + self.spec.compact_interval_s
+            target, self._compact_target = (
+                self._compact_target, self._clients[0].current_revision
+            )
+            if target > 1:
+                self._clients[0].compact(target)
         return {
             "bound": bound,
             "leases_renewed": sum(s["renewed"] for s in kwok),

@@ -31,7 +31,7 @@ import zlib
 
 from k8s1m_tpu.control.objects import lease_key, node_key, pod_key
 from k8s1m_tpu.obs.metrics import Counter
-from k8s1m_tpu.store.native import MemStore, prefix_end
+from k8s1m_tpu.store.native import MemStore, drain_events, prefix_end
 
 NODES_PREFIX = b"/registry/minions/"
 PODS_PREFIX = b"/registry/pods/"
@@ -83,14 +83,14 @@ class KubeletPool:
             self.adopt(name, kv.value, now)
         self._nodes_watch = self.store.watch(
             NODES_PREFIX, prefix_end(NODES_PREFIX),
-            start_revision=res.revision + 1,
+            start_revision=res.revision + 1, queue_cap=1 << 20,
         )
         pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
         for kv in pods.kvs:
             self._observe_pod(kv.value, kv.mod_revision)
         self._pods_watch = self.store.watch(
             PODS_PREFIX, prefix_end(PODS_PREFIX),
-            start_revision=pods.revision + 1,
+            start_revision=pods.revision + 1, queue_cap=1 << 20,
         )
 
     def adopt(self, name: str, obj_bytes: bytes, now: float) -> None:
@@ -185,40 +185,38 @@ class KubeletPool:
 
     def tick(self, now: float) -> dict:
         if self._pods_watch.dropped or self._nodes_watch.dropped:
-            # Watch overflow: events were silently lost (10K native queue)
-            # — relist, the same resync contract as the coordinator's.
+            # Watch overflow: events were silently lost — reset ALL soft
+            # state and relist (the resync contract).  Keeping nodes or
+            # running_pods across the reset would resurrect deleted nodes
+            # via heartbeats and skip recreated pods.
             self.close()
             self._starting.clear()
+            self.nodes.clear()
+            self._next_renewal.clear()
+            self._next_status.clear()
+            self.running_pods.clear()
             self.bootstrap(now)
-        while True:
-            evs = self._nodes_watch.poll(10000)
-            for e in evs:
-                name = e.kv.key[len(NODES_PREFIX):].decode()
-                if e.type == "PUT":
-                    if name in self.nodes:
-                        self.nodes[name] = e.kv.value  # track latest object
-                    else:
-                        self.adopt(name, e.kv.value, now)
+        for e in drain_events(self._nodes_watch):
+            name = e.kv.key[len(NODES_PREFIX):].decode()
+            if e.type == "PUT":
+                if name in self.nodes:
+                    self.nodes[name] = e.kv.value  # track latest object
                 else:
-                    # Node deleted: stop heartbeating — re-PUTting the
-                    # stale object would resurrect a removed node.
-                    self.nodes.pop(name, None)
-                    self._next_renewal.pop(name, None)
-                    self._next_status.pop(name, None)
-                    self.store.delete(lease_key(LEASE_NS, name))
-            if len(evs) < 10000:
-                break
-        while True:
-            evs = self._pods_watch.poll(10000)
-            for e in evs:
-                if e.type == "PUT":
-                    self._observe_pod(e.kv.value, e.kv.mod_revision)
-                else:
-                    key = e.kv.key[len(PODS_PREFIX):].decode()
-                    self._starting.pop(key, None)
-                    self.running_pods.discard(key)
-            if len(evs) < 10000:
-                break
+                    self.adopt(name, e.kv.value, now)
+            else:
+                # Node deleted: stop heartbeating — re-PUTting the
+                # stale object would resurrect a removed node.
+                self.nodes.pop(name, None)
+                self._next_renewal.pop(name, None)
+                self._next_status.pop(name, None)
+                self.store.delete(lease_key(LEASE_NS, name))
+        for e in drain_events(self._pods_watch):
+            if e.type == "PUT":
+                self._observe_pod(e.kv.value, e.kv.mod_revision)
+            else:
+                key = e.kv.key[len(PODS_PREFIX):].decode()
+                self._starting.pop(key, None)
+                self.running_pods.discard(key)
 
         renewed = statuses = 0
         for name, due in self._next_renewal.items():

@@ -21,7 +21,7 @@ import zlib
 
 from k8s1m_tpu.control.objects import lease_key, pod_key
 from k8s1m_tpu.obs.metrics import Counter, Histogram
-from k8s1m_tpu.store.native import MemStore, prefix_end
+from k8s1m_tpu.store.native import MemStore, drain_events, prefix_end
 
 NODES_PREFIX = b"/registry/minions/"
 PODS_PREFIX = b"/registry/pods/"
@@ -89,14 +89,14 @@ class KwokController:
                 self._foreign.add(obj["metadata"]["name"])
         self._nodes_watch = self.store.watch(
             NODES_PREFIX, prefix_end(NODES_PREFIX),
-            start_revision=res.revision + 1,
+            start_revision=res.revision + 1, queue_cap=1 << 20,
         )
         pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
         for kv in pods.kvs:
             self._maybe_start_pod(kv.value, kv.mod_revision)
         self._pods_watch = self.store.watch(
             PODS_PREFIX, prefix_end(PODS_PREFIX),
-            start_revision=pods.revision + 1,
+            start_revision=pods.revision + 1, queue_cap=1 << 20,
         )
 
     def _adopt(self, name: str, now: float) -> None:
@@ -153,38 +153,30 @@ class KwokController:
         newly bound pods.  Returns per-tick stats."""
         renewed = 0
         started0 = len(self.running_pods)
-        while True:  # drain fully — a fixed cap could starve adoption
-            evs = self._nodes_watch.poll(10000)
-            for ev in evs:
-                name = ev.kv.key[len(NODES_PREFIX):].decode()
-                if ev.type == "PUT":
-                    obj = json.loads(ev.kv.value)
-                    if self._owns(obj):
-                        if name not in self.nodes:
-                            self._adopt(name, now)
-                    else:
-                        if name in self.nodes:
-                            self._drop(name)
-                        self._foreign.add(name)
-                        self._waiting.pop(name, None)
+        for ev in drain_events(self._nodes_watch):
+            name = ev.kv.key[len(NODES_PREFIX):].decode()
+            if ev.type == "PUT":
+                obj = json.loads(ev.kv.value)
+                if self._owns(obj):
+                    if name not in self.nodes:
+                        self._adopt(name, now)
                 else:
-                    self._foreign.discard(name)
                     if name in self.nodes:
                         self._drop(name)
-            if len(evs) < 10000:
-                break
-        while True:
-            evs = self._pods_watch.poll(10000)
-            for ev in evs:
-                if ev.type == "PUT":
-                    self._maybe_start_pod(ev.kv.value, ev.kv.mod_revision)
-                else:
-                    key = ev.kv.key[len(PODS_PREFIX):].decode()
-                    self.running_pods.discard(key)
-                    for waiting in self._waiting.values():
-                        waiting.pop(key, None)
-            if len(evs) < 10000:
-                break
+                    self._foreign.add(name)
+                    self._waiting.pop(name, None)
+            else:
+                self._foreign.discard(name)
+                if name in self.nodes:
+                    self._drop(name)
+        for ev in drain_events(self._pods_watch):
+            if ev.type == "PUT":
+                self._maybe_start_pod(ev.kv.value, ev.kv.mod_revision)
+            else:
+                key = ev.kv.key[len(PODS_PREFIX):].decode()
+                self.running_pods.discard(key)
+                for waiting in self._waiting.values():
+                    waiting.pop(key, None)
 
         for name, due in self._next_renewal.items():
             if due <= now:

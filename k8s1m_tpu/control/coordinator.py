@@ -63,11 +63,21 @@ from k8s1m_tpu.plugins.registry import Profile
 from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
 from k8s1m_tpu.snapshot.node_table import NodeTableHost
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
-from k8s1m_tpu.store.native import MemStore, Watcher, prefix_end
+from k8s1m_tpu.store.native import (
+    MemStore,
+    Watcher,
+    drain_events,
+    prefix_end,
+)
 
 log = logging.getLogger("k8s1m.coordinator")
 
 NODES_PREFIX = b"/registry/minions/"
+# Tick-driven consumers drain once per cycle, so the watch queue must
+# absorb a full inter-cycle burst (creates + deletes + bind echoes);
+# the native default of 10K (reference store.rs:27) assumes a
+# continuously-draining consumer.
+DEEP_WATCH_QUEUE = 1 << 20
 PODS_PREFIX = b"/registry/pods/"
 
 _PODS_SCHEDULED = Counter(
@@ -145,6 +155,7 @@ class Coordinator:
         flight_recorder: FlightRecorder | None = None,
         backend: str = "xla",
         pipeline: bool = False,
+        watch_queue_cap: int = DEEP_WATCH_QUEUE,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -157,6 +168,7 @@ class Coordinator:
         self.flight = flight_recorder
         self.backend = backend
         self.pipeline = pipeline
+        self.watch_queue_cap = watch_queue_cap
         self._inflight = None
 
         self.host = NodeTableHost(table_spec)
@@ -208,14 +220,14 @@ class Coordinator:
                 self.host.upsert(decode_node(kv.value))
             self._nodes_watch = self.store.watch(
                 NODES_PREFIX, prefix_end(NODES_PREFIX),
-                start_revision=res.revision + 1,
+                start_revision=res.revision + 1, queue_cap=self.watch_queue_cap,
             )
             pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
             for kv in pods.kvs:
                 self._on_pod_put(kv.value, kv.mod_revision)
             self._pods_watch = self.store.watch(
                 PODS_PREFIX, prefix_end(PODS_PREFIX),
-                start_revision=pods.revision + 1,
+                start_revision=pods.revision + 1, queue_cap=self.watch_queue_cap,
             )
             self.table = self.host.to_device()
 
@@ -327,7 +339,11 @@ class Coordinator:
             return self.resync()
         n = 0
         with _CYCLE_TIME.time(stage="drain"):
-            for ev in self._nodes_watch.poll(max_events):
+            # Drain to (momentarily) empty — a single capped poll per
+            # cycle would let backlog accumulate into an overflow resync
+            # under heavy churn.  drain_events' bound keeps the cycle
+            # live against a producer that outruns the decode pass.
+            for ev in drain_events(self._nodes_watch, max_events):
                 n += 1
                 if ev.type == "PUT":
                     try:
@@ -342,10 +358,12 @@ class Coordinator:
                     name = ev.kv.key[len(NODES_PREFIX):].decode()
                     if name in self.host._row_of:
                         self._dirty_rows.add(self.host.remove(name))
-            for ev in self._pods_watch.poll(max_events):
+            for ev in drain_events(self._pods_watch, max_events):
                 n += 1
                 if ev.type == "PUT":
-                    self._on_pod_put(ev.kv.value, ev.kv.mod_revision, ev.kv.key)
+                    self._on_pod_put(
+                        ev.kv.value, ev.kv.mod_revision, ev.kv.key
+                    )
                 else:
                     self._on_pod_delete(ev.kv.key)
         return n
@@ -368,7 +386,7 @@ class Coordinator:
                     self._dirty_rows.add(self.host.remove(name))
             self._nodes_watch = self.store.watch(
                 NODES_PREFIX, prefix_end(NODES_PREFIX),
-                start_revision=res.revision + 1,
+                start_revision=res.revision + 1, queue_cap=self.watch_queue_cap,
             )
 
             pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
@@ -385,7 +403,7 @@ class Coordinator:
             }
             self._pods_watch = self.store.watch(
                 PODS_PREFIX, prefix_end(PODS_PREFIX),
-                start_revision=pods.revision + 1,
+                start_revision=pods.revision + 1, queue_cap=self.watch_queue_cap,
             )
         return len(listed) + len(seen)
 

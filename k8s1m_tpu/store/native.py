@@ -116,7 +116,7 @@ def _load_lib():
     lib.ms_watch_create.restype = c.c_int64
     lib.ms_watch_create.argtypes = [
         c.c_void_p, c.c_char_p, c.c_size_t, c.c_char_p, c.c_size_t,
-        c.c_int64, c.c_int, c.POINTER(c.c_int64),
+        c.c_int64, c.c_int, c.c_int64, c.POINTER(c.c_int64),
     ]
     lib.ms_watch_cancel.restype = c.c_int
     lib.ms_watch_cancel.argtypes = [c.c_void_p, c.c_int64]
@@ -198,6 +198,26 @@ class Watcher:
         if not self.canceled:
             _lib().ms_watch_cancel(self._store._h, self.id)
             self.canceled = True
+
+
+def drain_events(watcher, batch: int = 10000, limit: int = 200_000):
+    """Yield queued events from a watcher (native or remote) until its
+    queue momentarily empties OR ``limit`` events have been yielded.
+
+    The limit is a liveness bound for tick-driven consumers: against a
+    producer that sustains more than ``batch`` events per decode pass an
+    unbounded drain would never return and the caller's cycle would
+    starve.  The remainder stays queued (deep-capped watchers absorb it)
+    and is picked up next cycle.
+    """
+    seen = 0
+    while True:
+        evs = watcher.poll(batch)
+        for ev in evs:
+            yield ev
+        seen += len(evs)
+        if len(evs) < batch or seen >= limit:
+            return
 
 
 class MemStore:
@@ -340,13 +360,19 @@ class MemStore:
         *,
         start_revision: int = 0,
         prev_kv: bool = False,
+        queue_cap: int = 0,
     ) -> Watcher:
+        """``queue_cap=0`` keeps the reference's 10K default (store.rs:27);
+        tick-driven consumers that drain per cycle rather than
+        continuously pass a deep cap so bursty churn between cycles
+        doesn't overflow into a forced resync."""
         lib = _lib()
         compact = ctypes.c_int64()
         wid = lib.ms_watch_create(
             self._h, start, len(start),
             end, 0 if end is None else len(end),
-            start_revision, 1 if prev_kv else 0, ctypes.byref(compact),
+            start_revision, 1 if prev_kv else 0, queue_cap,
+            ctypes.byref(compact),
         )
         if wid == _ERR_COMPACTED:
             raise CompactedError(compact.value)

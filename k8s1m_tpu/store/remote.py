@@ -297,7 +297,11 @@ class RemoteStore:
         *,
         start_revision: int = 0,
         prev_kv: bool = False,
+        queue_cap: int = 0,
     ) -> RemoteWatcher:
+        """``queue_cap`` is accepted for MemStore-surface compatibility but
+        unused: the wire watcher's server side drains continuously into
+        the stream, and the client side buffers in an unbounded deque."""
         return RemoteWatcher(self, start, end, start_revision, prev_kv)
 
     # ---- maintenance ---------------------------------------------------

@@ -41,6 +41,12 @@ def parse_args(argv=None):
     ap.add_argument("--target", default=None,
                     help="remote store addr (default: in-process store)")
     ap.add_argument("--no-pipeline", action="store_true")
+    ap.add_argument(
+        "--churn", action="store_true",
+        help="BASELINE config 5 shape: delete the pods bound two waves "
+        "ago while new waves arrive — sustained create+delete churn "
+        "instead of a fill-up",
+    )
     return ap.parse_args(argv)
 
 
@@ -83,6 +89,16 @@ def main(argv=None):
     store.put(keys[0], values[0])
     while coord.run_until_idle() == 0:
         pass
+    if args.churn:
+        # Churn also exercises the dirty-row scatter (delete -> row
+        # re-upload) at full wave-sized buckets; compile those now too.
+        for i in range(4096):
+            store.put(pod_key("warm", f"w-{i}"),
+                      encode_pod(PodInfo(f"w-{i}", cpu_milli=1, mem_kib=1)))
+        coord.run_until_idle()
+        for i in range(4096):
+            store.delete(pod_key("warm", f"w-{i}"))
+        coord.run_until_idle()
 
     # Producer interleaved with scheduling, like make_pods running against
     # a live scheduler; wave pacing keeps the 10K-deep watch buffer from
@@ -94,9 +110,17 @@ def main(argv=None):
     t0 = time.perf_counter()
     bound = 0
     off = 1
+    deleted = 0
     while off < args.pods:
         for k, v in zip(keys[off:off + wave], values[off:off + wave]):
             store.put(k, v)
+        if args.churn and off > 2 * wave:
+            # Delete the wave bound two waves ago — the scheduler keeps
+            # binding into capacity that deletions keep freeing.
+            lo = off - 3 * wave
+            for k in keys[max(1, lo):lo + wave]:
+                store.delete(k)
+                deleted += 1
         off += wave
         bound += coord.step()
     bound += coord.run_until_idle()
@@ -117,6 +141,7 @@ def main(argv=None):
         "detail": {
             "pods": args.pods,
             "bound": bound,
+            "deleted": deleted,
             "node_create_s": round(nodes_s, 2),
             "bootstrap_s": round(bootstrap_s, 2),
             "pod_create_per_sec": round(args.pods / create_s, 1),

@@ -129,10 +129,11 @@ struct Event {
   KvMeta prev;
 };
 
-constexpr size_t kWatcherQueueCap = 10000;  // reference store.rs:27
+constexpr size_t kDefaultWatcherQueueCap = 10000;  // reference store.rs:27
 
 struct Watcher {
   int64_t id = 0;
+  size_t queue_cap = kDefaultWatcherQueueCap;
   std::string start, end;  // end conventions: "" single key, "\0" infinity
   bool single = false;
   bool want_prev = false;
@@ -396,7 +397,7 @@ struct ms_store {
       if (ev.kv.mod_rev < w->min_rev) continue;
       std::lock_guard<std::mutex> g(w->m);
       if (w->canceled) continue;
-      if (w->q.size() >= kWatcherQueueCap) {
+      if (w->q.size() >= w->queue_cap) {
         w->dropped++;
         continue;
       }
@@ -821,7 +822,8 @@ int ms_compact(ms_store* s, int64_t rev) {
 
 int64_t ms_watch_create(ms_store* s, const uint8_t* start, size_t start_len,
                         const uint8_t* end, size_t end_len, int64_t start_rev,
-                        int want_prev_kv, int64_t* compact_rev_out) {
+                        int want_prev_kv, int64_t queue_cap,
+                        int64_t* compact_rev_out) {
   std::unique_lock<std::shared_mutex> g(s->mu);
   if (start_rev > 0 && s->compacted && start_rev < s->compacted) {
     if (compact_rev_out) *compact_rev_out = s->compacted;
@@ -829,6 +831,10 @@ int64_t ms_watch_create(ms_store* s, const uint8_t* start, size_t start_len,
   }
   auto w = std::make_shared<Watcher>();
   w->id = s->next_watcher++;
+  // 0 = default cap.  Tick-driven consumers (the coordinator's pod
+  // firehose) pass a deep cap: they drain per cycle, not continuously,
+  // so a 10K cap would overflow between cycles under bursty churn.
+  if (queue_cap > 0) w->queue_cap = static_cast<size_t>(queue_cap);
   w->start.assign(reinterpret_cast<const char*>(start), start_len);
   RangeKind kind = range_kind(end, end_len);
   w->single = kind == RangeKind::kSingle;

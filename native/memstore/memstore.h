@@ -127,7 +127,8 @@ int ms_compact(ms_store* s, int64_t rev);
  * if start_rev is below the compact revision. */
 int64_t ms_watch_create(ms_store* s, const uint8_t* start, size_t start_len,
                         const uint8_t* end, size_t end_len, int64_t start_rev,
-                        int want_prev_kv, int64_t* compact_rev_out);
+                        int want_prev_kv, int64_t queue_cap,
+                        int64_t* compact_rev_out);
 
 int ms_watch_cancel(ms_store* s, int64_t watcher_id);
 

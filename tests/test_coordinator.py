@@ -229,7 +229,9 @@ def test_quantity_parsing():
 
 def test_watch_overflow_triggers_resync(store):
     put_node(store, "n0")
-    c = make_coord(store)
+    # Production uses a 1M-deep queue; a small cap here exercises the
+    # overflow-resync path without 1M events.
+    c = make_coord(store, watch_queue_cap=10_000)
     c.bootstrap()
     # Overflow the 10,000-event native watch queue without draining: the
     # coordinator must detect dropped events and relist (reflector 410

@@ -195,10 +195,9 @@ class Cluster:
             # Windowed compaction like the apiserver's: compact away
             # history older than one full interval.
             self._next_compact = now + self.spec.compact_interval_s
-            target, self._compact_target = (
-                self._compact_target, self._clients[0].current_revision
-            )
-            if target > 1:
+            current = self._clients[0].current_revision
+            target, self._compact_target = self._compact_target, current
+            if 1 < target <= current:
                 self._clients[0].compact(target)
         return {
             "bound": bound,
@@ -268,6 +267,44 @@ class Cluster:
             "binds_per_sec": round(bound / total_s, 1),
         }
 
+    def _stop_server(self) -> None:
+        self._server.terminate()
+        try:
+            self._server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._server.kill()
+            self._server.wait()
+
+    def restart_store(self) -> None:
+        """Kill and restart the store server on the same port + WAL dir —
+        the crash-recovery drill: WAL replay restores state, broken watch
+        streams surface as dropped and every consumer relists."""
+        cmd = self._server.args
+        self._stop_server()
+        self._server = subprocess.Popen(cmd)
+        # WAL-skipped prefixes (leases) lower the replayed revision below
+        # the pre-crash counter; a stale compaction target would then be
+        # a future revision the store rejects.
+        self._compact_target = 0
+        wait_for_port(self.port)
+        # Wait until every live watch stream has observed the break —
+        # gRPC delivers it asynchronously (~100ms), while simulated ticks
+        # can outrun wall time; a real cluster ticks in wall time, so the
+        # drill should too.
+        deadline = time.monotonic() + 5.0
+        watchers = []
+        for k in self.kwoks:
+            watchers += [k._nodes_watch, k._pods_watch]
+        for ha in self.coordinators:
+            if ha.coord is not None:
+                watchers += [ha.coord._nodes_watch, ha.coord._pods_watch]
+        for w in watchers:
+            while (
+                w is not None and not w.canceled and not w.dropped
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+
     def shutdown(self) -> None:
         if self._server is None:
             return
@@ -282,12 +319,7 @@ class Cluster:
                 c.close()
             except Exception:
                 pass
-        self._server.terminate()
-        try:
-            self._server.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            self._server.kill()
-            self._server.wait()
+        self._stop_server()
         self._server = None
 
     def __enter__(self):

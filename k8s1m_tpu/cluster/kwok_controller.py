@@ -60,6 +60,7 @@ class KwokController:
         self._nodes_watch = None
         self._pods_watch = None
         self.running_pods: set[str] = set()
+        self._started_total = 0   # monotonic; survives resync resets
         # Pods bound to one of OUR nodes whose adoption event hasn't been
         # applied yet (node and pod watches are separate queues, so a bind
         # can be seen before its node) — parked per node, started on adopt.
@@ -119,6 +120,13 @@ class KwokController:
         if not node:
             return
         if obj.get("status", {}).get("phase") != "Pending":
+            # Already Running (e.g. a relist after resync): keep it in the
+            # running set if it's on one of our nodes.
+            if node in self.nodes:
+                self.running_pods.add(
+                    f"{obj['metadata'].get('namespace', 'default')}/"
+                    f"{obj['metadata']['name']}"
+                )
             return
         if node in self._foreign:
             return            # another group's node — not ours to start
@@ -142,6 +150,7 @@ class KwokController:
         if ok:
             self.running_pods.add(f"{obj['metadata'].get('namespace', 'default')}/"
                                   f"{obj['metadata']['name']}")
+            self._started_total += 1
             _PODS_STARTED.inc(group=self.group)
         # CAS failure: someone updated the pod concurrently; the new
         # revision arrives via the watch and is retried there.
@@ -151,8 +160,35 @@ class KwokController:
     def tick(self, now: float) -> dict:
         """Advance the simulator: drain watches, renew due leases, start
         newly bound pods.  Returns per-tick stats."""
+        # ``started`` is a monotonic counter delta, NOT a set-size delta:
+        # a resync clears and rebuilds running_pods, which would make any
+        # length-based delta meaningless for the tick that resyncs.
+        started0 = self._started_total
+        if (
+            self._nodes_watch is None      # earlier resync attempt failed
+            or self._pods_watch is None
+            or self._nodes_watch.dropped
+            or self._pods_watch.dropped
+        ):
+            # Watch overflow or broken stream (store restart): events were
+            # lost — reset soft state and relist, like the coordinator.
+            # A failed relist (store still down) leaves the watches None
+            # and is retried next tick instead of wedging the controller.
+            self.close()
+            self.nodes.clear()
+            self._next_renewal.clear()
+            self._waiting.clear()
+            self._foreign.clear()
+            self.running_pods.clear()
+            try:
+                self.bootstrap(now)
+            except Exception:
+                import logging
+                logging.getLogger("k8s1m.kwok").warning(
+                    "resync relist failed; retrying next tick", exc_info=True
+                )
+                return {"renewed": 0, "started": 0, "nodes": 0}
         renewed = 0
-        started0 = len(self.running_pods)
         for ev in drain_events(self._nodes_watch):
             name = ev.kv.key[len(NODES_PREFIX):].decode()
             if ev.type == "PUT":
@@ -187,7 +223,7 @@ class KwokController:
                 renewed += 1
         return {
             "renewed": renewed,
-            "started": len(self.running_pods) - started0,
+            "started": self._started_total - started0,
             "nodes": len(self.nodes),
         }
 

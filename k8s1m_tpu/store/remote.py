@@ -91,6 +91,7 @@ class RemoteWatcher:
         self._thread.start()
 
     def _reader(self):
+        ended_clean = False
         try:
             for resp in self._call:
                 if resp.compact_revision:
@@ -104,6 +105,7 @@ class RemoteWatcher:
                             "watch canceled by server: %s", resp.cancel_reason
                         )
                         self._dropped += 1
+                    ended_clean = True
                     break
                 if not resp.events:
                     continue
@@ -119,12 +121,21 @@ class RemoteWatcher:
                         )
                         self._events.append(WatchEvent(kind, _kv(ev.kv), prev))
         except grpc.RpcError as e:
+            ended_clean = True  # error path already counted below
             if not self.canceled:
                 log.warning("watch stream broke: %s", e)
                 self._dropped += 1
         except CompactedError:
+            ended_clean = True
             self._dropped += 1
         finally:
+            if not ended_clean and not self.canceled:
+                # Bare EOF: the server closed the stream without a cancel
+                # response or an error (graceful shutdown).  Events after
+                # this point are lost — the owner must resync, exactly as
+                # for a broken stream.
+                log.warning("watch stream ended by server")
+                self._dropped += 1
             self.canceled = True
             # Unblock gRPC's request-consumer thread even when the stream
             # died server-side (cancel() will never enqueue the sentinel

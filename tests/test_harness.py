@@ -75,3 +75,30 @@ def test_leases_written_on_wire(cluster):
     assert res.count == 64
     lease = json.loads(res.kvs[0].value)
     assert lease["spec"]["leaseDurationSeconds"] == 40
+
+
+def test_store_crash_recovery_via_wal(tmp_path):
+    """Kill the store server mid-run: WAL replay restores state, the
+    coordinators and KWOK controllers resync over their broken streams,
+    and scheduling continues — the cluster-level recovery drill
+    (reference RUNNING.adoc:68-111 WAL modes; 'reconcile or rebuild')."""
+    spec = ClusterSpec(
+        nodes=32, kwok_groups=1, coordinators=1, pod_batch=16, chunk=64,
+        wal_mode="buffered", no_write_prefixes=(),
+    )
+    with Cluster(spec, wal_dir=str(tmp_path)) as c:
+        c.make_nodes()
+        c.tick()
+        stats = c.run_pods(10, max_ticks=30)
+        assert stats["bound"] == 10
+
+        c.restart_store()
+        # Everything written before the crash survived the WAL.
+        store = c._clients[0]
+        res = store.range(b"/registry/minions/", prefix_end(b"/registry/minions/"))
+        assert res.count == 32
+
+        # Consumers detect the broken streams, resync, and keep working.
+        stats = c.run_pods(10, max_ticks=60)
+        assert stats["bound"] == 10
+        assert stats["running"] == 10

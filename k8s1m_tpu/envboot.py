@@ -1,0 +1,32 @@
+"""Clean-environment bootstrap shared by the test suite and driver entry.
+
+This environment force-registers the axon TPU backend from a sitecustomize
+hook on PYTHONPATH at interpreter start.  A process that has initialised
+(or will initialise) that backend cannot host a virtual multi-device CPU
+mesh, so both pytest (tests/conftest.py) and the driver's multi-chip dry
+run (__graft_entry__.dryrun_multichip) re-launch themselves in a child
+interpreter built from :func:`cleaned_cpu_env`.
+
+Must stay importable without jax (it runs before backend selection).
+"""
+
+from __future__ import annotations
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def cleaned_cpu_env(environ, n_devices: int) -> dict:
+    """A copy of ``environ`` prepared for an ``n_devices`` CPU-mesh child:
+    axon stripped from PYTHONPATH, JAX_PLATFORMS=cpu, and the virtual
+    device count forced (replacing any existing count flag)."""
+    env = dict(environ)
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if p and "axon_site" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split() if not f.startswith(_COUNT_FLAG)
+    ]
+    flags.append(f"{_COUNT_FLAG}={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env

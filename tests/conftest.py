@@ -14,6 +14,10 @@ re-exec pytest once with a cleaned environment.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from k8s1m_tpu.envboot import cleaned_cpu_env  # noqa: E402
+
 _WANT_FLAG = "--xla_force_host_platform_device_count=8"
 
 
@@ -36,12 +40,7 @@ def pytest_configure(config):
     capman = config.pluginmanager.getplugin("capturemanager")
     if capman is not None:
         capman.stop_global_capturing()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ":".join(
-        p for p in env.get("PYTHONPATH", "").split(":") if p and "axon_site" not in p
-    )
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
+    env = cleaned_cpu_env(os.environ, 8)
     env["K8S1M_TEST_REEXEC"] = "1"
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 

@@ -54,8 +54,9 @@ from k8s1m_tpu.control.objects import (
 )
 from k8s1m_tpu.engine.cycle import (
     adjust_constraints,
+    commit_fields_np,
     commit_fields_of,
-    schedule_batch,
+    schedule_batch_packed,
 )
 from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram
 from k8s1m_tpu.obs.trace import FlightRecorder
@@ -64,9 +65,10 @@ from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
 from k8s1m_tpu.snapshot.node_table import NodeTableHost
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
 from k8s1m_tpu.store.native import (
+    BIND_INVALID,
     MemStore,
     Watcher,
-    drain_events,
+    drain_events_light,
     prefix_end,
 )
 
@@ -115,6 +117,9 @@ class PendingPod:
     # Raw stored bytes at intake revision — lets the bind CAS splice
     # nodeName into the bytes without a JSON decode/encode round trip.
     raw: bytes | None = None
+    # Store key bytes, captured at intake so the bind wave never
+    # re-formats /registry/pods/<ns>/<name> per pod.
+    key_bytes: bytes = b""
 
 
 # Structural splice marker: encode_pod always opens spec with
@@ -156,6 +161,7 @@ class Coordinator:
         backend: str = "xla",
         pipeline: bool = False,
         watch_queue_cap: int = DEEP_WATCH_QUEUE,
+        score_pct: int = 100,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -170,6 +176,22 @@ class Coordinator:
         self.pipeline = pipeline
         self.watch_queue_cap = watch_queue_cap
         self._inflight = None
+        # percentageOfNodesToScore (the reference's production config
+        # scores 5% of nodes per pod at 1M scale, README.adoc:525-531;
+        # terraform tfvars percentageOfNodesToScore: 5).  Each cycle
+        # filters+scores one rotating chunk-aligned window of the table.
+        if not 1 <= score_pct <= 100:
+            raise ValueError(f"score_pct must be in [1, 100], got {score_pct}")
+        if score_pct < 100 and with_constraints:
+            raise ValueError(
+                "score_pct < 100 requires with_constraints=False (spread/"
+                "inter-pod affinity need global domain statistics)"
+            )
+        n = table_spec.max_nodes
+        rows = -(-n * score_pct // 100)             # ceil
+        rows = -(-rows // chunk) * chunk            # round up to chunk
+        self._sample_rows = None if rows >= n else rows
+        self._window_i = 0
 
         self.host = NodeTableHost(table_spec)
         self.tracker = ConstraintTracker(table_spec)
@@ -299,7 +321,10 @@ class Coordinator:
             return
         self._queued_keys.add(pod.key)
         self.queue.append(
-            PendingPod(pod, mod_revision, time.perf_counter(), raw=data)
+            PendingPod(
+                pod, mod_revision, time.perf_counter(), raw=data,
+                key_bytes=key or pod_key(pod.namespace, pod.name),
+            )
         )
 
     def _on_pod_delete(self, key: bytes) -> None:
@@ -337,17 +362,23 @@ class Coordinator:
                 self._nodes_watch.dropped, self._pods_watch.dropped,
             )
             return self.resync()
+        n = self._drain_node_events(max_events)
+        n += self._drain_pod_events(max_events)
+        return n
+
+    def _drain_node_events(self, max_events: int = 10000) -> int:
+        """Apply node deltas.  MUTATES the row->node mapping (upsert can
+        reuse a freed row) — in the pipelined step this must only run
+        while no wave is in flight (see step())."""
         n = 0
         with _CYCLE_TIME.time(stage="drain"):
-            # Drain to (momentarily) empty — a single capped poll per
-            # cycle would let backlog accumulate into an overflow resync
-            # under heavy churn.  drain_events' bound keeps the cycle
-            # live against a producer that outruns the decode pass.
-            for ev in drain_events(self._nodes_watch, max_events):
+            for etype, key, value, _mrev in drain_events_light(
+                self._nodes_watch, max_events
+            ):
                 n += 1
-                if ev.type == "PUT":
+                if etype == 0:
                     try:
-                        node = decode_node(ev.kv.value)
+                        node = decode_node(value)
                     except Exception:
                         _DECODE_ERRORS.inc(kind="node")
                         log.exception("undecodable node object; skipping")
@@ -355,17 +386,28 @@ class Coordinator:
                     self._dirty_rows.add(self.host.upsert(node))
                     self._adopt_orphans(node.name)
                 else:
-                    name = ev.kv.key[len(NODES_PREFIX):].decode()
+                    name = key[len(NODES_PREFIX):].decode()
                     if name in self.host._row_of:
                         self._dirty_rows.add(self.host.remove(name))
-            for ev in drain_events(self._pods_watch, max_events):
+        return n
+
+    def _drain_pod_events(self, max_events: int = 10000) -> int:
+        """Apply pod deltas.  Touches capacity accounting only — never
+        the row->node mapping — so it is safe to run while a wave is in
+        flight.  Drain to (momentarily) empty: a single capped poll per
+        cycle would let backlog accumulate into an overflow resync under
+        heavy churn; drain_events_light's bound keeps the cycle live
+        against a producer that outruns the decode pass."""
+        n = 0
+        with _CYCLE_TIME.time(stage="drain"):
+            for etype, key, value, mrev in drain_events_light(
+                self._pods_watch, max_events
+            ):
                 n += 1
-                if ev.type == "PUT":
-                    self._on_pod_put(
-                        ev.kv.value, ev.kv.mod_revision, ev.kv.key
-                    )
+                if etype == 0:
+                    self._on_pod_put(value, mrev, key)
                 else:
-                    self._on_pod_delete(ev.kv.key)
+                    self._on_pod_delete(key)
         return n
 
     def resync(self) -> int:
@@ -503,73 +545,166 @@ class Coordinator:
             if pod.key in self._queued_keys or pod.key in self._bound:
                 continue
             self._queued_keys.add(pod.key)
-            self.queue.append(PendingPod(pod, None, time.perf_counter()))
+            self.queue.append(
+                PendingPod(
+                    pod, None, time.perf_counter(),
+                    key_bytes=pod_key(pod.namespace, pod.name),
+                )
+            )
 
-    def _dispatch(self):
-        """Intake + device half of a cycle: drain deltas, encode a batch,
-        enqueue the device step.  Returns an in-flight record (or None if
-        nothing is pending) without forcing any device→host transfer, so
-        a pipelined caller overlaps this batch's device work with the
-        previous batch's bind writes."""
-        self._drain_external()
-        self.drain_watches()
-        self._sync_table()
-        self._process_adjusts()
+    def _take_batch(self):
+        """Pop and encode up to one batch of pending pods; (None, None)
+        when the queue is empty."""
         if not self.queue:
-            return None
-        t_start = time.perf_counter()
-
+            return None, None
         batch_pods: list[PendingPod] = []
         while self.queue and len(batch_pods) < self.pod_spec.batch:
             batch_pods.append(self.queue.popleft())
         for p in batch_pods:
             self._queued_keys.discard(p.pod.key)
-
         with _CYCLE_TIME.time(stage="encode"):
-            batch = self.encoder.encode([p.pod for p in batch_pods])
+            batch = self.encoder.encode_packed([p.pod for p in batch_pods])
+        return batch_pods, batch
+
+    def _next_window(self) -> int:
+        """Rotating sample-window offset covering every row over
+        ceil(N/S) cycles (the tail window is anchored at N-S)."""
+        n = self.table_spec.max_nodes
+        s = self._sample_rows
+        w = n // s
+        total = w + (1 if n % s else 0)
+        i = self._window_i % total
+        self._window_i += 1
+        return n - s if i == w else i * s
+
+    def _launch(self, batch_pods, batch):
+        """Enqueue the device step for an encoded batch (async — no
+        device→host transfer is forced)."""
+        t_start = time.perf_counter()
         self.key, subkey = jax.random.split(self.key)
         with _CYCLE_TIME.time(stage="device"):
-            self.table, self.constraints, asg = schedule_batch(
+            self.table, self.constraints, asg, rows_dev = schedule_batch_packed(
                 self.table, batch, subkey,
                 profile=self.profile, constraints=self.constraints,
                 chunk=self.chunk, k=self.k, backend=self.backend,
+                sample_rows=self._sample_rows,
+                sample_offset=(
+                    self._next_window() if self._sample_rows else 0
+                ),
             )
-        return (batch_pods, batch, asg, t_start)
+        return (batch_pods, batch, asg, rows_dev, t_start)
+
+    def _dispatch(self):
+        """Intake + device half of a cycle: drain deltas, encode a batch,
+        enqueue the device step.  Returns an in-flight record (or None if
+        nothing is pending) without forcing any device→host transfer."""
+        self._drain_external()
+        self.drain_watches()
+        self._sync_table()
+        self._process_adjusts()
+        batch_pods, batch = self._take_batch()
+        if batch_pods is None:
+            return None
+        return self._launch(batch_pods, batch)
 
     def _complete(self, inflight) -> int:
         """Bind half: sync the assignment to host, CAS the binds back,
         roll back conflicts."""
-        batch_pods, batch, asg, t_start = inflight
+        batch_pods, batch, asg, rows_dev, t_start = inflight
         with _CYCLE_TIME.time(stage="sync_out"):
-            # One transfer for both arrays — each device_get through a
-            # remote relay pays per-call latency.
-            node_row, bound = jax.device_get((asg.node_row, asg.bound))
+            # ONE device_get per wave: through a remote relay each fetch
+            # is a full round trip (~tens of ms), so the bind decision
+            # comes back as a single packed i32[B] (-1 = unbound).
+            node_row = jax.device_get(rows_dev)
 
         nbound = 0
         failed = np.zeros(self.pod_spec.batch, bool)
+        bind_batch = getattr(self.store, "bind_batch", None)
+        host = self.host
         with _CYCLE_TIME.time(stage="bind"):
-            for i, p in enumerate(batch_pods):
-                if bound[i]:
-                    name = self.host.vocab.node_names.value(
-                        int(self.host.name_id[node_row[i]])
-                    )
-                    if self._bind(p, name):
-                        nbound += 1
-                        _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
-                        continue
-                    # CAS conflict: the device table already assumed this
-                    # bind (commit_binds), but the host mirror — which is
-                    # authoritative — was never incremented.  Marking the
-                    # row dirty re-uploads the host values, undoing the
-                    # device-side assume; the constraint-count commit is
-                    # rolled back below in one signed scatter.
-                    self._dirty_rows.add(self.host.row_of(name))
-                    failed[i] = True
+            # One native call binds the whole wave: splice + CAS happen
+            # inside the store against the bytes it already holds
+            # (ms_bind_batch), so the per-pod Python cost collapses to
+            # bookkeeping — itself vectorized below (per-pod np scalar
+            # indexing and metric calls were ~12us/pod).  Pods the native
+            # path can't take (webhook intake with no observed revision,
+            # non-canonical objects) fall back to the per-pod path.
+            nb = len(batch_pods)
+            rows = node_row[:nb]
+            bound_idx = np.nonzero(rows >= 0)[0]
+            for i in np.nonzero(rows < 0)[0].tolist():
+                self._retry(batch_pods[i])
+            brows = rows[bound_idx]
+            nv = host.vocab.node_names._to_val
+            names = [nv[i] for i in host.name_id[brows].tolist()]
+            zones = host.zone[brows].tolist()
+            regions = host.region[brows].tolist()
+
+            wave: list[tuple[int, PendingPod, str, int, int, int]] = []
+            entries: list[tuple[bytes, int, bytes]] = []
+            for j, i in enumerate(bound_idx.tolist()):
+                p = batch_pods[i]
+                name = names[j]
+                if bind_batch is not None and p.mod_revision is not None:
+                    wave.append((i, p, name, int(brows[j]), zones[j], regions[j]))
+                    entries.append((p.key_bytes, p.mod_revision, name.encode()))
+                    continue
+                if self._bind(p, name):
+                    nbound += 1
+                    _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
+                    continue
+                # CAS conflict: the device table already assumed this
+                # bind (commit_binds), but the host mirror — which is
+                # authoritative — was never incremented.  Marking the
+                # row dirty re-uploads the host values, undoing the
+                # device-side assume; the constraint-count commit is
+                # rolled back below in one signed scatter.
+                self._dirty_rows.add(host.row_of(name))
+                failed[i] = True
                 self._retry(p)
+            if wave:
+                results = self.store.bind_batch(entries)
+                now = time.perf_counter()
+                ok_rows: list[int] = []
+                ok_cpu: list[int] = []
+                ok_mem: list[int] = []
+                lats: list[float] = []
+                bound_dict = self._bound
+                for (i, p, name, row, zone, region), rev in zip(wave, results):
+                    if rev > 0:
+                        pod = p.pod
+                        ok_rows.append(row)
+                        ok_cpu.append(pod.cpu_milli)
+                        ok_mem.append(pod.mem_kib)
+                        lats.append(now - p.enqueued_at)
+                        keep = pod if self._constraintful(pod) else None
+                        bound_dict[pod.key] = (
+                            name, pod.cpu_milli, pod.mem_kib, zone, region, keep,
+                        )
+                        continue
+                    if rev == BIND_INVALID and self._bind(p, name):
+                        nbound += 1
+                        _BIND_LATENCY.observe(now - p.enqueued_at)
+                        continue
+                    if rev != BIND_INVALID:
+                        _PODS_SCHEDULED.inc(outcome="conflict")
+                    self._dirty_rows.add(host.row_of(name))
+                    failed[i] = True
+                    self._retry(p)
+                if ok_rows:
+                    # Duplicate rows (two pods on one node) accumulate
+                    # correctly under np.add.at.
+                    r = np.asarray(ok_rows, np.int32)
+                    np.add.at(host.cpu_req, r, np.asarray(ok_cpu, host.cpu_req.dtype))
+                    np.add.at(host.mem_req, r, np.asarray(ok_mem, host.mem_req.dtype))
+                    np.add.at(host.pods_req, r, 1)
+                    nbound += len(ok_rows)
+                    _PODS_SCHEDULED.inc(len(ok_rows), outcome="bound")
+                    _BIND_LATENCY.observe_many(lats)
         if failed.any() and self.constraints is not None:
             m = jnp.asarray(failed)
             self.constraints = adjust_constraints(
-                self.constraints, commit_fields_of(batch),
+                self.constraints, commit_fields_np(batch.fields),
                 asg.node_row, asg.zone, asg.region, m, m, sign=-1,
             )
 
@@ -599,11 +734,39 @@ class Coordinator:
         if not self.pipeline:
             disp = self._dispatch()
             return self._complete(disp) if disp is not None else 0
+        # Pipelined: run this cycle's host-heavy pod intake (drain +
+        # encode) BEFORE syncing the in-flight batch, so the device
+        # computes the previous wave while the host decodes this one.
+        # Ordering constraints:
+        #  - node events (and resync) mutate the row->node mapping, so
+        #    they apply only AFTER the in-flight wave — whose bind rows
+        #    were chosen against the old mapping — has retired;
+        #  - pod events touch capacity accounting only and are safe to
+        #    drain while the wave is in flight;
+        #  - _complete lands its bind accounting (and CAS-rollback dirty
+        #    rows) in the host mirror before _sync_table re-uploads rows
+        #    for the next launch.
         done = 0
+        if self._nodes_watch.dropped or self._pods_watch.dropped:
+            if self._inflight is not None:
+                prev, self._inflight = self._inflight, None
+                done += self._complete(prev)
+            log.warning(
+                "watch overflow (nodes dropped=%d pods dropped=%d); resyncing",
+                self._nodes_watch.dropped, self._pods_watch.dropped,
+            )
+            self.resync()
+        self._drain_external()
+        self._drain_pod_events()
+        batch_pods, batch = self._take_batch()
         if self._inflight is not None:
             prev, self._inflight = self._inflight, None
-            done = self._complete(prev)
-        self._inflight = self._dispatch()
+            done += self._complete(prev)
+        self._drain_node_events()
+        self._sync_table()
+        self._process_adjusts()
+        if batch_pods is not None:
+            self._inflight = self._launch(batch_pods, batch)
         return done
 
     def flush(self) -> int:

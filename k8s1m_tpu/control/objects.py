@@ -282,7 +282,136 @@ def decode_pod(data: bytes, tracker: ConstraintTracker | None = None) -> PodInfo
     Without a tracker, podAffinity/topologySpreadConstraints are ignored
     (the caller only wants identity/resources — e.g. load accounting).
     """
+    pod = decode_pod_fast(data, tracker)
+    if pod is not None:
+        return pod
     return decode_pod_obj(json.loads(data), tracker)
+
+
+# Byte landmarks of the canonical encode_pod shape.  The fast parser
+# accepts EXACTLY the objects this module's encode_pod emits for pods with
+# no selectors/tolerations/affinity/spread (plus the nodeName-spliced bind
+# form) — anything else, including any backslash escape anywhere, falls
+# back to the full JSON path.  This is the restricted-parser analogue of
+# the reference's empirically-restricted Txn support (one shape, fast;
+# everything else rejected — kv_service.rs:126-337).
+_FP_HEAD = b'{"apiVersion":"v1","kind":"Pod","metadata":{"name":"'
+_FP_NS = b'","namespace":"'
+_FP_LABELS = b'","labels":{'
+_FP_NODE = b'"nodeName":"'
+_FP_SCHED = b'"schedulerName":"'
+_FP_CONTAINERS = (
+    b'","containers":[{"name":"app","image":"img",'
+    b'"resources":{"requests":{"cpu":"'
+)
+_FP_MEM = b'","memory":"'
+_FP_TAIL = b'"}}}]},"status":{"phase":"Pending"}}'
+# encode_pod appends nodeName after containers (dict insertion order);
+# the bind splice inserts it before schedulerName.  Accept both.
+_FP_NODE_TAIL = b'"}}}],"nodeName":"'
+_FP_STATUS = b'"},"status":{"phase":"Pending"}}'
+
+
+def decode_pod_fast(
+    data: bytes, tracker: ConstraintTracker | None = None
+) -> PodInfo | None:
+    """Parse the canonical pod shape with byte scans; None = not canonical.
+
+    ~4x faster than json.loads + decode_pod_obj on the watch firehose,
+    where nearly every object is one this framework's own encoders wrote.
+    """
+    if not data.startswith(_FP_HEAD) or b"\\" in data:
+        return None
+    i = len(_FP_HEAD)
+    j = data.find(b'"', i)
+    name = data[i:j]
+    if not data.startswith(_FP_NS, j):
+        return None
+    i = j + len(_FP_NS)
+    j = data.find(b'"', i)
+    namespace = data[i:j]
+    if not data.startswith(_FP_LABELS, j):
+        return None
+    i = j + len(_FP_LABELS)
+    labels: dict[str, str] = {}
+    if data[i : i + 1] == b"}":
+        i += 1
+    else:
+        while True:
+            if data[i : i + 1] != b'"':
+                return None
+            j = data.find(b'"', i + 1)
+            lk = data[i + 1 : j]
+            if data[j : j + 3] != b'":"':
+                return None
+            i = j + 3
+            j = data.find(b'"', i)
+            labels[lk.decode()] = data[i:j].decode()
+            nxt = data[j + 1 : j + 2]
+            i = j + 2
+            if nxt == b",":
+                continue
+            if nxt == b"}":
+                break
+            return None
+    if data[i : i + 10] != b'},"spec":{':
+        return None
+    i += 10
+    node_name = None
+    if data.startswith(_FP_NODE, i):
+        i += len(_FP_NODE)
+        j = data.find(b'"', i)
+        node_name = data[i:j].decode()
+        if data[j : j + 2] != b'",':
+            return None
+        i = j + 2
+    if not data.startswith(_FP_SCHED, i):
+        return None
+    i += len(_FP_SCHED)
+    j = data.find(b'"', i)
+    scheduler_name = data[i:j]
+    if not data.startswith(_FP_CONTAINERS, j):
+        return None
+    i = j + len(_FP_CONTAINERS)
+    j = data.find(b'"', i)
+    cpu_b = data[i:j]
+    if not data.startswith(_FP_MEM, j):
+        return None
+    i = j + len(_FP_MEM)
+    j = data.find(b'"', i)
+    mem_b = data[i:j]
+    # The tail must be the EXACT remainder: proves there is no
+    # nodeSelector/tolerations/affinity/topologySpreadConstraints.
+    if data[j:] != _FP_TAIL:
+        if node_name is not None or not data.startswith(_FP_NODE_TAIL, j):
+            return None
+        i = j + len(_FP_NODE_TAIL)
+        j = data.find(b'"', i)
+        node_name = data[i:j].decode()
+        if data[j:] != _FP_STATUS:
+            return None
+    if not cpu_b.endswith(b"m") or not mem_b.endswith(b"Ki"):
+        return None
+    try:
+        cpu = int(cpu_b[:-1])
+        mem = int(mem_b[:-2])
+    except ValueError:
+        return None
+
+    pod = PodInfo(
+        name=name.decode(),
+        namespace=namespace.decode(),
+        labels=labels,
+        cpu_milli=cpu,
+        mem_kib=mem,
+        scheduler_name=scheduler_name.decode(),
+        node_name=node_name,
+    )
+    if tracker is not None:
+        ns = pod.namespace
+        pod.spread_incs = tracker.spread_matches(ns, labels)
+        pod.ipa_incs = tracker.affinity_matches(ns, labels)
+    return pod
 
 
 def decode_pod_obj(obj: dict, tracker: ConstraintTracker | None = None) -> PodInfo:

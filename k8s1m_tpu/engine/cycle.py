@@ -30,6 +30,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 from jax import lax
 
@@ -88,6 +89,27 @@ class CommitFields:
     ipa_own_valid: jax.Array  # pod's own required anti-affinity terms
     ipa_tid: jax.Array
     ipa_topo: jax.Array
+
+
+def commit_fields_np(fields: dict) -> CommitFields:
+    """CommitFields from a PackedPodBatch's host field dict (np arrays are
+    valid jit inputs; used on the rare CAS-rollback path)."""
+    return CommitFields(
+        cpu=fields["cpu"],
+        mem=fields["mem"],
+        valid=fields["valid"],
+        sinc_valid=fields["sinc_valid"],
+        sinc_cid=fields["sinc_cid"],
+        sinc_topo=fields["sinc_topo"],
+        iinc_valid=fields["iinc_valid"],
+        iinc_tid=fields["iinc_tid"],
+        iinc_topo=fields["iinc_topo"],
+        ipa_own_valid=fields["ipa_valid"]
+        & fields["ipa_required"]
+        & fields["ipa_anti"],
+        ipa_tid=fields["ipa_tid"],
+        ipa_topo=fields["ipa_topo"],
+    )
 
 
 def commit_fields_of(batch: PodBatch) -> CommitFields:
@@ -364,3 +386,109 @@ def schedule_batch(
     else:
         table, cons, asg = step(table, batch, key, constraints)
     return table, cons, asg
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_schedule_packed(
+    profile: Profile, chunk: int, k: int, with_constraints: bool,
+    backend: str, pod_spec, table_spec, groups: frozenset,
+    sample_rows: int | None,
+):
+    from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
+
+    def impl(table, ints, bools, key, offset, constraints):
+        batch = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
+        if sample_rows is None:
+            table, cons, asg = _schedule_batch_impl(
+                table, batch, key, constraints, profile, chunk, k, backend
+            )
+        else:
+            # percentageOfNodesToScore: filter+score only a rotating
+            # window of the node table (the reference's production
+            # config scores 5% of nodes per pod at 1M scale —
+            # terraform/tfvars percentageOfNodesToScore: 5,
+            # README.adoc:525-531); the bind commit still lands in the
+            # full table.  Candidate rows are remapped from window-local
+            # to global.
+            view = jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, offset, sample_rows, 0),
+                table,
+            )
+            if backend == "pallas":
+                from k8s1m_tpu.ops.pallas_topk import pallas_candidates
+
+                cand = pallas_candidates(
+                    view, batch, key, profile, chunk=chunk, k=k
+                )
+            else:
+                cand = filter_score_topk(
+                    view, batch, key, profile, chunk=chunk, k=k
+                )
+            cand = cand.replace(
+                idx=jnp.where(cand.idx >= 0, cand.idx + offset, -1)
+            )
+            table, cons, asg = finalize_batch(
+                table, constraints, cand, commit_fields_of(batch)
+            )
+        # One fetchable result array: the bound node row per pod, -1 for
+        # unbound.  Through a remote device relay every device_get is a
+        # round trip; the coordinator reads this single array per wave.
+        rows = jnp.where(asg.bound, asg.node_row, -1).astype(jnp.int32)
+        return table, cons, asg, rows
+
+    if with_constraints:
+        fn = lambda table, ints, bools, key, offset, constraints: impl(
+            table, ints, bools, key, offset, constraints
+        )
+    else:
+        fn = lambda table, ints, bools, key, offset: impl(
+            table, ints, bools, key, offset, None
+        )
+    return jax.jit(fn)
+
+
+def schedule_batch_packed(
+    table,
+    packed,
+    key: jax.Array,
+    *,
+    profile: Profile,
+    constraints: ConstraintState | None = None,
+    chunk: int = 16384,
+    k: int = 4,
+    backend: str = "xla",
+    sample_rows: int | None = None,
+    sample_offset: int = 0,
+):
+    """schedule_batch over a PackedPodBatch: the pod features cross the
+    host->device boundary as two buffers and the bind decision comes back
+    as one i32[B] row array (-1 = unbound) — 3 transfers per cycle total
+    instead of ~40, which is what the per-call cost of a remote device
+    relay demands.
+
+    ``sample_rows``/``sample_offset`` implement percentageOfNodesToScore:
+    only rows [offset, offset+sample_rows) are filtered+scored this cycle
+    (the caller rotates the offset).  The offset is a traced scalar — no
+    recompile per window.  Not supported with constraint state (spread /
+    inter-pod affinity need global domain statistics).
+
+    Returns (new_table, new_constraints, Assignment, rows).
+    """
+    if backend == "pallas":
+        from k8s1m_tpu.ops import pallas_topk
+
+        if constraints is not None or not pallas_topk.supports(profile):
+            raise ValueError(
+                "backend='pallas' requires the base profile and no "
+                "constraint state (see ops/pallas_topk.py)"
+            )
+    if sample_rows is not None and constraints is not None:
+        raise ValueError("node sampling requires constraints=None")
+    step = _jitted_schedule_packed(
+        profile, chunk, k, constraints is not None, backend,
+        packed.spec, packed.table_spec, packed.groups, sample_rows,
+    )
+    offset = np.int32(sample_offset)
+    if constraints is None:
+        return step(table, packed.ints, packed.bools, key, offset)
+    return step(table, packed.ints, packed.bools, key, offset, constraints)

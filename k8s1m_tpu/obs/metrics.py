@@ -149,6 +149,29 @@ class Histogram(Metric):
             self._sums[key] += v
             self._totals[key] += 1
 
+    def observe_many(self, values, **labels) -> None:
+        """Batch observe: one bucket pass and one lock acquisition for a
+        whole wave (the per-pod path is measurable at 10K+ binds/s)."""
+        if len(values) == 0:
+            return
+        import numpy as _np
+
+        v = _np.asarray(values, float)
+        idx = _np.searchsorted(self.buckets, v, side="left")
+        counts = _np.bincount(idx, minlength=len(self.buckets) + 1)
+        key = self._key(labels)
+        with self._lock:
+            if key not in self._counts:
+                self._counts[key] = [0] * (len(self.buckets) + 1)
+                self._sums[key] = 0.0
+                self._totals[key] = 0
+            c = self._counts[key]
+            for i, n in enumerate(counts):
+                if n:
+                    c[i] += int(n)
+            self._sums[key] += float(v.sum())
+            self._totals[key] += int(v.size)
+
     @contextmanager
     def time(self, **labels):
         t0 = time.perf_counter()

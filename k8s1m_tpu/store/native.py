@@ -24,6 +24,8 @@ _ERR_CAS = -1
 _ERR_COMPACTED = -2
 _ERR_FUTURE_REV = -3
 _ERR_NOT_FOUND = -4
+# Public: bind_batch result for "object not spliceable, use the slow path".
+BIND_INVALID = -5
 
 # etcd convention: range end of a single zero byte means "to infinity".
 INFINITY = b"\x00"
@@ -76,6 +78,11 @@ class WatchEvent:
 
 
 _KV_FIXED = struct.Struct("<IIqqqq")  # klen, vlen, create, mod, version, lease
+_U32 = struct.Struct("<I")
+_U32X2 = struct.Struct("<II")
+_PUT_REC = struct.Struct("<II")       # klen, vlen (0xFFFFFFFF = delete)
+_BIND_REC = struct.Struct("<qII")     # required_mod, klen, nlen
+_DELETE_MARKER = 0xFFFFFFFF
 
 
 def _parse_kv(buf: memoryview, off: int) -> tuple[KeyValue, int]:
@@ -129,6 +136,15 @@ def _load_lib():
     lib.ms_watch_dropped.argtypes = [c.c_void_p, c.c_int64]
     lib.ms_stats_json.restype = c.c_int
     lib.ms_stats_json.argtypes = [c.c_void_p, c.POINTER(P8), c.POINTER(c.c_size_t)]
+    lib.ms_put_batch.restype = c.c_int64
+    lib.ms_put_batch.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_size_t, c.c_int, c.c_int64,
+    ]
+    lib.ms_bind_batch.restype = c.c_int
+    lib.ms_bind_batch.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_size_t, c.c_int,
+        c.POINTER(c.POINTER(c.c_int64)),
+    ]
     lib.ms_wal_sync.restype = c.c_int
     lib.ms_wal_sync.argtypes = [c.c_void_p]
     return lib
@@ -190,6 +206,44 @@ class Watcher:
             )
         return events
 
+    def poll_light(
+        self, max_events: int = 1000, timeout_ms: int = 0
+    ) -> list[tuple[int, bytes, bytes, int]]:
+        """Like poll(), but returns ``(type, key, value, mod_revision)``
+        tuples (type 0=PUT, 1=DELETE) and skips prev-kv parsing — the
+        coordinator's firehose path, where per-event dataclass
+        construction is measurable at 100K events/s."""
+        lib = _lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        n = lib.ms_watch_poll(
+            self._store._h, self.id, max_events, timeout_ms,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if n == _ERR_NOT_FOUND:
+            self.canceled = True
+            return []
+        data = _take_buf(lib, out, out_len)
+        if data[4]:
+            self.canceled = True
+        (n_events,) = _U32.unpack_from(data, 0)
+        off = 5
+        events = []
+        unpack = _KV_FIXED.unpack_from
+        size = _KV_FIXED.size
+        for _ in range(n_events):
+            etype, has_prev = data[off], data[off + 1]
+            off += 2
+            klen, vlen, _crev, mrev, _ver, _lease = unpack(data, off)
+            off += size
+            key = data[off : off + klen]; off += klen
+            val = data[off : off + vlen]; off += vlen
+            if has_prev:
+                pklen, pvlen = _U32X2.unpack_from(data, off)
+                off += size + pklen + pvlen
+            events.append((etype, key, val, mrev))
+        return events
+
     @property
     def dropped(self) -> int:
         return _lib().ms_watch_dropped(self._store._h, self.id)
@@ -215,6 +269,29 @@ def drain_events(watcher, batch: int = 10000, limit: int = 200_000):
         evs = watcher.poll(batch)
         for ev in evs:
             yield ev
+        seen += len(evs)
+        if len(evs) < batch or seen >= limit:
+            return
+
+
+def drain_events_light(watcher, batch: int = 10000, limit: int = 200_000):
+    """drain_events, but yielding ``(type, key, value, mod_revision)``
+    tuples (type 0=PUT, 1=DELETE).  Uses the watcher's poll_light when it
+    has one; adapts full events otherwise (e.g. RemoteWatcher)."""
+    poll = getattr(watcher, "poll_light", None)
+    if poll is None:
+        for ev in drain_events(watcher, batch, limit):
+            yield (
+                0 if ev.type == "PUT" else 1,
+                ev.kv.key,
+                ev.kv.value,
+                ev.kv.mod_revision,
+            )
+        return
+    seen = 0
+    while True:
+        evs = poll(batch)
+        yield from evs
         seen += len(evs)
         if len(evs) < batch or seen >= limit:
             return
@@ -287,6 +364,54 @@ class MemStore:
         ok, rev, _ = self._set(key, value, False, False, 0, lease)
         assert ok
         return rev
+
+    def put_batch(
+        self,
+        items: list[tuple[bytes, bytes | None]],
+        lease: int = 0,
+    ) -> int:
+        """Apply a wave of puts/deletes (value None = delete) in one native
+        call under one lock acquisition; returns the last revision."""
+        parts = []
+        pack = _PUT_REC.pack
+        for key, value in items:
+            if value is None:
+                parts.append(pack(len(key), _DELETE_MARKER))
+                parts.append(key)
+            else:
+                parts.append(pack(len(key), len(value)))
+                parts.append(key)
+                parts.append(value)
+        buf = b"".join(parts)
+        rev = _lib().ms_put_batch(self._h, buf, len(buf), len(items), lease)
+        if rev < 0:
+            raise ValueError(f"ms_put_batch rc={rev}")
+        return rev
+
+    def bind_batch(
+        self, binds: list[tuple[bytes, int, bytes]]
+    ) -> list[int]:
+        """Splice spec.nodeName into stored pods under mod-revision CAS —
+        the whole bind wave in one native call.  ``binds`` entries are
+        (key, required_mod, node_name); returns per-entry new revision,
+        or _ERR_CAS / _ERR_INVALID (caller falls back to the slow path)."""
+        parts = []
+        pack = _BIND_REC.pack
+        for key, required_mod, name in binds:
+            parts.append(pack(required_mod, len(key), len(name)))
+            parts.append(key)
+            parts.append(name)
+        buf = b"".join(parts)
+        lib = _lib()
+        out = ctypes.POINTER(ctypes.c_int64)()
+        rc = lib.ms_bind_batch(
+            self._h, buf, len(buf), len(binds), ctypes.byref(out)
+        )
+        if rc < 0:
+            raise ValueError(f"ms_bind_batch rc={rc}")
+        results = out[: len(binds)]
+        lib.ms_free(out)
+        return results
 
     def delete(self, key: bytes) -> tuple[int, bool]:
         """Returns (revision, deleted). Revision is 0 when nothing existed."""

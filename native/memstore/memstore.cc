@@ -657,6 +657,128 @@ int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
   return rev;
 }
 
+int64_t ms_put_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
+                     int64_t lease) {
+  int64_t last = 0;
+  bool fsync_wait = false;
+  {
+    std::unique_lock<std::shared_mutex> g(s->mu);
+    size_t off = 0;
+    for (int i = 0; i < n; i++) {
+      if (off + 8 > len) return MS_ERR_INVALID;
+      uint32_t klen, vlen;
+      memcpy(&klen, buf + off, 4);
+      memcpy(&vlen, buf + off + 4, 4);
+      off += 8;
+      const bool is_del = vlen == kDeleteMarker;
+      const size_t vbytes = is_del ? 0 : vlen;
+      if (off + klen + vbytes > len) return MS_ERR_INVALID;
+      std::string key(reinterpret_cast<const char*>(buf + off), klen);
+      off += klen;
+      bool fw = false;
+      int64_t rev =
+          store_set_locked(s, key, is_del ? nullptr : buf + off, vbytes,
+                           is_del, 0, 0, 0, lease, nullptr, nullptr, nullptr,
+                           &fw);
+      off += vbytes;
+      if (rev > 0) last = rev;
+      fsync_wait |= fw;
+    }
+    if (last == 0) last = s->current;
+  }
+  if (fsync_wait) s->wal->WaitPersisted(last);
+  return last;
+}
+
+namespace {
+
+// Structural splice contract shared with the Python bind fast path
+// (k8s1m_tpu/control/coordinator.py splice_node_name): encode_pod always
+// opens spec with schedulerName, and this pattern cannot occur inside a
+// JSON string literal (the quotes would be escaped).
+constexpr char kSpecMark[] = "\"spec\":{\"schedulerName\":";
+constexpr size_t kSpecMarkLen = sizeof(kSpecMark) - 1;
+constexpr size_t kSpecCut = 8;  // len("\"spec\":{")
+
+bool json_plain(const uint8_t* p, size_t n) {
+  for (size_t i = 0; i < n; i++)
+    if (p[i] == '"' || p[i] == '\\' || p[i] < 0x20) return false;
+  return true;
+}
+
+}  // namespace
+
+int ms_bind_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
+                  int64_t** out) {
+  auto* results = static_cast<int64_t*>(malloc(sizeof(int64_t) * (n ? n : 1)));
+  int bound = 0;
+  int64_t last = 0;
+  bool fsync_wait = false;
+  {
+    std::unique_lock<std::shared_mutex> g(s->mu);
+    size_t off = 0;
+    std::string spliced;
+    for (int i = 0; i < n; i++) {
+      if (off + 16 > len) {
+        free(results);
+        return MS_ERR_INVALID;
+      }
+      int64_t req_mod;
+      uint32_t klen, nlen;
+      memcpy(&req_mod, buf + off, 8);
+      memcpy(&klen, buf + off + 8, 4);
+      memcpy(&nlen, buf + off + 12, 4);
+      off += 16;
+      if (off + klen + nlen > len) {
+        free(results);
+        return MS_ERR_INVALID;
+      }
+      std::string key(reinterpret_cast<const char*>(buf + off), klen);
+      off += klen;
+      const uint8_t* name = buf + off;
+      off += nlen;
+
+      auto it = s->by_key.find(key);
+      if (it == s->by_key.end() || !it->second->present ||
+          it->second->mod_rev != req_mod) {
+        results[i] = MS_ERR_CAS;
+        continue;
+      }
+      const std::string& val = *it->second->latest;
+      size_t idx = val.find(kSpecMark);
+      if (idx == std::string::npos ||
+          val.find("\"nodeName\"") != std::string::npos ||
+          !json_plain(name, nlen)) {
+        results[i] = MS_ERR_INVALID;
+        continue;
+      }
+      const size_t cut = idx + kSpecCut;
+      spliced.clear();
+      spliced.reserve(val.size() + nlen + 14);
+      spliced.append(val, 0, cut);
+      spliced.append("\"nodeName\":\"");
+      spliced.append(reinterpret_cast<const char*>(name), nlen);
+      spliced.append("\",");
+      spliced.append(val, cut, std::string::npos);
+
+      bool fw = false;
+      int64_t rev = store_set_locked(
+          s, key, reinterpret_cast<const uint8_t*>(spliced.data()),
+          spliced.size(), false, 1, 0, req_mod, it->second->lease, nullptr,
+          nullptr, nullptr, &fw);
+      results[i] = rev;
+      if (rev > 0) {
+        bound++;
+        last = rev;
+      }
+      fsync_wait |= fw;
+    }
+  }
+  if (fsync_wait && last > 0) s->wal->WaitPersisted(last);
+  *out = results;
+  return bound;
+}
+
 // ---- range ----------------------------------------------------------------
 
 namespace {

@@ -84,6 +84,37 @@ int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
 
 /* In fsync mode, ms_set returns only after the record is durable. */
 
+/* Batch write: n records packed as
+ *   u32 klen | u32 vlen | key bytes | val bytes
+ * with vlen == 0xFFFFFFFF marking a delete.  The whole batch executes
+ * under one lock acquisition and one FFI crossing — the amortization the
+ * reference gets from gRPC stream batching + per-core WAL writers
+ * (reference wal.rs:173-248).  Returns the last allocated revision (or
+ * the current revision if the batch allocated none), MS_ERR_INVALID on a
+ * malformed buffer.  In fsync mode, returns after the batch is durable. */
+int64_t ms_put_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
+                     int64_t lease);
+
+/* Batch bind: splice spec.nodeName into stored pod objects under CAS.
+ *
+ * n records packed as:
+ *   i64 required_mod | u32 klen | u32 nlen | key bytes | node name bytes
+ *
+ * For each record, if the key's latest mod_revision == required_mod and
+ * the stored value is in the canonical encoded-pod shape (opens with
+ * "spec":{"schedulerName": and contains no "nodeName"), the store writes
+ * a new value with "nodeName":"<name>" spliced after "spec":{ — the
+ * DefaultBinder's optimistic-concurrency bind collapsed to one native
+ * call per wave (reference README.adoc:558-560 semantics).
+ *
+ * *out is a malloc'd array of n int64 results: new revision (> 0),
+ * MS_ERR_CAS (revision mismatch / key absent), or MS_ERR_INVALID (value
+ * not spliceable or name needs JSON escaping — caller falls back to its
+ * slow path).  Returns the number of successful binds, or MS_ERR_INVALID
+ * on a malformed buffer. */
+int ms_bind_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
+                  int64_t** out);
+
 /* ---- reads ------------------------------------------------------------ */
 
 /* KV record layout inside result buffers (all little-endian):

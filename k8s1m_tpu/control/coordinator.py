@@ -103,6 +103,13 @@ _BIND_LATENCY = Histogram(
     "coordinator_schedule_to_bind_seconds",
     "Intake-to-bind latency per pod",
     (),
+    # Finer than the default pow2 ladder in the SLO range: the default's
+    # 164ms -> 328ms jump makes a ~170ms p50 report as 328.
+    buckets=(
+        0.005, 0.01, 0.02, 0.03, 0.04, 0.05, 0.065, 0.08, 0.1, 0.13,
+        0.165, 0.2, 0.25, 0.33, 0.42, 0.55, 0.7, 0.9, 1.2, 1.6, 2.1,
+        2.8, 3.7, 5.0, 8.0, 15.0, 30.0, 60.0,
+    ),
 )
 
 
@@ -160,6 +167,8 @@ class Coordinator:
         flight_recorder: FlightRecorder | None = None,
         backend: str = "xla",
         pipeline: bool = False,
+        depth: int = 2,
+        adaptive_batch: bool = False,
         watch_queue_cap: int = DEEP_WATCH_QUEUE,
         score_pct: int = 100,
     ):
@@ -174,8 +183,11 @@ class Coordinator:
         self.flight = flight_recorder
         self.backend = backend
         self.pipeline = pipeline
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.depth = depth
         self.watch_queue_cap = watch_queue_cap
-        self._inflight = None
+        self._inflights: list = []
         # percentageOfNodesToScore (the reference's production config
         # scores 5% of nodes per pod at 1M scale, README.adoc:525-531;
         # terraform tfvars percentageOfNodesToScore: 5).  Each cycle
@@ -196,6 +208,16 @@ class Coordinator:
         self.host = NodeTableHost(table_spec)
         self.tracker = ConstraintTracker(table_spec)
         self.encoder = PodBatchHost(pod_spec, table_spec, self.host.vocab)
+        # Adaptive batch buckets: a shallow queue schedules in a smaller
+        # power-of-two batch instead of waiting out a full wave's worth
+        # of padding — the lever that keeps p50 schedule-to-bind low at
+        # light load while deep queues still ride the big batch.  Each
+        # bucket is its own compiled executable, so this is opt-in: warm
+        # EVERY bucket before a latency-sensitive window or a mid-run
+        # compile (tens of seconds on TPU) lands in the tail.
+        self.adaptive_batch = adaptive_batch
+        self.min_batch = min(256, pod_spec.batch)
+        self._encoders = {pod_spec.batch: self.encoder}
         self.table = None           # device NodeTable, built lazily
         self.constraints = (
             empty_constraints(table_spec) if with_constraints else None
@@ -552,6 +574,25 @@ class Coordinator:
                 )
             )
 
+    def _encoder_for(self, n: int) -> PodBatchHost:
+        """Smallest power-of-two batch bucket holding n pods (clamped to
+        pod_spec.batch, which need not be a power of two)."""
+        if not self.adaptive_batch:
+            return self.encoder
+        b = self.min_batch
+        while b < n:
+            b <<= 1
+        if b > self.pod_spec.batch:
+            return self.encoder
+        enc = self._encoders.get(b)
+        if enc is None:
+            enc = PodBatchHost(
+                dataclasses.replace(self.pod_spec, batch=b),
+                self.table_spec, self.host.vocab,
+            )
+            self._encoders[b] = enc
+        return enc
+
     def _take_batch(self):
         """Pop and encode up to one batch of pending pods; (None, None)
         when the queue is empty."""
@@ -563,7 +604,9 @@ class Coordinator:
         for p in batch_pods:
             self._queued_keys.discard(p.pod.key)
         with _CYCLE_TIME.time(stage="encode"):
-            batch = self.encoder.encode_packed([p.pod for p in batch_pods])
+            batch = self._encoder_for(len(batch_pods)).encode_packed(
+                [p.pod for p in batch_pods]
+            )
         return batch_pods, batch
 
     def _next_window(self) -> int:
@@ -592,6 +635,14 @@ class Coordinator:
                     self._next_window() if self._sample_rows else 0
                 ),
             )
+        # Start the device->host copy of the bind decision now: by the
+        # time _complete runs (a drain + encode later), the bytes are
+        # already on the host and device_get returns without paying the
+        # relay round trip.
+        try:
+            rows_dev.copy_to_host_async()
+        except Exception:
+            pass
         return (batch_pods, batch, asg, rows_dev, t_start)
 
     def _dispatch(self):
@@ -618,7 +669,7 @@ class Coordinator:
             node_row = jax.device_get(rows_dev)
 
         nbound = 0
-        failed = np.zeros(self.pod_spec.batch, bool)
+        failed = np.zeros(batch.batch, bool)
         bind_batch = getattr(self.store, "bind_batch", None)
         host = self.host
         with _CYCLE_TIME.time(stage="bind"):
@@ -734,23 +785,21 @@ class Coordinator:
         if not self.pipeline:
             disp = self._dispatch()
             return self._complete(disp) if disp is not None else 0
-        # Pipelined: run this cycle's host-heavy pod intake (drain +
-        # encode) BEFORE syncing the in-flight batch, so the device
-        # computes the previous wave while the host decodes this one.
-        # Ordering constraints:
-        #  - node events (and resync) mutate the row->node mapping, so
-        #    they apply only AFTER the in-flight wave — whose bind rows
-        #    were chosen against the old mapping — has retired;
+        # Pipelined: up to ``depth`` waves in flight, so each wave's
+        # device compute AND its result-fetch round trip overlap the host
+        # work of later cycles (through a remote device relay the fetch
+        # RTT alone is tens of ms).  Ordering constraints:
+        #  - node events, resync, and dirty-row uploads mutate the
+        #    row->node mapping or overwrite device rows, so they apply
+        #    only at a QUIESCE point — every launched wave retired;
         #  - pod events touch capacity accounting only and are safe to
-        #    drain while the wave is in flight;
+        #    drain while waves are in flight;
         #  - _complete lands its bind accounting (and CAS-rollback dirty
         #    rows) in the host mirror before _sync_table re-uploads rows
         #    for the next launch.
         done = 0
         if self._nodes_watch.dropped or self._pods_watch.dropped:
-            if self._inflight is not None:
-                prev, self._inflight = self._inflight, None
-                done += self._complete(prev)
+            done += self.flush()
             log.warning(
                 "watch overflow (nodes dropped=%d pods dropped=%d); resyncing",
                 self._nodes_watch.dropped, self._pods_watch.dropped,
@@ -759,20 +808,35 @@ class Coordinator:
         self._drain_external()
         self._drain_pod_events()
         batch_pods, batch = self._take_batch()
-        if self._inflight is not None:
-            prev, self._inflight = self._inflight, None
-            done += self._complete(prev)
-        self._drain_node_events()
-        self._sync_table()
-        self._process_adjusts()
+        if len(self._inflights) >= (self.depth if batch_pods else 1):
+            done += self._complete(self._inflights.pop(0))
+        if self._inflights and (
+            self._dirty_rows or self._pending_adjusts or self._nodes_pending()
+        ):
+            # Something needs the quiesced table (node delta, CAS
+            # rollback, constraint correction): retire the pipeline now.
+            done += self.flush()
+        if not self._inflights:
+            self._drain_node_events()
+            self._sync_table()
+            self._process_adjusts()
         if batch_pods is not None:
-            self._inflight = self._launch(batch_pods, batch)
+            self._inflights.append(self._launch(batch_pods, batch))
         return done
 
     def flush(self) -> int:
-        """Retire any in-flight pipelined batch."""
-        prev, self._inflight = self._inflight, None
-        return self._complete(prev) if prev is not None else 0
+        """Retire every in-flight pipelined batch."""
+        done = 0
+        while self._inflights:
+            done += self._complete(self._inflights.pop(0))
+        return done
+
+    def _nodes_pending(self) -> int:
+        """Queued node events (forces a pipeline quiesce so they apply).
+        Watchers without a cheap pending probe report 1 — the pipeline
+        then quiesces every cycle, trading depth for safety."""
+        p = getattr(self._nodes_watch, "pending", None)
+        return 1 if p is None else p
 
     def _bind(self, p: PendingPod, node_name: str) -> bool:
         """CAS spec.nodeName into the pod object; False on conflict."""
@@ -869,7 +933,7 @@ class Coordinator:
         for _ in range(max_cycles):
             n = self.step()
             total += n
-            if not self.queue and self._inflight is None:
+            if not self.queue and not self._inflights:
                 idle += 1
                 if idle > 1 and self.drain_watches() == 0 and not self._external:
                     break

@@ -149,6 +149,13 @@ class Histogram(Metric):
             self._sums[key] += v
             self._totals[key] += 1
 
+    def reset(self) -> None:
+        """Drop all recorded samples (benchmark windows only)."""
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+
     def observe_many(self, values, **labels) -> None:
         """Batch observe: one bucket pass and one lock acquisition for a
         whole wave (the per-pod path is measurable at 10K+ binds/s)."""

@@ -134,6 +134,8 @@ def _load_lib():
     ]
     lib.ms_watch_dropped.restype = c.c_int64
     lib.ms_watch_dropped.argtypes = [c.c_void_p, c.c_int64]
+    lib.ms_watch_pending.restype = c.c_int64
+    lib.ms_watch_pending.argtypes = [c.c_void_p, c.c_int64]
     lib.ms_stats_json.restype = c.c_int
     lib.ms_stats_json.argtypes = [c.c_void_p, c.POINTER(P8), c.POINTER(c.c_size_t)]
     lib.ms_put_batch.restype = c.c_int64
@@ -247,6 +249,11 @@ class Watcher:
     @property
     def dropped(self) -> int:
         return _lib().ms_watch_dropped(self._store._h, self.id)
+
+    @property
+    def pending(self) -> int:
+        """Queued-event count, without consuming anything."""
+        return max(0, _lib().ms_watch_pending(self._store._h, self.id))
 
     def cancel(self) -> None:
         if not self.canceled:

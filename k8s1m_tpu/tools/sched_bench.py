@@ -41,6 +41,12 @@ def parse_args(argv=None):
     ap.add_argument("--target", default=None,
                     help="remote store addr (default: in-process store)")
     ap.add_argument(
+        "--rate", type=int, default=0,
+        help="offered load in pods/s (paced producer + adaptive batch "
+        "buckets; reports p50/p95/p99 schedule-to-bind latency).  0 = "
+        "max-throughput fill",
+    )
+    ap.add_argument(
         "--score-pct", type=int, default=100,
         help="percentageOfNodesToScore (the reference's 1M-node production "
         "config uses 5, terraform tfvars percentageOfNodesToScore: 5)",
@@ -52,7 +58,10 @@ def parse_args(argv=None):
         "ago while new waves arrive — sustained create+delete churn "
         "instead of a fill-up",
     )
-    return ap.parse_args(argv)
+    args = ap.parse_args(argv)
+    if args.rate and args.churn:
+        ap.error("--churn is not implemented for the paced --rate mode")
+    return args
 
 
 def main(argv=None):
@@ -90,7 +99,7 @@ def main(argv=None):
         store, TableSpec(max_nodes=cap), PodSpec(batch=args.batch),
         profile, chunk=args.chunk, with_constraints=False,
         backend=args.backend, pipeline=not args.no_pipeline,
-        score_pct=args.score_pct,
+        score_pct=args.score_pct, adaptive_batch=bool(args.rate),
     )
     t0 = time.perf_counter()
     coord.bootstrap()
@@ -124,6 +133,70 @@ def main(argv=None):
     # burst-arrival reason, README.adoc:684-695).  Interleaved, not
     # threaded: on a single-core host a producer thread only adds GIL
     # contention and queue backlog.
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    if args.rate:
+        # Warm the adaptive buckets the paced run will actually use
+        # (each bucket is its own compiled executable).
+        # Every bucket must be compiled up front: a mid-run compile stall
+        # (tens of seconds) while the queue is growing destroys the tail.
+        b = coord.min_batch
+        warm = {coord.pod_spec.batch}   # overload bucket (may be non-pow2)
+        while b <= coord.pod_spec.batch:
+            warm.add(b)
+            b <<= 1
+        woff = 0
+        for b in sorted(warm):
+            ks = [pod_key("warm2", f"r-{woff+i}") for i in range(b)]
+            vs = [encode_pod(PodInfo(f"r-{woff+i}", cpu_milli=1, mem_kib=1))
+                  for i in range(b)]
+            woff += b
+            if put_batch is not None:
+                put_batch(list(zip(ks, vs)))
+            else:
+                for kk, vv in zip(ks, vs):
+                    store.put(kk, vv)
+            coord.run_until_idle()
+        REGISTRY.get("coordinator_schedule_to_bind_seconds").reset()
+
+        # Paced producer: emit pods on the offered-load schedule, step
+        # the coordinator continuously, measure intake-to-bind latency.
+        t0 = time.perf_counter()
+        bound = 0
+        emitted = 1
+        while emitted < args.pods or coord.queue or coord._inflights:
+            due = min(args.pods, 1 + int(args.rate * (time.perf_counter() - t0)))
+            if due > emitted:
+                if put_batch is not None:
+                    put_batch(list(zip(keys[emitted:due], values[emitted:due])))
+                else:
+                    for k, v in zip(keys[emitted:due], values[emitted:due]):
+                        store.put(k, v)
+                emitted = due
+            bound += coord.step()
+            if emitted >= args.pods and not coord.queue and not coord._inflights:
+                bound += coord.run_until_idle()
+                break
+        sched_s = time.perf_counter() - t0
+        lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
+        e2e = bound / sched_s if sched_s else 0.0
+        print(json.dumps({
+            "metric": f"e2e_p50_bind_ms_{args.nodes}_nodes_at_{args.rate}",
+            "value": round(lat.quantile(0.5) * 1e3, 2),
+            "unit": "ms",
+            "vs_baseline": None,
+            "detail": {
+                "rate": args.rate,
+                "score_pct": args.score_pct,
+                "binds_per_sec": round(e2e, 1),
+                "bound": bound,
+                "p50_ms": round(lat.quantile(0.5) * 1e3, 2),
+                "p95_ms": round(lat.quantile(0.95) * 1e3, 2),
+                "p99_ms": round(lat.quantile(0.99) * 1e3, 2),
+            },
+        }))
+        return
+
     wave = args.batch
     t0 = time.perf_counter()
     bound = 0
@@ -152,8 +225,6 @@ def main(argv=None):
     sched_s = time.perf_counter() - t0
     create_s = sched_s  # creation is inside the measured window
     e2e = bound / sched_s if sched_s else 0.0
-
-    from k8s1m_tpu.obs.metrics import REGISTRY
 
     lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
     p50_ms = round(lat.quantile(0.5) * 1e3, 2) if lat else None

@@ -1062,6 +1062,14 @@ int64_t ms_watch_dropped(ms_store* s, int64_t watcher_id) {
   return it->second->dropped;
 }
 
+int64_t ms_watch_pending(ms_store* s, int64_t watcher_id) {
+  std::shared_lock<std::shared_mutex> g(s->mu);
+  auto it = s->watchers.find(watcher_id);
+  if (it == s->watchers.end()) return MS_ERR_NOT_FOUND;
+  std::lock_guard<std::mutex> g2(it->second->m);
+  return static_cast<int64_t>(it->second->q.size());
+}
+
 // ---- stats / maintenance --------------------------------------------------
 
 int64_t ms_num_keys(ms_store* s) {

@@ -179,6 +179,9 @@ int ms_watch_poll(ms_store* s, int64_t watcher_id, int max_events,
  * watchers. */
 int64_t ms_watch_dropped(ms_store* s, int64_t watcher_id);
 
+/* Events currently queued on the watcher (without consuming them). */
+int64_t ms_watch_pending(ms_store* s, int64_t watcher_id);
+
 /* ---- stats / maintenance --------------------------------------------- */
 
 /* Total live keys. */

@@ -75,12 +75,16 @@ class KubeletPool:
         # Pods mid-startup: key -> (stage index, object dict, mod rev).
         self._starting: dict[str, tuple[int, dict, int]] = {}
         self.running_pods: set[str] = set()
+        # Last observed mod revision per node — the status heartbeat is a
+        # CAS against it so it can never clobber a concurrent external
+        # update (e.g. a label move) made after this tick's watch drain.
+        self._node_mod: dict[str, int] = {}
 
     def bootstrap(self, now: float = 0.0) -> None:
         res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
         for kv in res.kvs:
             name = kv.key[len(NODES_PREFIX):].decode()
-            self.adopt(name, kv.value, now)
+            self.adopt(name, kv.value, now, mod_revision=kv.mod_revision)
         self._nodes_watch = self.store.watch(
             NODES_PREFIX, prefix_end(NODES_PREFIX),
             start_revision=res.revision + 1, queue_cap=1 << 20,
@@ -93,8 +97,11 @@ class KubeletPool:
             start_revision=pods.revision + 1, queue_cap=1 << 20,
         )
 
-    def adopt(self, name: str, obj_bytes: bytes, now: float) -> None:
+    def adopt(
+        self, name: str, obj_bytes: bytes, now: float, *, mod_revision: int = 0
+    ) -> None:
         self.nodes[name] = obj_bytes
+        self._node_mod[name] = mod_revision
         stagger = (zlib.crc32(name.encode()) % 1000) / 1000.0
         self._next_renewal[name] = now + stagger * self.renew_interval_s
         self._next_status[name] = now + stagger * self.status_interval_s
@@ -201,12 +208,16 @@ class KubeletPool:
             if e.type == "PUT":
                 if name in self.nodes:
                     self.nodes[name] = e.kv.value  # track latest object
+                    self._node_mod[name] = e.kv.mod_revision
                 else:
-                    self.adopt(name, e.kv.value, now)
+                    self.adopt(
+                        name, e.kv.value, now, mod_revision=e.kv.mod_revision
+                    )
             else:
                 # Node deleted: stop heartbeating — re-PUTting the
                 # stale object would resurrect a removed node.
                 self.nodes.pop(name, None)
+                self._node_mod.pop(name, None)
                 self._next_renewal.pop(name, None)
                 self._next_status.pop(name, None)
                 self.store.delete(lease_key(LEASE_NS, name))
@@ -242,11 +253,28 @@ class KubeletPool:
                 renewed += 1
         for name, due in self._next_status.items():
             if due <= now:
-                # Full Node object heartbeat — the write kwok skips.
-                self.store.put(node_key(name), self.nodes[name])
-                _WRITES.inc(kind="node_status")
+                # Full Node object heartbeat — the write kwok skips.  CAS
+                # on the observed revision: a conflict means an external
+                # writer updated the node after our last watch drain, so
+                # the heartbeat is skipped and the newer object arrives
+                # via watch (like _advance_pod's rebase for pod status).
+                ok, rev, cur = self.store.cas(
+                    node_key(name), self.nodes[name],
+                    required_mod=self._node_mod.get(name, 0),
+                )
+                if ok:
+                    self._node_mod[name] = rev
+                    _WRITES.inc(kind="node_status")
+                    statuses += 1
+                else:
+                    # Rebase from the conflicting KV the CAS already
+                    # returned, so the next heartbeat carries the
+                    # external change (no extra read round trip).
+                    _WRITES.inc(kind="node_status_conflict")
+                    if cur is not None:
+                        self.nodes[name] = cur.value
+                        self._node_mod[name] = cur.mod_revision
                 self._next_status[name] = now + self.status_interval_s
-                statuses += 1
 
         # Advance every mid-startup pod one stage per tick.
         for key in list(self._starting):

@@ -17,14 +17,21 @@ reproduced as a histogram of (actual - scheduled) renewal delay.
 from __future__ import annotations
 
 import json
+import logging
 import zlib
 
 from k8s1m_tpu.control.objects import lease_key, pod_key
 from k8s1m_tpu.obs.metrics import Counter, Histogram
 from k8s1m_tpu.store.native import MemStore, drain_events, prefix_end
 
+log = logging.getLogger("k8s1m.kwok")
+
 NODES_PREFIX = b"/registry/minions/"
 PODS_PREFIX = b"/registry/pods/"
+# Cap on pods parked waiting for an unseen node, and how long a node may
+# stay unseen before its parked pods become evictable (see tick()).
+MAX_WAITING_PODS = 10_000
+WAITING_GRACE_S = 60.0
 LEASE_NS = "kube-node-lease"
 
 _LEASE_RENEWALS = Counter(
@@ -65,6 +72,8 @@ class KwokController:
         # applied yet (node and pod watches are separate queues, so a bind
         # can be seen before its node) — parked per node, started on adopt.
         self._waiting: dict[str, dict[str, tuple[bytes, int]]] = {}
+        # First tick time each unseen node started parking pods.
+        self._waiting_since: dict[str, float] = {}
         # Nodes known to belong to other groups.  The controller already
         # lists+watches ALL nodes (it must, to discover label moves), so
         # ownership is answered locally instead of with a store round trip
@@ -94,7 +103,7 @@ class KwokController:
         )
         pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
         for kv in pods.kvs:
-            self._maybe_start_pod(kv.value, kv.mod_revision)
+            self._maybe_start_pod(kv.value, kv.mod_revision, now)
         self._pods_watch = self.store.watch(
             PODS_PREFIX, prefix_end(PODS_PREFIX),
             start_revision=pods.revision + 1, queue_cap=1 << 20,
@@ -109,12 +118,15 @@ class KwokController:
         offset = (zlib.crc32(name.encode()) % 1000) / 1000.0 * self.renew_interval_s
         self._next_renewal[name] = now + offset
         self._foreign.discard(name)
+        self._waiting_since.pop(name, None)
         for data, mod in self._waiting.pop(name, {}).values():
-            self._maybe_start_pod(data, mod)
+            self._maybe_start_pod(data, mod, now)
 
     # ---- pod lifecycle -------------------------------------------------
 
-    def _maybe_start_pod(self, data: bytes, mod_revision: int) -> None:
+    def _maybe_start_pod(
+        self, data: bytes, mod_revision: int, now: float = 0.0
+    ) -> None:
         obj = json.loads(data)
         node = obj.get("spec", {}).get("nodeName")
         if not node:
@@ -138,6 +150,7 @@ class KwokController:
             pk = (f"{obj['metadata'].get('namespace', 'default')}/"
                   f"{obj['metadata']['name']}")
             self._waiting.setdefault(node, {})[pk] = (data, mod_revision)
+            self._waiting_since.setdefault(node, now)
             return
         key = pod_key(obj["metadata"].get("namespace", "default"),
                       obj["metadata"]["name"])
@@ -178,13 +191,13 @@ class KwokController:
             self.nodes.clear()
             self._next_renewal.clear()
             self._waiting.clear()
+            self._waiting_since.clear()
             self._foreign.clear()
             self.running_pods.clear()
             try:
                 self.bootstrap(now)
             except Exception:
-                import logging
-                logging.getLogger("k8s1m.kwok").warning(
+                log.warning(
                     "resync relist failed; retrying next tick", exc_info=True
                 )
                 return {"renewed": 0, "started": 0, "nodes": 0}
@@ -201,18 +214,37 @@ class KwokController:
                         self._drop(name)
                     self._foreign.add(name)
                     self._waiting.pop(name, None)
+        self._waiting_since.pop(name, None)
+                    self._waiting_since.pop(name, None)
             else:
                 self._foreign.discard(name)
                 if name in self.nodes:
                     self._drop(name)
         for ev in drain_events(self._pods_watch):
             if ev.type == "PUT":
-                self._maybe_start_pod(ev.kv.value, ev.kv.mod_revision)
+                self._maybe_start_pod(ev.kv.value, ev.kv.mod_revision, now)
             else:
                 key = ev.kv.key[len(PODS_PREFIX):].decode()
                 self.running_pods.discard(key)
                 for waiting in self._waiting.values():
                     waiting.pop(key, None)
+        # Bound the parking lot: pods bound to a node name that never
+        # appears (typo'd / external writer) would otherwise be retained
+        # forever.  Node and pod watches are separate streams, so a large
+        # bind wave can legitimately park >cap pods for a tick or two —
+        # eviction therefore requires BOTH pressure (total over the cap)
+        # and age (the node stayed unseen past a grace period).
+        if sum(len(w) for w in self._waiting.values()) > MAX_WAITING_PODS:
+            for node in list(self._waiting):
+                since = self._waiting_since.get(node, now)
+                if now - since < WAITING_GRACE_S:
+                    continue
+                dropped = self._waiting.pop(node)
+                self._waiting_since.pop(node, None)
+                log.warning(
+                    "evicting %d pod(s) parked %.0fs on never-seen node %r",
+                    len(dropped), now - since, node,
+                )
 
         for name, due in self._next_renewal.items():
             if due <= now:
@@ -238,6 +270,7 @@ class KwokController:
         self.nodes.discard(name)
         self._next_renewal.pop(name, None)
         self._waiting.pop(name, None)
+        self._waiting_since.pop(name, None)
         self.store.delete(lease_key(LEASE_NS, name))
 
     def _renew_lease(self, name: str, now: float) -> None:

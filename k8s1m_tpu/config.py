@@ -64,6 +64,9 @@ NONE_ID = 0
 # ignores every pod whose schedulerName differs, so a drifted copy would
 # silently schedule nothing.
 DEFAULT_SCHEDULER = "dist-scheduler"
+# What Kubernetes assigns when spec.schedulerName is unset; such pods
+# belong to the stock scheduler, never to this framework's intake.
+K8S_DEFAULT_SCHEDULER = "default-scheduler"
 
 # Taint / toleration effects (reference mem of upstream v1.Taint effects).
 EFFECT_NONE = 0                # toleration with no effect: matches all

@@ -21,7 +21,7 @@ from __future__ import annotations
 import json
 
 from k8s1m_tpu.config import (
-    DEFAULT_SCHEDULER,
+    K8S_DEFAULT_SCHEDULER,
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
     EFFECT_NONE,
@@ -433,7 +433,11 @@ def decode_pod_obj(obj: dict, tracker: ConstraintTracker | None = None) -> PodIn
         labels=labels,
         cpu_milli=cpu,
         mem_kib=mem,
-        scheduler_name=spec.get("schedulerName", DEFAULT_SCHEDULER),
+        # Kubernetes semantics: an unset schedulerName belongs to
+        # "default-scheduler", NOT to this framework's scheduler — the
+        # reference's intake filter only claims explicitly-marked pods
+        # (webhook.go:102-125).
+        scheduler_name=spec.get("schedulerName", K8S_DEFAULT_SCHEDULER),
         node_name=spec.get("nodeName"),
         node_selector=dict(spec.get("nodeSelector", {})),
         tolerations=[

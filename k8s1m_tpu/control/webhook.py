@@ -101,8 +101,11 @@ class WebhookServer:
                 spec = obj.get("spec", {})
                 if (
                     obj.get("kind") == "Pod"
-                    and spec.get("schedulerName", DEFAULT_SCHEDULER)
-                    == outer.scheduler_name
+                    # Unset schedulerName = "default-scheduler" (upstream
+                    # semantics): only explicitly-marked pods are claimed,
+                    # matching the reference's intake filter
+                    # (webhook.go:102-125) and decode_pod_obj.
+                    and spec.get("schedulerName") == outer.scheduler_name
                     and not spec.get("nodeName")
                 ):
                     _REQUESTS.inc(outcome="enqueued")

@@ -65,7 +65,11 @@ class KeyValue:
 @dataclasses.dataclass(frozen=True)
 class RangeResult:
     revision: int       # store revision at read time
-    count: int          # total matches ignoring limit
+    # Total matches when limit=0 (or count_only); with limit>0 the scan
+    # stops one element past the limit, so count is approximate (at most
+    # limit+1 — proof of `more`, not a total).  etcd permits this and
+    # Kubernetes tolerates it (reference README.adoc:326-328).
+    count: int
     more: bool
     kvs: list[KeyValue]
 

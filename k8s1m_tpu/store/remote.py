@@ -56,9 +56,16 @@ class RemoteWatcher:
     ``poll`` is non-blocking like the native watcher's.
     """
 
-    def __init__(self, store: "RemoteStore", key, end, start_revision, prev_kv):
+    def __init__(
+        self, store: "RemoteStore", key, end, start_revision, prev_kv,
+        queue_cap: int = 0,
+    ):
         self._events: collections.deque = collections.deque()
         self._lock = threading.Lock()
+        # Client-side cap mirroring the native watcher's bounded queue: a
+        # consumer that stops draining sees dropped>0 and resyncs, instead
+        # of the backlog growing without bound.
+        self._queue_cap = queue_cap if queue_cap > 0 else 10_000
         self._dropped = 0
         self.canceled = False
         # The request side must stay open for the watch's lifetime — a
@@ -111,6 +118,9 @@ class RemoteWatcher:
                     continue
                 with self._lock:
                     for ev in resp.events:
+                        if len(self._events) >= self._queue_cap:
+                            self._dropped += 1
+                            continue
                         kind = (
                             "DELETE"
                             if ev.type == mvcc_pb2.Event.DELETE
@@ -310,10 +320,14 @@ class RemoteStore:
         prev_kv: bool = False,
         queue_cap: int = 0,
     ) -> RemoteWatcher:
-        """``queue_cap`` is accepted for MemStore-surface compatibility but
-        unused: the wire watcher's server side drains continuously into
-        the stream, and the client side buffers in an unbounded deque."""
-        return RemoteWatcher(self, start, end, start_revision, prev_kv)
+        """``queue_cap`` bounds the CLIENT-side buffer (default 10K like
+        the native watcher): the server drains continuously into the
+        stream, so overflow protection has to live where the backlog
+        accumulates.  On overflow ``dropped`` goes positive and the owner
+        resyncs, the same contract as a native-watcher overflow."""
+        return RemoteWatcher(
+            self, start, end, start_revision, prev_kv, queue_cap
+        )
 
     # ---- maintenance ---------------------------------------------------
 

@@ -111,3 +111,21 @@ def test_pod_deleted_mid_startup(store):
     pool.tick(3.0)
     assert "default/p0" not in pool._starting
     assert "default/p0" not in pool.running_pods
+
+
+def test_node_heartbeat_never_clobbers_external_update(store):
+    """The node-status heartbeat is a CAS on the observed revision: an
+    external label move landing after the watch drain must survive."""
+    pool = setup_pool(store)
+    pool.tick(1.0)
+    # External writer moves a label AFTER the pool's last watch drain.
+    kv = store.get(node_key("n0"))
+    obj = json.loads(kv.value)
+    obj["metadata"].setdefault("labels", {})["moved"] = "yes"
+    store.put(node_key("n0"), json.dumps(obj, separators=(",", ":")).encode())
+    # Next heartbeat CAS conflicts, rebases; following one succeeds on
+    # the fresh object.
+    pool.tick(100.0)
+    pool.tick(200.0)
+    final = json.loads(store.get(node_key("n0")).value)
+    assert final["metadata"]["labels"]["moved"] == "yes"

@@ -249,3 +249,17 @@ def test_webhook_pod_never_persisted_is_dropped(store):
     # The slow write finally lands -> watch intake picks it up.
     store.put(pod_key("default", "ghost"), encode_pod(PodInfo("ghost")))
     assert coord.run_until_idle() == 1
+
+
+def test_webhook_unset_scheduler_name_belongs_to_default_scheduler(store):
+    """Kubernetes semantics: pods with no spec.schedulerName belong to
+    'default-scheduler' and must NOT be claimed by the intake."""
+    got = []
+    srv = WebhookServer(got.append).start()
+    try:
+        pod = json.loads(encode_pod(PodInfo("web-noname")))
+        del pod["spec"]["schedulerName"]
+        assert post_review(srv.port, pod)["response"]["allowed"] is True
+    finally:
+        srv.stop()
+    assert got == []

@@ -142,3 +142,28 @@ def test_kwok_lease_delay_metric(env):
     rendered = REGISTRY.render()
     assert "kwok_node_lease_delay_seconds" in rendered
     assert "kwok_lease_renewals_total" in rendered
+
+
+def test_kwok_waiting_parking_lot_is_bounded(env):
+    """Pods bound to a node name that never appears are evicted once the
+    parking lot exceeds its cap, instead of accumulating forever."""
+    import k8s1m_tpu.cluster.kwok_controller as kc
+
+    loop, store, target = env
+    c = KwokController(store, 0)
+    c.bootstrap(now=0.0)
+    old = kc.MAX_WAITING_PODS
+    kc.MAX_WAITING_PODS = 16
+    try:
+        from k8s1m_tpu.control.objects import encode_pod, pod_key
+        from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+
+        for i in range(40):
+            store.put(
+                pod_key("default", f"ghost-{i}"),
+                encode_pod(PodInfo(f"ghost-{i}", node_name=f"no-such-node-{i}")),
+            )
+        c.tick(now=1.0)
+        assert sum(len(w) for w in c._waiting.values()) <= 16
+    finally:
+        kc.MAX_WAITING_PODS = old

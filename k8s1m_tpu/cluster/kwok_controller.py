@@ -214,7 +214,6 @@ class KwokController:
                         self._drop(name)
                     self._foreign.add(name)
                     self._waiting.pop(name, None)
-        self._waiting_since.pop(name, None)
                     self._waiting_since.pop(name, None)
             else:
                 self._foreign.discard(name)

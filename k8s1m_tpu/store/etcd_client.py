@@ -14,8 +14,8 @@ import dataclasses
 
 from grpc import aio
 
-from k8s1m_tpu.store.native import prefix_end
-from k8s1m_tpu.store.proto import rpc_pb2
+from k8s1m_tpu.store.native import pack_bind_frame, pack_put_frame, prefix_end
+from k8s1m_tpu.store.proto import batch_pb2, rpc_pb2
 
 _M = "etcdserverpb"
 
@@ -30,8 +30,13 @@ class WatchBatch:
 
 
 class EtcdClient:
-    def __init__(self, target: str, channel: aio.Channel | None = None):
-        self.channel = channel or aio.insecure_channel(target)
+    def __init__(
+        self,
+        target: str,
+        channel: aio.Channel | None = None,
+        options: list[tuple[str, int | str]] | None = None,
+    ):
+        self.channel = channel or aio.insecure_channel(target, options=options)
         c = self.channel
         pb = rpc_pb2
 
@@ -54,6 +59,16 @@ class EtcdClient:
             f"/{_M}.Watch/Watch",
             request_serializer=pb.WatchRequest.SerializeToString,
             response_deserializer=pb.WatchResponse.FromString,
+        )
+        self._put_frame = c.unary_unary(
+            "/k8s1m.BatchKV/PutFrame",
+            request_serializer=batch_pb2.PutFrameRequest.SerializeToString,
+            response_deserializer=batch_pb2.PutFrameResponse.FromString,
+        )
+        self._bind_frame = c.unary_unary(
+            "/k8s1m.BatchKV/BindFrame",
+            request_serializer=batch_pb2.BindFrameRequest.SerializeToString,
+            response_deserializer=batch_pb2.BindFrameResponse.FromString,
         )
 
     async def close(self):
@@ -139,6 +154,31 @@ class EtcdClient:
             fail.request_range.key = key
             req.failure.append(fail)
         return await self._txn(req)
+
+    async def put_batch(
+        self, items: list[tuple[bytes, bytes | None]], lease: int = 0
+    ) -> int:
+        """Pipelined write wave over the private BatchKV extension (our
+        server only — not part of the public etcd surface).  value None =
+        delete.  Returns the store revision after the wave."""
+        resp = await self._put_frame(
+            batch_pb2.PutFrameRequest(
+                frame=pack_put_frame(items), count=len(items), lease=lease
+            )
+        )
+        return resp.revision
+
+    async def bind_batch(
+        self, binds: list[tuple[bytes, int, bytes]]
+    ) -> list[int]:
+        """Bind wave (key, required_mod, node_name) -> per-record revision
+        or -1 (CAS conflict) / -5 (not spliceable).  BatchKV extension."""
+        resp = await self._bind_frame(
+            batch_pb2.BindFrameRequest(
+                frame=pack_bind_frame(binds), count=len(binds)
+            )
+        )
+        return list(resp.revisions)
 
     async def compact(self, revision: int) -> None:
         await self._compact(rpc_pb2.CompactionRequest(revision=revision))

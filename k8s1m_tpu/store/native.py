@@ -89,6 +89,34 @@ _BIND_REC = struct.Struct("<qII")     # required_mod, klen, nlen
 _DELETE_MARKER = 0xFFFFFFFF
 
 
+def pack_put_frame(items: list[tuple[bytes, bytes | None]]) -> bytes:
+    """Pack puts/deletes (value None = delete) into the ms_put_batch frame
+    format — also the wire form of BatchKV.PutFrame (proto/batch.proto)."""
+    parts = []
+    pack = _PUT_REC.pack
+    for key, value in items:
+        if value is None:
+            parts.append(pack(len(key), _DELETE_MARKER))
+            parts.append(key)
+        else:
+            parts.append(pack(len(key), len(value)))
+            parts.append(key)
+            parts.append(value)
+    return b"".join(parts)
+
+
+def pack_bind_frame(binds: list[tuple[bytes, int, bytes]]) -> bytes:
+    """Pack (key, required_mod, node_name) bind records into the
+    ms_bind_batch frame format — also the wire form of BatchKV.BindFrame."""
+    parts = []
+    pack = _BIND_REC.pack
+    for key, required_mod, name in binds:
+        parts.append(pack(required_mod, len(key), len(name)))
+        parts.append(key)
+        parts.append(name)
+    return b"".join(parts)
+
+
 def _parse_kv(buf: memoryview, off: int) -> tuple[KeyValue, int]:
     klen, vlen, crev, mrev, ver, lease = _KV_FIXED.unpack_from(buf, off)
     off += _KV_FIXED.size
@@ -383,21 +411,18 @@ class MemStore:
     ) -> int:
         """Apply a wave of puts/deletes (value None = delete) in one native
         call under one lock acquisition; returns the last revision."""
-        parts = []
-        pack = _PUT_REC.pack
-        for key, value in items:
-            if value is None:
-                parts.append(pack(len(key), _DELETE_MARKER))
-                parts.append(key)
-            else:
-                parts.append(pack(len(key), len(value)))
-                parts.append(key)
-                parts.append(value)
-        buf = b"".join(parts)
-        rev = _lib().ms_put_batch(self._h, buf, len(buf), len(items), lease)
+        rev = self.put_frame(pack_put_frame(items), len(items), lease)
         if rev < 0:
             raise ValueError(f"ms_put_batch rc={rev}")
         return rev
+
+    def put_frame(self, frame: bytes, count: int, lease: int = 0) -> int:
+        """put_batch over a pre-packed frame (see pack_put_frame) — the
+        wire batch path hands a client-packed frame straight through so
+        the serving core does zero per-item Python.  Returns the last
+        revision, or a negative MS_ERR_* code for a malformed frame (the
+        native side bounds-checks every record)."""
+        return _lib().ms_put_batch(self._h, frame, len(frame), count, lease)
 
     def bind_batch(
         self, binds: list[tuple[bytes, int, bytes]]
@@ -406,23 +431,26 @@ class MemStore:
         the whole bind wave in one native call.  ``binds`` entries are
         (key, required_mod, node_name); returns per-entry new revision,
         or _ERR_CAS / _ERR_INVALID (caller falls back to the slow path)."""
-        parts = []
-        pack = _BIND_REC.pack
-        for key, required_mod, name in binds:
-            parts.append(pack(required_mod, len(key), len(name)))
-            parts.append(key)
-            parts.append(name)
-        buf = b"".join(parts)
+        rc, results = self.bind_frame(pack_bind_frame(binds), len(binds))
+        if rc < 0:
+            raise ValueError(f"ms_bind_batch rc={rc}")
+        return results
+
+    def bind_frame(
+        self, frame: bytes, count: int
+    ) -> tuple[int, list[int]]:
+        """bind_batch over a pre-packed frame (see pack_bind_frame).
+        Returns (bound_count_or_negative_error, per_record_revisions)."""
         lib = _lib()
         out = ctypes.POINTER(ctypes.c_int64)()
         rc = lib.ms_bind_batch(
-            self._h, buf, len(buf), len(binds), ctypes.byref(out)
+            self._h, frame, len(frame), count, ctypes.byref(out)
         )
         if rc < 0:
-            raise ValueError(f"ms_bind_batch rc={rc}")
-        results = out[: len(binds)]
+            return rc, []
+        results = out[:count]
         lib.ms_free(out)
-        return results
+        return rc, results
 
     def delete(self, key: bytes) -> tuple[int, bool]:
         """Returns (revision, deleted). Revision is 0 when nothing existed."""

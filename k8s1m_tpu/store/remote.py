@@ -30,8 +30,10 @@ from k8s1m_tpu.store.native import (
     KeyValue,
     RangeResult,
     WatchEvent,
+    pack_bind_frame,
+    pack_put_frame,
 )
-from k8s1m_tpu.store.proto import mvcc_pb2, rpc_pb2
+from k8s1m_tpu.store.proto import batch_pb2, mvcc_pb2, rpc_pb2
 
 log = logging.getLogger("k8s1m.remote_store")
 
@@ -200,6 +202,16 @@ class RemoteStore:
             request_serializer=pb.WatchRequest.SerializeToString,
             response_deserializer=pb.WatchResponse.FromString,
         )
+        self._put_frame = c.unary_unary(
+            "/k8s1m.BatchKV/PutFrame",
+            request_serializer=batch_pb2.PutFrameRequest.SerializeToString,
+            response_deserializer=batch_pb2.PutFrameResponse.FromString,
+        )
+        self._bind_frame = c.unary_unary(
+            "/k8s1m.BatchKV/BindFrame",
+            request_serializer=batch_pb2.BindFrameRequest.SerializeToString,
+            response_deserializer=batch_pb2.BindFrameResponse.FromString,
+        )
 
     def close(self) -> None:
         self.channel.close()
@@ -221,6 +233,30 @@ class RemoteStore:
         if resp.deleted:
             return resp.header.revision, True
         return 0, False
+
+    def put_batch(
+        self, items: list[tuple[bytes, bytes | None]], lease: int = 0
+    ) -> int:
+        """A whole write wave as one BatchKV.PutFrame RPC — the wire
+        equivalent of MemStore.put_batch (one FFI call server-side).
+        Only works against our server; a real etcd would return
+        UNIMPLEMENTED, and the caller should fall back to per-item puts."""
+        resp = self._put_frame(
+            batch_pb2.PutFrameRequest(
+                frame=pack_put_frame(items), count=len(items), lease=lease
+            )
+        )
+        return resp.revision
+
+    def bind_batch(self, binds: list[tuple[bytes, int, bytes]]) -> list[int]:
+        """Bind wave over one BatchKV.BindFrame RPC — the wire equivalent
+        of MemStore.bind_batch (same per-record result codes)."""
+        resp = self._bind_frame(
+            batch_pb2.BindFrameRequest(
+                frame=pack_bind_frame(binds), count=len(binds)
+            )
+        )
+        return list(resp.revisions)
 
     def cas(
         self,

@@ -119,4 +119,12 @@ def add_common_args(ap):
 
 
 def client_factory(args):
-    return lambda: EtcdClient(args.target)
+    # Each client must be a real separate connection: grpc Python shares
+    # one TCP connection across channels to the same target (global
+    # subchannel pool), so without this every "client" multiplexes onto a
+    # single connection and trips the server's HTTP/2
+    # max_concurrent_streams=100 (RST_STREAM REFUSED_STREAM) under load —
+    # the same reason the reference shards across 10-12 clientsets.
+    return lambda: EtcdClient(
+        args.target, options=[("grpc.use_local_subchannel_pool", 1)]
+    )

@@ -49,6 +49,10 @@ def parse_args(argv=None):
     ap.add_argument("--rounds", type=int, default=10,
                     help="lease renewals per node")
     ap.add_argument("--prefix", default="kwok-node")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="puts per BatchKV.PutFrame RPC (0 = per-put RPCs "
+                         "like the reference's etcd-lease-flood; >0 "
+                         "pipelines waves over the private batch wire)")
     return ap.parse_args(argv)
 
 
@@ -61,12 +65,34 @@ async def amain(args) -> dict:
         seq = i // args.nodes
         await client.put(lease_key(LEASE_NS, node), lease_value(node, seq))
 
+    async def work_batched(client, bi):
+        lo = bi * args.batch
+        items = []
+        for i in range(lo, min(lo + args.batch, total)):
+            node = f"{args.prefix}-{i % args.nodes}"
+            items.append(
+                (lease_key(LEASE_NS, node), lease_value(node, i // args.nodes))
+            )
+        await client.put_batch(items)
+        if reporter:
+            # count individual puts, not RPCs (minus the one add() the
+            # pool itself records per work item)
+            reporter.add(len(items) - 1)
+
     t0 = time.perf_counter()
-    await run_sharded(
-        total, args.concurrency, client_factory(args), work,
-        clients=args.clients, reporter=reporter,
-    )
+    if args.batch > 0:
+        n_batches = (total + args.batch - 1) // args.batch
+        await run_sharded(
+            n_batches, args.concurrency, client_factory(args), work_batched,
+            clients=args.clients, reporter=reporter,
+        )
+    else:
+        await run_sharded(
+            total, args.concurrency, client_factory(args), work,
+            clients=args.clients, reporter=reporter,
+        )
     out = reporter.summary()
+    out["count"] = total
     out["puts_per_sec"] = round(total / (time.perf_counter() - t0), 1)
     return out
 

@@ -258,3 +258,75 @@ def test_unimplemented_maintenance_like_reference(env):
         assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
 
     loop.run_until_complete(go())
+
+
+def test_batch_put_frame_over_wire(env):
+    """BatchKV.PutFrame: a whole write wave in one RPC — puts + deletes
+    apply in order, watchers see every event, malformed frames are
+    rejected without crashing the native side."""
+    loop, client, store = env
+
+    async def go():
+        await client.put(b"/registry/leases/ns/doomed", b"x")
+        w = store.watch(b"/registry/leases/", prefix_end(b"/registry/leases/"))
+        items = [(b"/registry/leases/ns/l%03d" % i, b"v%d" % i)
+                 for i in range(50)]
+        items.append((b"/registry/leases/ns/doomed", None))  # delete
+        rev = await client.put_batch(items)
+        assert rev == store.current_revision
+        kv = await client.get(b"/registry/leases/ns/l049")
+        assert kv.value == b"v49"
+        assert (await client.get(b"/registry/leases/ns/doomed")) is None
+        evs = w.poll(1000)
+        assert len(evs) == 51
+        assert [e.type for e in evs] == ["PUT"] * 50 + ["DELETE"]
+        # Revision-ordered like any other write path.
+        revs = [e.kv.mod_revision for e in evs]
+        assert revs == sorted(revs)
+
+        # Malformed frame: count says 3 records but the buffer holds 1.
+        from k8s1m_tpu.store.proto import batch_pb2
+
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await client._put_frame(
+                batch_pb2.PutFrameRequest(
+                    frame=b"\x01\x00\x00\x00\x01\x00\x00\x00kv", count=3
+                )
+            )
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # Store unharmed.
+        assert (await client.get(b"/registry/leases/ns/l000")).value == b"v0"
+
+    loop.run_until_complete(go())
+
+
+def test_batch_bind_frame_over_wire(env):
+    """BatchKV.BindFrame: bind wave splices spec.nodeName under CAS with
+    per-record success / conflict / not-spliceable results."""
+    loop, client, store = env
+    from k8s1m_tpu.control.objects import encode_pod, pod_key
+    from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+
+    async def go():
+        k1 = pod_key("default", "p1")
+        k2 = pod_key("default", "p2")
+        r1 = await client.put(k1, encode_pod(PodInfo("p1")))
+        r2 = await client.put(k2, encode_pod(PodInfo("p2")))
+        k3 = b"/registry/pods/default/notjson"
+        r3 = await client.put(k3, b"not a pod object")
+        revs = await client.bind_batch([
+            (k1, r1, b"node-a"),
+            (k2, r2 - 1, b"node-b"),   # stale mod_revision -> CAS conflict
+            (k3, r3, b"node-c"),       # not spliceable
+        ])
+        assert revs[0] > r3
+        assert revs[1] == -1
+        assert revs[2] == -5
+        import json
+
+        bound = json.loads((await client.get(k1)).value)
+        assert bound["spec"]["nodeName"] == "node-a"
+        unbound = json.loads((await client.get(k2)).value)
+        assert "nodeName" not in unbound["spec"]
+
+    loop.run_until_complete(go())

@@ -20,7 +20,13 @@ from __future__ import annotations
 
 import json
 
-from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram, REGISTRY
+from k8s1m_tpu.obs.metrics import (
+    CallbackMetric,
+    Counter,
+    Gauge,
+    Histogram,
+    REGISTRY,
+)
 
 # Row layout mirrors the reference dashboard's subsystem rows.
 ROWS = [
@@ -79,6 +85,13 @@ def _panels_for(metric) -> list[tuple[str, list[dict]]]:
             [_target(f"sum {labels}({name})",
                      "-".join("{{%s}}" % l for l in metric.labelnames))],
         )]
+    if isinstance(metric, CallbackMetric):
+        # Scrape-computed sample sets (e.g. the store's lock-contention
+        # cells, labeled by method/structure/rw, reference
+        # "mem_etcd_lock_count" panels).
+        if metric.kind == "counter":
+            return [(f"{name} rate", [_target(f"rate({name}[1m])")])]
+        return [(name, [_target(name)])]
     return []
 
 

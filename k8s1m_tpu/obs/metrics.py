@@ -125,6 +125,32 @@ class Gauge(Metric):
         return out
 
 
+class CallbackMetric(Metric):
+    """Metric whose whole sample set is computed at scrape time.
+
+    ``fn`` returns ``[(labels_dict, value), ...]``; label sets may vary
+    scrape to scrape (e.g. the store's lock cells only exist for methods
+    that have run).  A failing callback yields no samples — a scrape must
+    never die with its source."""
+
+    def __init__(self, name: str, help: str, fn, kind: str = "gauge",
+                 registry: "Registry | None" = None):
+        super().__init__(name, help, (), registry)
+        self._fn = fn
+        self.kind = kind
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        try:
+            samples = self._fn()
+        except Exception:
+            return out
+        for labels, v in samples:
+            out.append(f"{self.name}{_label_str(dict(labels))} {v}")
+        return out
+
+
 class Histogram(Metric):
     kind = "histogram"
 

@@ -33,12 +33,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import weakref
 
 import grpc
 from grpc import aio
 
-from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram
+from k8s1m_tpu.obs.metrics import CallbackMetric, Counter, Gauge, Histogram
 from k8s1m_tpu.store.native import (
     CompactedError,
     FutureRevError,
@@ -71,6 +72,74 @@ for _stat in ("num_keys", "db_size", "current_revision", "compact_revision"):
         (lambda stat: lambda: sum(getattr(s, stat) for s in _SERVED_STORES))(_stat),
         stat=_stat.replace("current_", ""),
     )
+
+
+# One scrape renders five callback metrics; without a snapshot each would
+# re-serialize the full native stats JSON (taking the store read lock and
+# inflating its own M_STATS counters five-fold).  A short TTL shares one
+# snapshot across the metrics of a scrape without ever serving stale data
+# to a real scrape interval (seconds).
+_STATS_TTL_S = 0.25
+_stats_snapshots: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _stats_of(s) -> dict:
+    now = time.monotonic()
+    ent = _stats_snapshots.get(s)
+    if ent is not None and now - ent[0] < _STATS_TTL_S:
+        return ent[1]
+    st = s.stats()
+    _stats_snapshots[s] = (now, st)
+    return st
+
+
+def _lock_samples(field: str, scale: float = 1.0):
+    """Aggregate the native store's (method, structure, rw) lock cells
+    across served stores (reference mem_etcd_lock_seconds/lock_count,
+    metrics.rs:78-94)."""
+    agg: dict[tuple, float] = {}
+    for s in list(_SERVED_STORES):
+        for cell in _stats_of(s).get("locks", ()):
+            key = (cell["method"], cell["structure"], cell["rw"])
+            agg[key] = agg.get(key, 0.0) + cell[field] * scale
+    return [
+        ({"method": m, "structure": st, "rw": rw}, v)
+        for (m, st, rw), v in sorted(agg.items())
+    ]
+
+
+def _watch_samples(stat: str, agg=sum):
+    vals = [
+        _stats_of(s)["watch_pressure"][stat] for s in list(_SERVED_STORES)
+    ]
+    return [({}, agg(vals))] if vals else []
+
+
+CallbackMetric(
+    "memstore_lock_count_total",
+    "store lock acquisitions by (method, structure, rw)",
+    lambda: _lock_samples("count"), kind="counter",
+)
+CallbackMetric(
+    "memstore_lock_wait_seconds_total",
+    "time spent waiting on contended store locks",
+    lambda: _lock_samples("wait_ns", 1e-9), kind="counter",
+)
+CallbackMetric(
+    "memstore_watch_enqueued_total",
+    "events enqueued to watcher queues",
+    lambda: _watch_samples("enqueued"), kind="counter",
+)
+CallbackMetric(
+    "memstore_watch_dropped_total",
+    "events dropped at watcher queue caps (consumer must resync)",
+    lambda: _watch_samples("dropped"), kind="counter",
+)
+CallbackMetric(
+    "memstore_watch_queue_hwm",
+    "high-water watcher queue depth",
+    lambda: _watch_samples("queue_hwm", agg=max), kind="gauge",
+)
 
 
 def _kv_to_pb(kv: KeyValue) -> mvcc_pb2.KeyValue:
@@ -292,6 +361,14 @@ class EtcdService:
         """
         _REQ_COUNT.inc(method="PutFrame")
         with _REQ_LATENCY.time(method="PutFrame"):
+            # A record is >=8 bytes, so count must fit the frame; this
+            # also keeps the client-controlled uint32 inside the FFI's
+            # c_int before ctypes ever sees it.
+            if req.count > len(req.frame) // 8:
+                await ctx.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "count exceeds frame capacity",
+                )
             rev = self.store.put_frame(req.frame, req.count, req.lease)
             if rev < 0:
                 await ctx.abort(
@@ -305,6 +382,12 @@ class EtcdService:
     ) -> batch_pb2.BindFrameResponse:
         _REQ_COUNT.inc(method="BindFrame")
         with _REQ_LATENCY.time(method="BindFrame"):
+            # A bind record is >=16 bytes (see PutFrame's count check).
+            if req.count > len(req.frame) // 16:
+                await ctx.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "count exceeds frame capacity",
+                )
             bound, revisions = self.store.bind_frame(req.frame, req.count)
             if bound < 0:
                 await ctx.abort(

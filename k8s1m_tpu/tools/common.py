@@ -85,9 +85,11 @@ async def run_sharded(
                 return
             for attempt in range(retries + 1):
                 try:
-                    await work(client, i)
+                    done = await work(client, i)
                     if reporter:
-                        reporter.add()
+                        # A work item that returns an int covers that many
+                        # logical ops (e.g. one batched RPC of N puts).
+                        reporter.add(done if isinstance(done, int) else 1)
                     break
                 except Exception as e:
                     if attempt == retries:

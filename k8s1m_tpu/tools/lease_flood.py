@@ -74,10 +74,7 @@ async def amain(args) -> dict:
                 (lease_key(LEASE_NS, node), lease_value(node, i // args.nodes))
             )
         await client.put_batch(items)
-        if reporter:
-            # count individual puts, not RPCs (minus the one add() the
-            # pool itself records per work item)
-            reporter.add(len(items) - 1)
+        return len(items)  # run_sharded counts individual puts, not RPCs
 
     t0 = time.perf_counter()
     if args.batch > 0:

@@ -44,6 +44,12 @@ namespace {
 
 using Bytes = std::shared_ptr<const std::string>;
 
+inline int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
 Bytes make_bytes(const uint8_t* p, size_t n) {
   return std::make_shared<const std::string>(reinterpret_cast<const char*>(p),
                                              n);
@@ -206,12 +212,24 @@ class Wal {
 
   void Append(int fd, int64_t rev, std::string key, Bytes val) {
     {
-      std::lock_guard<std::mutex> g(qm_);
+      // Contention-metered (reference metrics.rs:78-94): the queue mutex
+      // is shared with the writer thread's drain, the one lock a write
+      // can block on outside the store mutex.
+      std::unique_lock<std::mutex> g(qm_, std::defer_lock);
+      if (!g.try_lock()) {
+        int64_t t0 = now_ns();
+        g.lock();
+        append_wait_ns.fetch_add(now_ns() - t0, std::memory_order_relaxed);
+      }
+      append_count.fetch_add(1, std::memory_order_relaxed);
       q_.push_back(WalRec{fd, rev, std::move(key), std::move(val)});
       last_enqueued_ = rev;
     }
     qcv_.notify_one();
   }
+
+  std::atomic<int64_t> append_count{0};
+  std::atomic<int64_t> append_wait_ns{0};
 
   void WaitPersisted(int64_t rev) {
     std::unique_lock<std::mutex> g(pm_);
@@ -345,6 +363,26 @@ struct ms_store {
   std::vector<std::string> no_write_prefixes;
   bool replaying = false;
 
+  // ---- contention metrics (reference metrics.rs:78-94, store.rs:478-495).
+  // Store-mutex acquisitions by (method, read|write), with wait time
+  // accumulated only when the acquisition actually contended — the
+  // try_lock fast path keeps the uncontended cost to one relaxed add.
+  enum Method {
+    M_SET, M_PUT_BATCH, M_BIND_BATCH, M_RANGE, M_COMPACT, M_WATCH, M_STATS,
+    M_METHODS
+  };
+  static constexpr const char* kMethodNames[M_METHODS] = {
+      "set", "put_batch", "bind_batch", "range", "compact", "watch", "stats"};
+  std::atomic<int64_t> lock_count[M_METHODS][2]{};
+  std::atomic<int64_t> lock_wait_ns[M_METHODS][2]{};
+  // Watcher-queue pressure.  The reference *blocks* a slow notify and
+  // times it (store.rs:478-495); this design drops-at-cap instead (the
+  // consumer resyncs), so the analog is enqueue/drop counts and the
+  // high-water queue depth.
+  std::atomic<int64_t> watch_enqueued{0};
+  std::atomic<int64_t> watch_dropped_total{0};
+  std::atomic<int64_t> watch_queue_hwm{0};
+
   ~ms_store() {
     wal.reset();  // drain writer before freeing items
     for (auto& [k, item] : by_key) delete item;
@@ -399,6 +437,7 @@ struct ms_store {
       if (w->canceled) continue;
       if (w->q.size() >= w->queue_cap) {
         w->dropped++;
+        watch_dropped_total.fetch_add(1, std::memory_order_relaxed);
         continue;
       }
       Event e = ev;
@@ -407,10 +446,48 @@ struct ms_store {
         e.prev = KvMeta{};
       }
       w->q.push_back(std::move(e));
+      watch_enqueued.fetch_add(1, std::memory_order_relaxed);
+      const int64_t depth = static_cast<int64_t>(w->q.size());
+      int64_t hwm = watch_queue_hwm.load(std::memory_order_relaxed);
+      while (depth > hwm &&
+             !watch_queue_hwm.compare_exchange_weak(
+                 hwm, depth, std::memory_order_relaxed)) {
+      }
       w->cv.notify_one();
     }
   }
 };
+
+namespace {
+
+// Scoped store-mutex guards that feed the contention metrics.
+struct WGuard {
+  std::unique_lock<std::shared_mutex> g;
+  WGuard(ms_store* s, int m) : g(s->mu, std::defer_lock) {
+    if (!g.try_lock()) {
+      int64_t t0 = now_ns();
+      g.lock();
+      s->lock_wait_ns[m][1].fetch_add(now_ns() - t0,
+                                      std::memory_order_relaxed);
+    }
+    s->lock_count[m][1].fetch_add(1, std::memory_order_relaxed);
+  }
+};
+
+struct RGuard {
+  std::shared_lock<std::shared_mutex> g;
+  RGuard(ms_store* s, int m) : g(s->mu, std::defer_lock) {
+    if (!g.try_lock()) {
+      int64_t t0 = now_ns();
+      g.lock();
+      s->lock_wait_ns[m][0].fetch_add(now_ns() - t0,
+                                      std::memory_order_relaxed);
+    }
+    s->lock_count[m][0].fetch_add(1, std::memory_order_relaxed);
+  }
+};
+
+}  // namespace
 
 // ---- open / replay --------------------------------------------------------
 
@@ -645,7 +722,7 @@ int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
   int64_t rev;
   bool fsync_wait = false;
   {
-    std::unique_lock<std::shared_mutex> g(s->mu);
+    WGuard g(s, ms_store::M_SET);
     rev = store_set_locked(s, k, val, vlen, val == nullptr, has_req,
                            req_is_version, req_val, lease, latest_rev_out,
                            cur_out, cur_len_out, &fsync_wait);
@@ -659,10 +736,13 @@ int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
 
 int64_t ms_put_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
                      int64_t lease) {
-  int64_t last = 0;
-  bool fsync_wait = false;
+  if (n < 0) return MS_ERR_INVALID;
+  // Validate the WHOLE frame before applying anything (and before taking
+  // the lock): frames arrive from the wire, and a malformed one must
+  // reject atomically — not after a prefix of the wave has committed,
+  // which would make the INVALID_ARGUMENT response a lie and skip the
+  // fsync wait for the records already applied.
   {
-    std::unique_lock<std::shared_mutex> g(s->mu);
     size_t off = 0;
     for (int i = 0; i < n; i++) {
       if (off + 8 > len) return MS_ERR_INVALID;
@@ -670,9 +750,23 @@ int64_t ms_put_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
       memcpy(&klen, buf + off, 4);
       memcpy(&vlen, buf + off + 4, 4);
       off += 8;
+      const size_t vbytes = vlen == kDeleteMarker ? 0 : vlen;
+      if (off + klen + vbytes > len) return MS_ERR_INVALID;
+      off += klen + vbytes;
+    }
+  }
+  int64_t last = 0;
+  bool fsync_wait = false;
+  {
+    WGuard g(s, ms_store::M_PUT_BATCH);
+    size_t off = 0;
+    for (int i = 0; i < n; i++) {
+      uint32_t klen, vlen;
+      memcpy(&klen, buf + off, 4);
+      memcpy(&vlen, buf + off + 4, 4);
+      off += 8;
       const bool is_del = vlen == kDeleteMarker;
       const size_t vbytes = is_del ? 0 : vlen;
-      if (off + klen + vbytes > len) return MS_ERR_INVALID;
       std::string key(reinterpret_cast<const char*>(buf + off), klen);
       off += klen;
       bool fw = false;
@@ -710,29 +804,36 @@ bool json_plain(const uint8_t* p, size_t n) {
 
 int ms_bind_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
                   int64_t** out) {
+  if (n < 0) return MS_ERR_INVALID;
+  // Pre-validate the whole frame (see ms_put_batch): reject atomically
+  // before any bind commits.
+  {
+    size_t off = 0;
+    for (int i = 0; i < n; i++) {
+      if (off + 16 > len) return MS_ERR_INVALID;
+      uint32_t klen, nlen;
+      memcpy(&klen, buf + off + 8, 4);
+      memcpy(&nlen, buf + off + 12, 4);
+      off += 16;
+      if (off + klen + nlen > len) return MS_ERR_INVALID;
+      off += klen + nlen;
+    }
+  }
   auto* results = static_cast<int64_t*>(malloc(sizeof(int64_t) * (n ? n : 1)));
   int bound = 0;
   int64_t last = 0;
   bool fsync_wait = false;
   {
-    std::unique_lock<std::shared_mutex> g(s->mu);
+    WGuard g(s, ms_store::M_BIND_BATCH);
     size_t off = 0;
     std::string spliced;
     for (int i = 0; i < n; i++) {
-      if (off + 16 > len) {
-        free(results);
-        return MS_ERR_INVALID;
-      }
       int64_t req_mod;
       uint32_t klen, nlen;
       memcpy(&req_mod, buf + off, 8);
       memcpy(&klen, buf + off + 8, 4);
       memcpy(&nlen, buf + off + 12, 4);
       off += 16;
-      if (off + klen + nlen > len) {
-        free(results);
-        return MS_ERR_INVALID;
-      }
       std::string key(reinterpret_cast<const char*>(buf + off), klen);
       off += klen;
       const uint8_t* name = buf + off;
@@ -803,7 +904,7 @@ int ms_range(ms_store* s, const uint8_t* start, size_t start_len,
                       ? std::string(reinterpret_cast<const char*>(end), end_len)
                       : std::string();
 
-  std::shared_lock<std::shared_mutex> g(s->mu);
+  RGuard g(s, ms_store::M_RANGE);
   if (rev > 0) {
     if (rev > s->current) return MS_ERR_FUTURE_REV;
     if (s->compacted && rev < s->compacted) return MS_ERR_COMPACTED;
@@ -904,7 +1005,7 @@ int64_t ms_progress_revision(ms_store* s) { return ms_current_revision(s); }
 // ---- compaction -----------------------------------------------------------
 
 int ms_compact(ms_store* s, int64_t rev) {
-  std::unique_lock<std::shared_mutex> g(s->mu);
+  WGuard g(s, ms_store::M_COMPACT);
   if (rev <= s->compacted) return MS_ERR_COMPACTED;
   if (rev > s->current) return MS_ERR_FUTURE_REV;
   s->compacted = rev;
@@ -946,7 +1047,7 @@ int64_t ms_watch_create(ms_store* s, const uint8_t* start, size_t start_len,
                         const uint8_t* end, size_t end_len, int64_t start_rev,
                         int want_prev_kv, int64_t queue_cap,
                         int64_t* compact_rev_out) {
-  std::unique_lock<std::shared_mutex> g(s->mu);
+  WGuard g(s, ms_store::M_WATCH);
   if (start_rev > 0 && s->compacted && start_rev < s->compacted) {
     if (compact_rev_out) *compact_rev_out = s->compacted;
     return MS_ERR_COMPACTED;
@@ -1003,7 +1104,7 @@ int64_t ms_watch_create(ms_store* s, const uint8_t* start, size_t start_len,
 int ms_watch_cancel(ms_store* s, int64_t watcher_id) {
   std::shared_ptr<Watcher> w;
   {
-    std::unique_lock<std::shared_mutex> g(s->mu);
+    WGuard g(s, ms_store::M_WATCH);
     auto it = s->watchers.find(watcher_id);
     if (it == s->watchers.end()) return MS_ERR_NOT_FOUND;
     w = it->second;
@@ -1021,7 +1122,7 @@ int ms_watch_poll(ms_store* s, int64_t watcher_id, int max_events,
                   int timeout_ms, uint8_t** out, size_t* out_len) {
   std::shared_ptr<Watcher> w;
   {
-    std::shared_lock<std::shared_mutex> g(s->mu);
+    RGuard g(s, ms_store::M_WATCH);
     auto it = s->watchers.find(watcher_id);
     if (it != s->watchers.end()) w = it->second;
   }
@@ -1081,13 +1182,51 @@ int64_t ms_db_size(ms_store* s) {
 }
 
 int ms_stats_json(ms_store* s, uint8_t** out, size_t* out_len) {
-  std::shared_lock<std::shared_mutex> g(s->mu);
+  RGuard g(s, ms_store::M_STATS);
   std::string j = "{\"revision\":" + std::to_string(s->current) +
                   ",\"compact_revision\":" + std::to_string(s->compacted) +
                   ",\"keys\":" + std::to_string(s->live_keys.load()) +
                   ",\"db_bytes\":" + std::to_string(s->db_bytes.load()) +
                   ",\"watchers\":" + std::to_string(s->watchers.size()) +
-                  ",\"prefixes\":{";
+                  ",\"locks\":[";
+  // (method, structure, rw) lock cells, the reference's
+  // mem_etcd_lock_seconds/lock_count label set (metrics.rs:78-94).
+  bool lfirst = true;
+  for (int m = 0; m < ms_store::M_METHODS; m++) {
+    for (int rw = 0; rw < 2; rw++) {
+      int64_t c = s->lock_count[m][rw].load(std::memory_order_relaxed);
+      if (c == 0) continue;
+      if (!lfirst) j += ",";
+      lfirst = false;
+      j += std::string("{\"method\":\"") + ms_store::kMethodNames[m] +
+           "\",\"structure\":\"store_mu\",\"rw\":\"" +
+           (rw ? "write" : "read") + "\",\"count\":" + std::to_string(c) +
+           ",\"wait_ns\":" +
+           std::to_string(
+               s->lock_wait_ns[m][rw].load(std::memory_order_relaxed)) +
+           "}";
+    }
+  }
+  if (s->wal) {
+    int64_t c = s->wal->append_count.load(std::memory_order_relaxed);
+    if (c > 0) {
+      if (!lfirst) j += ",";
+      lfirst = false;
+      j += "{\"method\":\"wal_append\",\"structure\":\"wal_queue\","
+           "\"rw\":\"write\",\"count\":" +
+           std::to_string(c) + ",\"wait_ns\":" +
+           std::to_string(
+               s->wal->append_wait_ns.load(std::memory_order_relaxed)) +
+           "}";
+    }
+  }
+  j += "],\"watch_pressure\":{\"enqueued\":" +
+       std::to_string(s->watch_enqueued.load(std::memory_order_relaxed)) +
+       ",\"dropped\":" +
+       std::to_string(s->watch_dropped_total.load(std::memory_order_relaxed)) +
+       ",\"queue_hwm\":" +
+       std::to_string(s->watch_queue_hwm.load(std::memory_order_relaxed)) +
+       "},\"prefixes\":{";
   bool first = true;
   for (auto& [p, st] : s->prefix_stats) {
     if (!first) j += ",";

@@ -285,8 +285,11 @@ def test_batch_put_frame_over_wire(env):
         assert revs == sorted(revs)
 
         # Malformed frame: count says 3 records but the buffer holds 1.
+        # Rejection must be ATOMIC: the valid first record ('k'->'v')
+        # must NOT have been applied before the bounds check failed.
         from k8s1m_tpu.store.proto import batch_pb2
 
+        rev_before = store.current_revision
         with pytest.raises(grpc.aio.AioRpcError) as ei:
             await client._put_frame(
                 batch_pb2.PutFrameRequest(
@@ -294,7 +297,15 @@ def test_batch_put_frame_over_wire(env):
                 )
             )
         assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
-        # Store unharmed.
+        assert (await client.get(b"k")) is None
+        assert store.current_revision == rev_before
+        # A count that can't fit the frame is rejected before the FFI
+        # (uint32 count vs c_int would otherwise raise in ctypes).
+        with pytest.raises(grpc.aio.AioRpcError) as ei:
+            await client._put_frame(
+                batch_pb2.PutFrameRequest(frame=b"", count=2**31)
+            )
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
         assert (await client.get(b"/registry/leases/ns/l000")).value == b"v0"
 
     loop.run_until_complete(go())

@@ -32,6 +32,7 @@ from k8s1m_tpu.obs.metrics import (
 ROWS = [
     ("Scheduler", ("coordinator_", "leader_", "webhook_")),
     ("Store (mem-etcd)", ("store_", "etcd_", "memstore_")),
+    ("Watch cache (apiserver tier)", ("watchcache_",)),
     ("KWOK nodes", ("kwok_",)),
     ("Load generators", ("loadgen_", "stress_")),
 ]

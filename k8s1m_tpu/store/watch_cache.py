@@ -1,0 +1,647 @@
+"""Watch-cache fan-out tier — the apiserver's watch-amplification role.
+
+The reference's hardest apiserver findings live in this tier: every
+kubelet+kube-proxy holds ~18 apiserver watches (18M watches at 1M nodes)
+and **none of them reach etcd** — the apiserver's watch cache holds one
+etcd watch per resource and fans events out to all client watches
+(reference README.adoc:410-416).  The cache's storage structure sets the
+update ceiling: the 1.31+ B-tree cache capped at ~40K updates/s while the
+O(1) hashmap cache sustained 100K+/s, which is why the reference runs a
+custom k3s build with ``BtreeWatchCache=false`` (reference
+README.adoc:495-499, terraform/k8s-server/server.tf:39).
+
+This module is that tier for our store: ``WatchCache`` primes itself with
+a list+watch against the upstream store (ONE store watch per prefix,
+regardless of client count) and serves the public etcd Watch wire
+protocol downstream, so ``EtcdClient``/``watch_stress`` work against it
+unchanged.  ``index="hash"|"btree"`` switches the cached-object storage
+to reproduce the ceiling experiment: hash keeps an O(1) dict, btree
+additionally maintains the ordered key index on every event (bisect
+search + ordered insert), which is also what lets btree-mode Range serve
+ordered lists without a per-call sort.
+
+Downstream watch semantics mirror the store server
+(k8s1m_tpu/store/etcd_server.py): created:true response, past-events
+replay from the bounded history window, live batches, ProgressRequest,
+CancelRequest; a start revision older than the window yields a cancel
+response with ``compact_revision`` set, and a slow consumer that
+overflows its queue is canceled so it relists — the same contract as a
+store-watcher overflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import bisect
+import collections
+import dataclasses
+import json
+import logging
+
+import grpc
+from grpc import aio
+
+from k8s1m_tpu.obs.metrics import Counter, Gauge
+from k8s1m_tpu.store.etcd_client import EtcdClient
+from k8s1m_tpu.store.native import prefix_end
+from k8s1m_tpu.store.proto import mvcc_pb2, rpc_pb2
+
+log = logging.getLogger("k8s1m.watchcache")
+
+_EVENTS_IN = Counter(
+    "watchcache_events_in_total", "events received from the store", ()
+)
+_EVENTS_OUT = Counter(
+    "watchcache_events_delivered_total", "events delivered to client watches", ()
+)
+_WATCHERS = Gauge("watchcache_watchers", "active client watches", ())
+
+_DEFAULT_WINDOW = 65536
+_QUEUE_CAP = 10_000
+_WATCH_BATCH = 1000
+
+
+@dataclasses.dataclass
+class CachedObject:
+    value: bytes
+    create_revision: int
+    mod_revision: int
+    version: int
+
+
+@dataclasses.dataclass
+class CacheEvent:
+    type: int            # 0 PUT, 1 DELETE
+    key: bytes
+    value: bytes
+    create_revision: int
+    mod_revision: int
+    version: int
+
+
+class Downstream:
+    """One client watch served from the cache."""
+
+    def __init__(self, wid: int, key: bytes, end: bytes | None,
+                 min_rev: int = 0):
+        self.id = wid
+        self.key = key
+        self.end = end          # None = single key; b"\0" = to infinity
+        self.min_rev = min_rev  # suppress live events below this revision
+        self.queue: collections.deque[CacheEvent] = collections.deque()
+        self.wakeup = asyncio.Event()
+        self.overflowed = False
+
+    def matches(self, key: bytes) -> bool:
+        if self.end is None:
+            return key == self.key
+        if key < self.key:
+            return False
+        if self.end == b"\x00":
+            return True
+        return key < self.end
+
+    def push(self, ev: CacheEvent) -> None:
+        if len(self.queue) >= _QUEUE_CAP:
+            # Slow consumer: cancel rather than gap silently (store
+            # watcher overflow contract — the client relists).
+            self.overflowed = True
+        else:
+            self.queue.append(ev)
+        self.wakeup.set()
+
+
+class WatchCache:
+    """Cached objects + bounded event history + downstream fan-out."""
+
+    def __init__(self, index: str = "hash", window: int = _DEFAULT_WINDOW):
+        if index not in ("hash", "btree"):
+            raise ValueError(f"index must be hash|btree, got {index!r}")
+        self.index = index
+        self.objects: dict[bytes, CachedObject] = {}
+        # btree mode: ordered key index maintained per event — the
+        # reference's BtreeWatchCache cost axis.  hash mode sorts only
+        # when a Range needs it.
+        self.sorted_keys: list[bytes] = []
+        self.history: collections.deque[CacheEvent] = collections.deque(
+            maxlen=window
+        )
+        self.last_revision = 0    # newest applied store revision
+        self.prime_revision = 0   # revision of the (latest) priming list
+        self.events_in = 0
+        self.events_out = 0
+        # Watcher index: exact-key hashmap + (short) list of range
+        # watchers, so per-event dispatch is O(1) + O(range watchers) —
+        # the fan-out stays cheap even with 10K+ exact watchers (the
+        # 18-watches-per-node shape is mostly exact watches).
+        self._exact: dict[bytes, set[Downstream]] = {}
+        self._ranges: set[Downstream] = set()
+        self._next_id = 1
+
+    # ---- window bounds -------------------------------------------------
+
+    @property
+    def replayable_from(self) -> int:
+        """Earliest revision from which event replay is provably
+        complete: everything after the priming list is in the history
+        window unless the bounded deque has started evicting."""
+        if self.history and len(self.history) == self.history.maxlen:
+            return self.history[0].mod_revision
+        return self.prime_revision + 1
+
+    # ---- upstream apply ------------------------------------------------
+
+    def prime(self, kvs, revision: int) -> None:
+        """Load the initial list snapshot (list+watch priming)."""
+        for kv in kvs:
+            self.objects[kv.key] = CachedObject(
+                kv.value, kv.create_revision, kv.mod_revision, kv.version
+            )
+        if self.index == "btree":
+            self.sorted_keys = sorted(self.objects)
+        self.last_revision = max(self.last_revision, revision)
+        self.prime_revision = max(self.prime_revision, revision)
+
+    def invalidate(self) -> None:
+        """Upstream watch broke: events were lost and a latest-only cache
+        cannot reconstruct them (deletes during the outage would linger,
+        and the history window would silently gap).  Cancel every client
+        watch so each one relists — the same contract as a store-watcher
+        overflow — and reset state for re-priming."""
+        for peers in self._exact.values():
+            for w in peers:
+                w.overflowed = True
+                w.wakeup.set()
+        for w in self._ranges:
+            w.overflowed = True
+            w.wakeup.set()
+        self.objects.clear()
+        self.sorted_keys = []
+        self.history.clear()
+
+    def apply(self, ev_type: int, key: bytes, value: bytes,
+              create_revision: int, mod_revision: int, version: int) -> None:
+        """Apply one upstream store event: update the cached object map
+        (hash or btree storage), append to the history window, fan out."""
+        if ev_type == 0:
+            existed = key in self.objects
+            self.objects[key] = CachedObject(
+                value, create_revision, mod_revision, version
+            )
+            if self.index == "btree" and not existed:
+                bisect.insort(self.sorted_keys, key)
+            elif self.index == "btree":
+                # Existing key: the B-tree still pays the ordered-index
+                # search on update — the cost the reference's experiment
+                # measures (README.adoc:495-499).
+                bisect.bisect_left(self.sorted_keys, key)
+        else:
+            if self.objects.pop(key, None) is not None and self.index == "btree":
+                i = bisect.bisect_left(self.sorted_keys, key)
+                if i < len(self.sorted_keys) and self.sorted_keys[i] == key:
+                    del self.sorted_keys[i]
+        ev = CacheEvent(
+            ev_type, key, value, create_revision, mod_revision, version
+        )
+        self.history.append(ev)
+        self.last_revision = max(self.last_revision, mod_revision)
+        self.events_in += 1
+        _EVENTS_IN.inc()
+        delivered = 0
+        for w in self._exact.get(key, ()):
+            if mod_revision >= w.min_rev:
+                w.push(ev)
+                delivered += 1
+        for w in self._ranges:
+            if mod_revision >= w.min_rev and w.matches(key):
+                w.push(ev)
+                delivered += 1
+        self.events_out += delivered
+        if delivered:
+            _EVENTS_OUT.inc(delivered)
+
+    # ---- downstream registry -------------------------------------------
+
+    def register(
+        self, key: bytes, end: bytes | None, min_rev: int = 0
+    ) -> Downstream:
+        w = Downstream(self._next_id, key, end, min_rev)
+        self._next_id += 1
+        if end is None:
+            self._exact.setdefault(key, set()).add(w)
+        else:
+            self._ranges.add(w)
+        _WATCHERS.inc()
+        return w
+
+    def unregister(self, w: Downstream) -> None:
+        if w.end is None:
+            peers = self._exact.get(w.key)
+            if peers is not None:
+                peers.discard(w)
+                if not peers:
+                    del self._exact[w.key]
+        else:
+            self._ranges.discard(w)
+        _WATCHERS.dec()
+
+    @property
+    def watcher_count(self) -> int:
+        return sum(len(s) for s in self._exact.values()) + len(self._ranges)
+
+    def replay(self, w: Downstream, start_revision: int) -> int | None:
+        """Queue historical events >= start_revision for ``w``.  Returns
+        the compact revision when the window no longer reaches back far
+        enough (the caller sends a compact_revision cancel, mirroring
+        etcd; the client relists), else None."""
+        if start_revision <= 0:
+            return None
+        if start_revision < self.replayable_from:
+            return self.replayable_from
+        for ev in self.history:
+            if ev.mod_revision >= start_revision and w.matches(ev.key):
+                w.push(ev)
+        return None
+
+    # ---- cache-served Range --------------------------------------------
+
+    def range(self, key: bytes, end: bytes, limit: int = 0):
+        """Serve a list from the cache (the apiserver serves lists from
+        the watch cache, which is what makes its storage structure the
+        throughput-critical one).  Returns (kvs, more, count)."""
+        if not end:
+            obj = self.objects.get(key)
+            return ([(key, obj)] if obj else [], False, 1 if obj else 0)
+        if self.index == "btree":
+            lo = bisect.bisect_left(self.sorted_keys, key)
+            hi = (
+                len(self.sorted_keys)
+                if end == b"\x00"
+                else bisect.bisect_left(self.sorted_keys, end)
+            )
+            keys = self.sorted_keys[lo:hi]
+        else:
+            keys = sorted(
+                k for k in self.objects
+                if k >= key and (end == b"\x00" or k < end)
+            )
+        total = len(keys)
+        if limit > 0:
+            keys = keys[:limit]
+        return ([(k, self.objects[k]) for k in keys], total > len(keys), total)
+
+    def stats(self) -> dict:
+        return {
+            "index": self.index,
+            "objects": len(self.objects),
+            "watchers": self.watcher_count,
+            "events_in": self.events_in,
+            "events_delivered": self.events_out,
+            "last_revision": self.last_revision,
+            "window": len(self.history),
+        }
+
+
+async def run_upstream(
+    cache: WatchCache, client: EtcdClient, prefix: bytes,
+    *, primed: asyncio.Event | None = None,
+) -> None:
+    """The tier's single store watch for ``prefix``: list to prime, then
+    watch from the list revision, applying every event to the cache.
+    Runs until cancelled; on a broken/canceled stream it relists —
+    clients keep their watches, the cache absorbs the resync."""
+    end = prefix_end(prefix)
+    first = True
+    while True:
+        if not first:
+            # Events were lost between the broken stream and this relist;
+            # cancel every client watch (they relist) and rebuild.
+            cache.invalidate()
+        first = False
+        resp = await client.prefix(prefix)
+        cache.prime(resp.kvs, resp.header.revision)
+        if primed is not None:
+            primed.set()
+        try:
+            async with client.watch(
+                prefix, end, start_revision=resp.header.revision + 1
+            ) as session:
+                if session.compact_revision:
+                    continue    # relist: our revision already compacted
+                while True:
+                    batch = await session.next()
+                    if batch.canceled:
+                        break   # server-side cancel -> relist
+                    for ev in batch.events:
+                        cache.apply(
+                            1 if ev.type == mvcc_pb2.Event.DELETE else 0,
+                            ev.kv.key,
+                            ev.kv.value,
+                            ev.kv.create_revision,
+                            ev.kv.mod_revision,
+                            ev.kv.version,
+                        )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.warning("upstream watch for %r broke (%s); relisting", prefix, e)
+            await asyncio.sleep(0.2)
+
+
+class WatchCacheService:
+    """etcd wire services served from the cache tier."""
+
+    def __init__(self, cache: WatchCache, upstream: EtcdClient):
+        self.cache = cache
+        self.upstream = upstream
+
+    def _header(self) -> rpc_pb2.ResponseHeader:
+        return rpc_pb2.ResponseHeader(
+            cluster_id=1, member_id=2, revision=self.cache.last_revision,
+            raft_term=1,
+        )
+
+    # ---- KV.Range: served from the cache -------------------------------
+
+    async def Range(self, req: rpc_pb2.RangeRequest, ctx) -> rpc_pb2.RangeResponse:
+        if req.revision > 0:
+            # A latest-only cache cannot serve an exact MVCC snapshot
+            # (the apiserver's "resourceVersion >= X" semantics don't map
+            # to etcd's exact-revision reads), so any pinned-revision
+            # Range goes to the store.  revision=0 — the hot list path —
+            # is what the cache exists to absorb.
+            return await self.upstream._range(req)
+        kvs, more, count = self.cache.range(req.key, req.range_end, req.limit)
+        return rpc_pb2.RangeResponse(
+            header=self._header(),
+            kvs=[
+                mvcc_pb2.KeyValue(
+                    key=k,
+                    value=b"" if req.keys_only else o.value,
+                    create_revision=o.create_revision,
+                    mod_revision=o.mod_revision,
+                    version=o.version,
+                )
+                for k, o in ([] if req.count_only else kvs)
+            ],
+            more=more,
+            count=count,
+        )
+
+    # ---- Watch: the fan-out --------------------------------------------
+
+    async def Watch(self, request_iterator, ctx):
+        cache = self.cache
+        watchers: dict[int, Downstream] = {}
+        out: asyncio.Queue = asyncio.Queue()
+        next_id = 1
+
+        async def pump(wid: int, w: Downstream):
+            try:
+                while True:
+                    await w.wakeup.wait()
+                    w.wakeup.clear()
+                    if w.overflowed:
+                        cache.unregister(w)
+                        watchers.pop(wid, None)
+                        await out.put(
+                            rpc_pb2.WatchResponse(
+                                header=self._header(),
+                                watch_id=wid,
+                                canceled=True,
+                                cancel_reason="watcher overflowed; events dropped",
+                            )
+                        )
+                        return
+                    while w.queue:
+                        resp = rpc_pb2.WatchResponse(
+                            header=self._header(), watch_id=wid
+                        )
+                        for _ in range(min(len(w.queue), _WATCH_BATCH)):
+                            ev = w.queue.popleft()
+                            pb = resp.events.add()
+                            pb.type = (
+                                mvcc_pb2.Event.DELETE
+                                if ev.type
+                                else mvcc_pb2.Event.PUT
+                            )
+                            pb.kv.key = ev.key
+                            pb.kv.value = ev.value
+                            pb.kv.create_revision = ev.create_revision
+                            pb.kv.mod_revision = ev.mod_revision
+                            pb.kv.version = ev.version
+                        await out.put(resp)
+            except asyncio.CancelledError:
+                raise
+
+        pumps: dict[int, asyncio.Task] = {}
+
+        async def reader():
+            nonlocal next_id
+            async for req in request_iterator:
+                which = req.WhichOneof("request_union")
+                if which == "create_request":
+                    cr = req.create_request
+                    wid = cr.watch_id or next_id
+                    next_id = max(next_id, wid) + 1
+                    if wid in watchers:
+                        # Reject like the store server: silently replacing
+                        # would leak the old Downstream (still registered
+                        # and fed) and leave its pump emitting under the
+                        # same id.
+                        await out.put(
+                            rpc_pb2.WatchResponse(
+                                header=self._header(),
+                                watch_id=wid,
+                                canceled=True,
+                                cancel_reason="duplicate watch_id",
+                            )
+                        )
+                        continue
+                    end = cr.range_end if cr.range_end else None
+                    w = cache.register(cr.key, end, min_rev=cr.start_revision)
+                    compact = cache.replay(w, cr.start_revision)
+                    if compact is not None:
+                        cache.unregister(w)
+                        await out.put(
+                            rpc_pb2.WatchResponse(
+                                header=self._header(),
+                                watch_id=wid,
+                                created=True,
+                                canceled=True,
+                                compact_revision=compact,
+                            )
+                        )
+                        continue
+                    watchers[wid] = w
+                    await out.put(
+                        rpc_pb2.WatchResponse(
+                            header=self._header(), watch_id=wid, created=True
+                        )
+                    )
+                    pumps[wid] = asyncio.create_task(pump(wid, w))
+                elif which == "cancel_request":
+                    wid = req.cancel_request.watch_id
+                    w = watchers.pop(wid, None)
+                    if w is not None:
+                        cache.unregister(w)
+                        task = pumps.pop(wid, None)
+                        if task:
+                            task.cancel()
+                        await out.put(
+                            rpc_pb2.WatchResponse(
+                                header=self._header(),
+                                watch_id=wid,
+                                canceled=True,
+                            )
+                        )
+                elif which == "progress_request":
+                    await out.put(
+                        rpc_pb2.WatchResponse(header=self._header(), watch_id=-1)
+                    )
+            await out.put(None)
+
+        rtask = asyncio.create_task(reader())
+        try:
+            while True:
+                resp = await out.get()
+                if resp is None:
+                    return
+                yield resp
+        finally:
+            rtask.cancel()
+            for task in pumps.values():
+                task.cancel()
+            for w in watchers.values():
+                cache.unregister(w)
+
+    # ---- Maintenance.Status --------------------------------------------
+
+    async def Status(self, req: rpc_pb2.StatusRequest, ctx):
+        return rpc_pb2.StatusResponse(
+            header=self._header(), version="3.5.16", leader=1,
+            raftIndex=1, raftTerm=1,
+        )
+
+
+@dataclasses.dataclass
+class WatchCacheTier:
+    """Handle to a running tier; ``close()`` tears everything down
+    including the upstream channel (one watch stream per prefix)."""
+
+    server: aio.Server
+    port: int
+    cache: WatchCache
+    tasks: list
+    upstream: EtcdClient
+
+    async def close(self) -> None:
+        for t in self.tasks:
+            t.cancel()
+        for t in self.tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.upstream.close()
+        await self.server.stop(None)
+
+
+async def serve_watch_cache(
+    upstream_target: str,
+    prefixes: list[bytes],
+    port: int = 2381,
+    host: str = "127.0.0.1",
+    index: str = "hash",
+    window: int = _DEFAULT_WINDOW,
+) -> WatchCacheTier:
+    """Start the tier: one upstream watch per prefix, etcd wire served on
+    ``port``."""
+    cache = WatchCache(index=index, window=window)
+    upstream = EtcdClient(upstream_target)
+    svc = WatchCacheService(cache, upstream)
+
+    def _unary(fn, req_cls, resp_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+
+    server = aio.server(
+        options=[
+            ("grpc.max_concurrent_streams", 100),
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ]
+    )
+    server.add_generic_rpc_handlers((
+        grpc.method_handlers_generic_handler("etcdserverpb.KV", {
+            "Range": _unary(svc.Range, rpc_pb2.RangeRequest, rpc_pb2.RangeResponse),
+        }),
+        grpc.method_handlers_generic_handler("etcdserverpb.Watch", {
+            "Watch": grpc.stream_stream_rpc_method_handler(
+                svc.Watch,
+                request_deserializer=rpc_pb2.WatchRequest.FromString,
+                response_serializer=rpc_pb2.WatchResponse.SerializeToString,
+            ),
+        }),
+        grpc.method_handlers_generic_handler("etcdserverpb.Maintenance", {
+            "Status": _unary(svc.Status, rpc_pb2.StatusRequest, rpc_pb2.StatusResponse),
+        }),
+    ))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise OSError(f"failed to bind {host}:{port}")
+    await server.start()
+    primed_events = [asyncio.Event() for _ in prefixes]
+    tasks = [
+        asyncio.create_task(run_upstream(cache, upstream, p, primed=e))
+        for p, e in zip(prefixes, primed_events)
+    ]
+    for e in primed_events:
+        await e.wait()
+    return WatchCacheTier(server, bound, cache, tasks, upstream)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="watch-cache fan-out tier")
+    ap.add_argument("--upstream", default="127.0.0.1:2379")
+    ap.add_argument("--port", type=int, default=2381)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--prefix", action="append", default=None,
+                    help="watched prefix (repeatable; default /registry/)")
+    ap.add_argument("--index", choices=("hash", "btree"), default="hash",
+                    help="cache storage structure (the reference's "
+                         "BtreeWatchCache experiment axis)")
+    ap.add_argument("--window", type=int, default=_DEFAULT_WINDOW)
+    ap.add_argument("--metrics-port", type=int, default=0)
+    args = ap.parse_args(argv)
+    prefixes = [p.encode() for p in (args.prefix or ["/registry/"])]
+
+    async def run():
+        tier = await serve_watch_cache(
+            args.upstream, prefixes, port=args.port, host=args.host,
+            index=args.index, window=args.window,
+        )
+        if args.metrics_port:
+            from k8s1m_tpu.obs.http import start_metrics_server
+
+            start_metrics_server(args.metrics_port)
+        logging.basicConfig(level=logging.INFO)
+        log.info(
+            "watch cache serving on :%d (upstream %s, index=%s, prefixes=%s)",
+            tier.port, args.upstream, args.index,
+            [p.decode() for p in prefixes],
+        )
+        try:
+            await tier.server.wait_for_termination()
+        finally:
+            await tier.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

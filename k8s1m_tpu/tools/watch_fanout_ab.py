@@ -1,0 +1,160 @@
+"""Watch-amplification A/B through the watch-cache tier.
+
+Reproduces the shape of the reference's apiserver findings
+(reference README.adoc:410-416, 495-499): every node holds several
+watches on its own objects (18 per kubelet+kube-proxy in the reference;
+``--watchers-per-node`` here), all served by the fan-out tier from ONE
+store watch — the store sees the write load, never the watch load.  The
+``--index both`` mode runs the experiment under the hash and btree cache
+storages, the reference's ``BtreeWatchCache`` ceiling axis.
+
+    python -m k8s1m_tpu.tools.watch_fanout_ab --nodes 50 --writes 20000
+
+Prints one BENCH-style JSON line per index mode:
+``store_events_per_sec`` (events entering the tier) vs
+``delivered_per_sec`` (events fanned out to client watches), plus the
+store-side watcher count proving the amplification never reaches it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+from k8s1m_tpu.store.etcd_client import EtcdClient
+from k8s1m_tpu.store.etcd_server import serve
+from k8s1m_tpu.store.native import MemStore
+from k8s1m_tpu.store.watch_cache import serve_watch_cache
+from k8s1m_tpu.control.objects import lease_key
+from k8s1m_tpu.tools.lease_flood import LEASE_NS, lease_value
+
+_STREAMS_PER_CHANNEL = 80   # under the server's max_concurrent_streams=100
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="watch fan-out A/B")
+    ap.add_argument("--nodes", type=int, default=50)
+    ap.add_argument("--watchers-per-node", type=int, default=3,
+                    help="client watches per node object (the reference "
+                         "counts 18 per kubelet+kube-proxy)")
+    ap.add_argument("--writes", type=int, default=10000)
+    ap.add_argument("--batch", type=int, default=500,
+                    help="producer batch size (BatchKV wave)")
+    ap.add_argument("--index", choices=("hash", "btree", "both"),
+                    default="both")
+    ap.add_argument("--quiet", action="store_true")
+    return ap.parse_args(argv)
+
+
+async def run_one(index: str, args, store: MemStore, store_port: int) -> dict:
+    lease_prefix = lease_key(LEASE_NS, "x")[:-1]    # .../kube-node-lease/
+    tier = await serve_watch_cache(
+        f"127.0.0.1:{store_port}", [lease_prefix], port=0, index=index,
+    )
+    cache, cache_port = tier.cache, tier.port
+    n_sessions = args.nodes * args.watchers_per_node
+    n_channels = (n_sessions + _STREAMS_PER_CHANNEL - 1) // _STREAMS_PER_CHANNEL
+    clients = [
+        EtcdClient(f"127.0.0.1:{cache_port}",
+                   options=[("grpc.use_local_subchannel_pool", 1)])
+        for _ in range(max(1, n_channels))
+    ]
+    sessions = []
+    for i in range(n_sessions):
+        node = f"kwok-node-{i % args.nodes}"
+        s = clients[i % len(clients)].watch(lease_key(LEASE_NS, node))
+        await s.__aenter__()
+        sessions.append(s)
+
+    expected = args.writes * args.watchers_per_node
+    delivered = 0
+    stream_errors = 0
+    done = asyncio.Event()
+
+    async def drain(s):
+        nonlocal delivered, stream_errors
+        while not done.is_set():
+            try:
+                batch = await s.next(timeout=15)
+            except asyncio.TimeoutError:
+                return
+            except Exception:
+                # A broken stream must surface as an error, not masquerade
+                # as a fan-out throughput ceiling.
+                stream_errors += 1
+                return
+            delivered += len(batch.events)
+            if delivered >= expected:
+                done.set()
+
+    drainers = [asyncio.create_task(drain(s)) for s in sessions]
+
+    producer = EtcdClient(f"127.0.0.1:{store_port}")
+    t0 = time.perf_counter()
+    i = 0
+    while i < args.writes:
+        n = min(args.batch, args.writes - i)
+        items = []
+        for j in range(i, i + n):
+            node = f"kwok-node-{j % args.nodes}"
+            items.append(
+                (lease_key(LEASE_NS, node), lease_value(node, j // args.nodes))
+            )
+        await producer.put_batch(items)
+        i += n
+    write_s = time.perf_counter() - t0
+    try:
+        await asyncio.wait_for(done.wait(), timeout=60)
+    except asyncio.TimeoutError:
+        pass
+    total_s = time.perf_counter() - t0
+
+    store_watchers = store.stats()["watchers"]
+    st = cache.stats()
+    for t in drainers:
+        t.cancel()
+    for s in sessions:
+        await s.cancel()
+    for c in clients:
+        await c.close()
+    await producer.close()
+    await tier.close()
+
+    return {
+        "index": index,
+        "nodes": args.nodes,
+        "client_watches": n_sessions,
+        "store_watches": store_watchers,     # 1 per prefix: fan-out proof
+        "writes": args.writes,
+        "writes_per_sec": round(args.writes / write_s, 1),
+        "store_events_per_sec": round(st["events_in"] / total_s, 1),
+        "delivered": delivered,
+        "delivered_per_sec": round(delivered / total_s, 1),
+        "amplification": round(delivered / max(1, st["events_in"]), 2),
+        "stream_errors": stream_errors,
+    }
+
+
+async def amain(args) -> list[dict]:
+    store = MemStore()
+    server, store_port = await serve(store, port=0)
+    out = []
+    try:
+        modes = ("hash", "btree") if args.index == "both" else (args.index,)
+        for index in modes:
+            out.append(await run_one(index, args, store, store_port))
+    finally:
+        await server.stop(None)
+        store.close()
+    return out
+
+
+def main(argv=None):
+    for line in asyncio.run(amain(parse_args(argv))):
+        print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

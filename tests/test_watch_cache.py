@@ -1,0 +1,242 @@
+"""Watch-cache fan-out tier tests: one store watch serving N client
+watches (the apiserver amplification role, reference README.adoc:410-416),
+replay/compaction semantics, and the hash|btree storage axis
+(README.adoc:495-499)."""
+
+import asyncio
+
+import pytest
+
+from k8s1m_tpu.store.etcd_client import EtcdClient
+from k8s1m_tpu.store.etcd_server import serve
+from k8s1m_tpu.store.native import MemStore, prefix_end
+from k8s1m_tpu.store.watch_cache import WatchCache, serve_watch_cache
+
+PFX = b"/registry/leases/ns/"
+
+
+@pytest.fixture()
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(params=["hash", "btree"])
+def env(loop, request):
+    """(loop, store, store_client, cache, cache_client) with live tier."""
+    store = MemStore()
+    state = {}
+
+    async def up():
+        server, port = await serve(store, port=0)
+        sclient = EtcdClient(f"127.0.0.1:{port}")
+        await sclient.put(PFX + b"seed", b"s0")   # pre-tier state
+        tier = await serve_watch_cache(
+            f"127.0.0.1:{port}", [PFX], port=0, index=request.param
+        )
+        cclient = EtcdClient(f"127.0.0.1:{tier.port}")
+        state.update(server=server, sclient=sclient, tier=tier,
+                     cclient=cclient)
+        return sclient, tier.cache, cclient
+
+    sclient, cache, cclient = loop.run_until_complete(up())
+    yield loop, store, sclient, cache, cclient
+
+    async def down():
+        await state["cclient"].close()
+        await state["sclient"].close()
+        await state["tier"].close()
+        await state["server"].stop(None)
+
+    loop.run_until_complete(down())
+    store.close()
+
+
+def test_fanout_one_store_watch_many_clients(env):
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        sessions = []
+        for i in range(10):
+            s = cclient.watch(PFX + b"n%d" % (i % 5))   # exact-key watches
+            await s.__aenter__()
+            sessions.append(s)
+        pw = cclient.watch(PFX, prefix_end(PFX))        # one range watch
+        await pw.__aenter__()
+
+        # The tier holds exactly ONE store watch regardless of clients.
+        assert store.stats()["watchers"] == 1
+        assert cache.watcher_count == 11
+
+        for i in range(5):
+            await sclient.put(PFX + b"n%d" % i, b"v%d" % i)
+
+        # Each exact watcher gets exactly its key's event; two watchers
+        # share each key (10 watchers over 5 keys).
+        for i, s in enumerate(sessions):
+            batch = await s.next(timeout=5)
+            assert len(batch.events) == 1
+            assert batch.events[0].kv.key == PFX + b"n%d" % (i % 5)
+            assert batch.events[0].kv.value == b"v%d" % (i % 5)
+        # The range watcher sees all five.
+        got = 0
+        while got < 5:
+            batch = await pw.next(timeout=5)
+            got += len(batch.events)
+        assert got == 5
+        st = cache.stats()
+        assert st["events_in"] == 5
+        assert st["events_delivered"] == 15   # 5 events x (2 exact + 1 range)
+        for s in sessions:
+            await s.cancel()
+        await pw.cancel()
+
+    loop.run_until_complete(go())
+
+
+def test_replay_from_revision_and_compaction(env):
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        r1 = await sclient.put(PFX + b"a", b"1")
+        await sclient.put(PFX + b"a", b"2")
+        # Wait for the tier to absorb both events.
+        for _ in range(100):
+            if cache.last_revision >= r1 + 1:
+                break
+            await asyncio.sleep(0.01)
+
+        # Replay both events from r1.
+        s = cclient.watch(PFX + b"a", start_revision=r1)
+        async with s:
+            b1 = await s.next(timeout=5)
+            vals = [e.kv.value for e in b1.events]
+            while len(vals) < 2:
+                b = await s.next(timeout=5)
+                vals += [e.kv.value for e in b.events]
+            assert vals == [b"1", b"2"]
+
+        # A start revision older than the tier's priming list cannot be
+        # proven complete -> compact_revision cancel (client relists).
+        s2 = cclient.watch(PFX + b"seed", start_revision=1)
+        async with s2:
+            assert s2.compact_revision >= 1
+            assert s2.canceled
+
+    loop.run_until_complete(go())
+
+
+def test_range_served_from_cache(env):
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        for i in (3, 1, 2):
+            await sclient.put(PFX + b"k%d" % i, b"v%d" % i)
+        for _ in range(100):
+            if len(cache.objects) >= 4:   # 3 + seed
+                break
+            await asyncio.sleep(0.01)
+        resp = await cclient.prefix(PFX)
+        keys = [kv.key for kv in resp.kvs]
+        # Ordered in both storage modes (btree serves from its ordered
+        # index; hash sorts on demand).
+        assert keys == sorted(keys)
+        assert PFX + b"k1" in keys and PFX + b"seed" in keys
+        got = {kv.key: kv.value for kv in resp.kvs}
+        assert got[PFX + b"k2"] == b"v2"
+        # Deletes drop out of the cache-served list.
+        await sclient.delete(PFX + b"k2")
+        for _ in range(100):
+            if len(cache.objects) == 3:
+                break
+            await asyncio.sleep(0.01)
+        resp = await cclient.prefix(PFX)
+        assert PFX + b"k2" not in [kv.key for kv in resp.kvs]
+
+    loop.run_until_complete(go())
+
+
+def test_live_events_after_replay_not_duplicated(env):
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        r1 = await sclient.put(PFX + b"x", b"old")
+        for _ in range(100):
+            if cache.last_revision >= r1:
+                break
+            await asyncio.sleep(0.01)
+        s = cclient.watch(PFX + b"x", start_revision=r1)
+        async with s:
+            await sclient.put(PFX + b"x", b"new")
+            vals = []
+            while len(vals) < 2:
+                b = await s.next(timeout=5)
+                vals += [e.kv.value for e in b.events]
+            assert vals == [b"old", b"new"]
+            # Nothing further: no duplicate delivery of either event.
+            with pytest.raises(asyncio.TimeoutError):
+                await s.next(timeout=0.3)
+
+    loop.run_until_complete(go())
+
+
+def test_window_eviction_forces_relist():
+    """Unit-level: once the bounded history evicts, replayable_from
+    advances to the window start."""
+    cache = WatchCache(index="hash", window=4)
+    cache.prime([], revision=10)
+    assert cache.replayable_from == 11
+    for i in range(6):
+        cache.apply(0, b"k", b"v", 11, 11 + i, i + 1)
+    # Window holds revisions 13..16; 11-12 evicted.
+    assert cache.replayable_from == 13
+    w = cache.register(b"k", None)
+    assert cache.replay(w, 12) == 13          # too old -> compact
+    assert cache.replay(w, 13) is None        # replayable
+    assert [e.mod_revision for e in w.queue] == [13, 14, 15, 16]
+
+
+def test_duplicate_watch_id_rejected(env):
+    loop, store, sclient, cache, cclient = env
+    from k8s1m_tpu.store.proto import rpc_pb2
+
+    async def go():
+        call = cclient._watch_stream()
+        req = rpc_pb2.WatchRequest(
+            create_request=rpc_pb2.WatchCreateRequest(key=PFX + b"a", watch_id=7)
+        )
+        await call.write(req)
+        first = await call.read()
+        assert first.created and first.watch_id == 7
+        await call.write(req)    # same id again
+        second = await call.read()
+        assert second.canceled and second.cancel_reason == "duplicate watch_id"
+        # The original watch is still live and registered exactly once.
+        assert cache.watcher_count == 1
+        call.cancel()
+
+    loop.run_until_complete(go())
+
+
+def test_upstream_break_cancels_clients_for_relist(env):
+    """An upstream outage cannot be papered over by a latest-only cache
+    (deletes during the outage would linger; the event window would gap):
+    every client watch is canceled so it relists."""
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        s = cclient.watch(PFX + b"a")
+        await s.__aenter__()
+        assert cache.watcher_count == 1
+        cache.invalidate()       # what run_upstream does before relisting
+        batch = await s.next(timeout=5)
+        assert batch.canceled
+        for _ in range(100):
+            if cache.watcher_count == 0:
+                break
+            await asyncio.sleep(0.01)
+        assert cache.watcher_count == 0
+        await s.cancel()
+
+    loop.run_until_complete(go())

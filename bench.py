@@ -53,11 +53,20 @@ def main():
         "(anti)affinity constraints, scheduled under the full default "
         "profile with live ConstraintState (XLA backend)",
     )
+    ap.add_argument(
+        "--affinity", action="store_true",
+        help="BASELINE config 2: pods carry NodeAffinity required terms "
+        "(zone In + region NotIn) and preferred zone terms, scheduled "
+        "under the default profile minus constraints — runs fused on the "
+        "pallas backend",
+    )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
     if args.constraints and args.backend == "pallas":
         ap.error("--constraints requires the XLA backend "
                  "(constraint plugins live on the XLA path)")
+    if args.constraints and args.affinity:
+        ap.error("--constraints and --affinity are separate configs")
     if args.backend is None:
         args.backend = "xla" if args.constraints else "pallas"
     if args.chunk is None:
@@ -71,7 +80,7 @@ def main():
     populate_kwok_nodes(host, args.nodes)
     build_s = time.perf_counter() - t0
 
-    enc = PodBatchHost(PodSpec(batch=args.batch), spec, host.vocab)
+    pod_spec = PodSpec(batch=args.batch)
     constraints = None
     if args.constraints:
         from k8s1m_tpu.cluster.workload import (
@@ -93,6 +102,23 @@ def main():
             )
         )
         constraints = empty_constraints(spec)
+    elif args.affinity:
+        from k8s1m_tpu.cluster.workload import node_affinity_pods
+
+        # Default profile minus the constraint plugins: NodeAffinity
+        # filters AND scores with live selector data, fused in the pallas
+        # kernel (ops/pallas_topk.py affinity stage).  The PodSpec is
+        # fitted to the workload's selector shape: the fused kernel's
+        # program size (and Mosaic compile time) scales with the slot
+        # count, so production encoders should size aff_terms/aff_exprs/
+        # aff_values to the batch, not to the worst case (static shapes
+        # sized to the workload — the same rule as every other TPU dim).
+        profile = Profile(topology_spread=0, interpod_affinity=0)
+        pods = node_affinity_pods(args.batch)
+        pod_spec = PodSpec(
+            batch=args.batch, aff_terms=1, aff_exprs=2, aff_values=2,
+            pref_terms=1,
+        )
     else:
         # Uniform KWOK pods carry no affinity/spread terms, so the base
         # profile is exact for this workload (affinity plugins would
@@ -103,6 +129,12 @@ def main():
         )
         pods = uniform_pods(args.batch)
 
+    # Uniform pods carry no selectors, so the base config compiles the
+    # selector-free kernel (the packed production path derives the same
+    # flag per wave from its field groups).
+    with_affinity = bool(args.affinity)
+
+    enc = PodBatchHost(pod_spec, spec, host.vocab)
     table = host.to_device()
     batch = enc.encode(pods)
     key = jax.random.key(0)
@@ -119,6 +151,7 @@ def main():
         table, constraints, asg = schedule_batch(
             table, batch, k1, profile=profile, constraints=constraints,
             chunk=args.chunk, k=args.k, backend=args.backend,
+            with_affinity=with_affinity,
         )
         return table, constraints, k2, asg.bound.sum(dtype=jax.numpy.int32)
 
@@ -152,7 +185,11 @@ def main():
             f"elapsed={elapsed*1e3:.1f}ms "
             f"({elapsed/args.steps*1e3:.2f}ms/batch)",
         )
-    suffix = "_constrained" if args.constraints else ""
+    suffix = (
+        "_constrained" if args.constraints
+        else "_affinity" if args.affinity
+        else ""
+    )
     print(json.dumps({
         "metric": f"pod_binds_per_sec_{args.nodes}_nodes{suffix}",
         "value": round(binds_per_sec, 1),

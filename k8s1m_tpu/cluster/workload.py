@@ -7,11 +7,21 @@ Here a workload is a generator of PodInfo lists sized to the engine's batch.
 
 from __future__ import annotations
 
-from k8s1m_tpu.config import SPREAD_DO_NOT_SCHEDULE, TOPO_HOSTNAME, TOPO_ZONE
+from k8s1m_tpu.config import (
+    SEL_OP_IN,
+    SEL_OP_NOT_IN,
+    SPREAD_DO_NOT_SCHEDULE,
+    TOPO_HOSTNAME,
+    TOPO_ZONE,
+)
 from k8s1m_tpu.snapshot.constraints import ConstraintTracker
+from k8s1m_tpu.snapshot.node_table import REGION_LABEL, ZONE_LABEL
 from k8s1m_tpu.snapshot.pod_encoding import (
     AffinityTermRef,
+    NodeSelectorTerm,
     PodInfo,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
     SpreadConstraintRef,
 )
 
@@ -33,6 +43,56 @@ def uniform_pods(
         )
         for i in range(count)
     ]
+
+
+def node_affinity_pods(
+    count: int,
+    *,
+    zones: int = 64,
+    regions: int = 8,
+    cpu_milli: int = 100,
+    mem_kib: int = 200 << 10,
+    name_prefix: str = "aff-pod",
+    namespace: str = "default",
+) -> list[PodInfo]:
+    """Pods exercising the NodeAffinity plugin against KWOK node labels
+    (populate_kwok_nodes writes hostname/zone/region): each pod REQUIRES
+    one of two zones (In) while excluding one region (NotIn), and PREFERS
+    its primary zone — so the kernel's required-term OR, value sets, and
+    preferred-term scoring all run with live data, like BASELINE config 2."""
+    out = []
+    for i in range(count):
+        z1, z2 = i % zones, (i + zones // 2) % zones
+        out.append(
+            PodInfo(
+                name=f"{name_prefix}-{i}",
+                namespace=namespace,
+                cpu_milli=cpu_milli,
+                mem_kib=mem_kib,
+                required_terms=[
+                    NodeSelectorTerm([
+                        SelectorRequirement(
+                            ZONE_LABEL, SEL_OP_IN, [f"zone-{z1}", f"zone-{z2}"]
+                        ),
+                        SelectorRequirement(
+                            REGION_LABEL, SEL_OP_NOT_IN,
+                            [f"region-{(i + 1) % regions}"],
+                        ),
+                    ])
+                ],
+                preferred_terms=[
+                    PreferredSchedulingTerm(
+                        2,
+                        NodeSelectorTerm([
+                            SelectorRequirement(
+                                ZONE_LABEL, SEL_OP_IN, [f"zone-{z1}"]
+                            )
+                        ]),
+                    )
+                ],
+            )
+        )
+    return out
 
 
 def spread_deployment(

@@ -316,12 +316,14 @@ def _schedule_batch_impl(
     chunk: int,
     k: int,
     backend: str = "xla",
+    with_affinity: bool = True,
 ):
     if backend == "pallas":
         from k8s1m_tpu.ops.pallas_topk import pallas_candidates
 
         cand = pallas_candidates(
-            table, batch, key, profile, chunk=chunk, k=k
+            table, batch, key, profile, chunk=chunk, k=k,
+            with_affinity=with_affinity,
         )
     else:
         cand = filter_score_topk(
@@ -334,7 +336,7 @@ def _schedule_batch_impl(
 @functools.lru_cache(maxsize=64)
 def _jitted_schedule(
     profile: Profile, chunk: int, k: int, with_constraints: bool,
-    backend: str = "xla",
+    backend: str = "xla", with_affinity: bool = True,
 ):
     # One jax.jit function object per static configuration.  Routing every
     # configuration through a single jitted function trips a pjit fast-path
@@ -343,11 +345,13 @@ def _jitted_schedule(
     # expected 67 buffers"); distinct function identities sidestep it.
     if with_constraints:
         fn = lambda table, batch, key, constraints: _schedule_batch_impl(
-            table, batch, key, constraints, profile, chunk, k, backend
+            table, batch, key, constraints, profile, chunk, k, backend,
+            with_affinity=with_affinity,
         )
     else:
         fn = lambda table, batch, key: _schedule_batch_impl(
-            table, batch, key, None, profile, chunk, k, backend
+            table, batch, key, None, profile, chunk, k, backend,
+            with_affinity=with_affinity,
         )
     return jax.jit(fn)
 
@@ -362,6 +366,7 @@ def schedule_batch(
     chunk: int = 16384,
     k: int = 4,
     backend: str = "xla",
+    with_affinity: bool = True,
 ):
     """Schedule one pod batch end-to-end on a single device.
 
@@ -370,17 +375,23 @@ def schedule_batch(
     (the assume step), so back-to-back batches see each other's placements.
 
     ``backend="pallas"`` routes filter+score+top-k through the fused
-    Pallas kernel (ops/pallas_topk.py) — base profile only.
+    Pallas kernel (ops/pallas_topk.py) — stateless profiles only (no
+    topology spread / inter-pod affinity).  ``with_affinity=False``
+    compiles the cheaper selector-free kernel; pass it only when the
+    caller knows no pod in the batch carries nodeSelector/affinity terms
+    (the packed path derives this per wave from the field groups).
     """
     if backend == "pallas":
         from k8s1m_tpu.ops import pallas_topk
 
         if constraints is not None or not pallas_topk.supports(profile):
             raise ValueError(
-                "backend='pallas' requires the base profile and no "
+                "backend='pallas' requires a stateless profile and no "
                 "constraint state (see ops/pallas_topk.py)"
             )
-    step = _jitted_schedule(profile, chunk, k, constraints is not None, backend)
+    step = _jitted_schedule(
+        profile, chunk, k, constraints is not None, backend, with_affinity
+    )
     if constraints is None:
         table, cons, asg = step(table, batch, key)
     else:
@@ -396,11 +407,16 @@ def _jitted_schedule_packed(
 ):
     from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
 
+    # Waves whose pods carry no selectors skip the affinity stage of the
+    # fused kernel entirely; the packed field groups already say so.
+    aff = bool(groups & {"sel", "req", "pref"})
+
     def impl(table, ints, bools, key, offset, constraints):
         batch = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
         if sample_rows is None:
             table, cons, asg = _schedule_batch_impl(
-                table, batch, key, constraints, profile, chunk, k, backend
+                table, batch, key, constraints, profile, chunk, k, backend,
+                with_affinity=aff,
             )
         else:
             # percentageOfNodesToScore: filter+score only a rotating
@@ -418,7 +434,8 @@ def _jitted_schedule_packed(
                 from k8s1m_tpu.ops.pallas_topk import pallas_candidates
 
                 cand = pallas_candidates(
-                    view, batch, key, profile, chunk=chunk, k=k
+                    view, batch, key, profile, chunk=chunk, k=k,
+                    with_affinity=aff,
                 )
             else:
                 cand = filter_score_topk(
@@ -479,7 +496,7 @@ def schedule_batch_packed(
 
         if constraints is not None or not pallas_topk.supports(profile):
             raise ValueError(
-                "backend='pallas' requires the base profile and no "
+                "backend='pallas' requires a stateless profile and no "
                 "constraint state (see ops/pallas_topk.py)"
             )
     if sample_rows is not None and constraints is not None:

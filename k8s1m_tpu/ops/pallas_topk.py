@@ -16,13 +16,30 @@ spreads over 8,670 CPU cores (256 scheduler shards x filter+score per pod,
 - carries a running top-k per pod in VMEM across the chunk grid
   (accumulator-output pattern), merged by K max-extract passes — no sort.
 
-Plugin coverage (the base profile; BASELINE.json configs 1-2 resource
-path): NodeResourcesFit + NodeName + TaintToleration(+NodeUnschedulable)
-filters; LeastAllocated + BalancedAllocation + TaintToleration scores.
-Label-selector plugins (NodeAffinity) and constraint plugins
-(PodTopologySpread, InterPodAffinity) stay on the XLA path — their
-vocab-sized gathers don't fit the dense-kernel mold; the engine picks the
-backend per batch (engine/cycle.py schedule_batch).
+Plugin coverage: NodeResourcesFit + NodeName + TaintToleration
+(+NodeUnschedulable) + **NodeAffinity** (spec.nodeSelector, required
+terms, preferred-term scoring — all six selector ops).  The NodeAffinity
+gathers (per-expression lookups into the per-chunk label resolution)
+become one-hot matmuls on the MXU, like the taint trick: the [Q, C]
+query-key resolution is packed as a [Q, 5C] plane (found, value-id hi/lo,
+numeric hi/lo) and each expression slot selects its row with a
+[TB, Q] x [Q, 5C] dot.  Every id travels the f32 dot as two 16-bit
+halves (f32-exact) and is recombined in int32, so In/NotIn equality and
+Gt/Lt compares are bit-exact even for ids beyond f32's 2^24 integer
+range (one-hot rows make the dot a pure selection — no summation error).
+Constraint plugins (PodTopologySpread, InterPodAffinity) stay on the XLA
+path — their count-table state doesn't fit the stateless-kernel mold;
+the engine picks the backend per batch (engine/cycle.py schedule_batch).
+
+**Size the PodSpec slot dims to the workload.** The affinity stage
+unrolls one evaluation per selector slot (aff_exprs + aff_terms*aff_exprs
++ pref_terms*aff_exprs), and Mosaic compile time AND step time scale with
+that count: measured on v5e, 6 slots compile in ~13s and run ~3x faster
+than the XLA path, while the worst-case default spec (36 slots) takes
+minutes to compile and loses its advantage.  Like every other static dim
+on TPU, aff_terms/aff_exprs/aff_values/pref_terms should be the batch's
+actual shape, not the schema maximum; ``fused_topk`` warns past
+``_SLOT_WARN`` slots.
 
 Tie-break parity: priorities pack ``score << JITTER_BITS | jitter`` like
 ops/priority.py, but jitter comes from a stateless integer hash of
@@ -45,7 +62,14 @@ from k8s1m_tpu.config import (
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
     EFFECT_PREFER_NO_SCHEDULE,
+    NO_NUMERIC,
     NONE_ID,
+    SEL_OP_DOES_NOT_EXIST,
+    SEL_OP_EXISTS,
+    SEL_OP_GT,
+    SEL_OP_IN,
+    SEL_OP_LT,
+    SEL_OP_NOT_IN,
 )
 from k8s1m_tpu.ops.priority import JITTER_BITS, MAX_SCORE
 from k8s1m_tpu.plugins.registry import Profile
@@ -55,11 +79,31 @@ from k8s1m_tpu.snapshot.pod_encoding import PodBatch
 
 def supports(profile: Profile) -> bool:
     """True if the fused kernel computes this profile exactly."""
-    return (
-        profile.node_affinity == 0
-        and profile.topology_spread == 0
-        and profile.interpod_affinity == 0
-    )
+    return profile.topology_spread == 0 and profile.interpod_affinity == 0
+
+
+# Above this many unrolled selector-slot evaluations the Mosaic compile
+# takes minutes and the kernel loses to the XLA path (module doc).
+_SLOT_WARN = 16
+_slot_warned = False
+
+
+def _check_slots(batch: PodBatch) -> None:
+    global _slot_warned
+    s = batch.sel_valid.shape[1]
+    t, e = batch.req_expr_valid.shape[1], batch.req_expr_valid.shape[2]
+    p = batch.pref_expr_valid.shape[1]
+    n = s + (t + p) * e
+    if n > _SLOT_WARN and not _slot_warned:
+        _slot_warned = True
+        import logging
+
+        logging.getLogger("k8s1m.pallas").warning(
+            "affinity kernel unrolls %d selector slots (PodSpec aff_exprs=%d"
+            " aff_terms=%d pref_terms=%d); compile and step time scale with"
+            " this — size the PodSpec to the workload's selector shape",
+            n, s, t, p,
+        )
 
 
 def _hash_jitter(seed, row_ids, col_ids):
@@ -83,32 +127,48 @@ def _hash_jitter(seed, row_ids, col_ids):
 
 
 def _kernel(
-    seed_ref,      # i32[1, 1] SMEM
-    cpu_alloc,     # i32[1, C]
-    mem_alloc,     # i32[1, C]
-    pods_alloc,    # i32[1, C]
-    cpu_req,       # i32[1, C]
-    mem_req,       # i32[1, C]
-    pods_req,      # i32[1, C]
-    name_id,       # i32[1, C]
-    taint_id,      # i32[TS, C]
-    taint_eff,     # i32[TS, C]
-    p_cpu,         # i32[TB, 1]
-    p_mem,         # i32[TB, 1]
-    p_valid,       # i32[TB, 1]
-    p_nnid,        # i32[TB, 1]
-    untol,         # f32[TB, M]  1.0 where pod does NOT tolerate taint id m
-    out_idx,       # i32[TB, K] accumulator output
-    out_prio,      # i32[TB, K] accumulator output
-    run_prio,      # i32[TB, 128] VMEM scratch: lane-aligned running top-k
-    run_idx,       # i32[TB, 128] (slots k..127 stay -1)
-    *,
+    *refs,
     chunk: int,
     k: int,
     w_la: int,
     w_ba: int,
     w_tt: int,
+    w_na: int,
+    with_aff: bool,
 ):
+    """Base refs (always):
+        seed_ref   i32[1, 1] SMEM
+        cpu_alloc, mem_alloc, pods_alloc,
+        cpu_req, mem_req, pods_req, name_id   i32[1, C]
+        taint_id, taint_eff                    i32[TS, C]
+        p_cpu, p_mem, p_valid, p_nnid          i32[TB, 1]
+        untol      f32[TB, M]  1.0 where pod does NOT tolerate taint id m
+    Affinity refs (with_aff only):
+        lkey, lval, lnum                       i32[L, C]  node label slots
+        qkey       i32[Q, 1]   batch query-key table
+        sel_valid, sel_qidx, sel_val           i32[TB, S]
+        req_tv     i32[TB, T]
+        req_ev, req_qidx, req_op, req_num      i32[TB, T*E]
+        req_vals   i32[TB, T*E*V]
+        pref_tv, pref_w                        i32[TB, P]
+        pref_ev, pref_qidx, pref_op, pref_num  i32[TB, P*E]
+        pref_vals  i32[TB, P*E*V]
+    Outputs/scratch:
+        out_idx, out_prio  i32[TB, K] accumulator outputs
+        run_prio, run_idx  i32[TB, 128] VMEM scratch (lane-aligned top-k)
+    """
+    (seed_ref, cpu_alloc, mem_alloc, pods_alloc, cpu_req, mem_req,
+     pods_req, name_id, taint_id, taint_eff) = refs[:10]
+    if with_aff:
+        (lkey, lval, lnum, qkey) = refs[10:14]
+        (p_cpu, p_mem, p_valid, p_nnid, untol) = refs[14:19]
+        (sel_valid, sel_qidx, sel_val, req_tv, req_ev, req_qidx, req_op,
+         req_num, req_vals, pref_tv, pref_w, pref_ev, pref_qidx, pref_op,
+         pref_num, pref_vals) = refs[19:35]
+        out_idx, out_prio, run_prio, run_idx = refs[35:]
+    else:
+        (p_cpu, p_mem, p_valid, p_nnid, untol) = refs[10:15]
+        out_idx, out_prio, run_prio, run_idx = refs[15:]
     b_i = pl.program_id(0)
     c_i = pl.program_id(1)
 
@@ -168,6 +228,153 @@ def _kernel(
     f_mem = jnp.clip(mem_after / alloc_mem, 0.0, 1.0)
     ba = 100.0 * (1.0 - jnp.abs(f_cpu - f_mem) / 2.0)
 
+    # ---- NodeAffinity (with_aff): resolve the batch's query keys against
+    # this chunk's label slots, then evaluate every selector slot via a
+    # one-hot [TB, Q] x [Q, 4C] dot on the MXU (see module doc).
+    if with_aff:
+        # All affinity logic runs on i32 0/1 masks (AND = *, OR = max,
+        # NOT = 1-x): Mosaic rejects selects/reductions over i1 vectors
+        # ("unsupported target bitwidth for truncation"), and the int
+        # form vectorizes the same.
+        q = qkey.shape[0]
+        kq = qkey[:]                                  # [Q, 1]
+        found = jnp.zeros((q, c), jnp.float32)
+        # Every id travels the f32 dot as two 16-bit halves (f32-exact)
+        # and is recombined in int32 — value ids as well as numerics, so
+        # vocab ids beyond f32's 2^24 integer range can never alias.
+        vhi = jnp.zeros((q, c), jnp.float32)
+        vlo = jnp.zeros((q, c), jnp.float32)
+        nhi = jnp.zeros((q, c), jnp.float32)
+        nlo = jnp.zeros((q, c), jnp.float32)
+        for l in range(lkey.shape[0]):
+            lk = lkey[l : l + 1, :]                   # [1, C]
+            eq = (kq == lk) & (lk != NONE_ID)         # [Q, C]
+            found = jnp.where(eq, 1.0, found)
+            lv = lval[l : l + 1, :]
+            vhi = jnp.where(eq, (lv >> 16).astype(jnp.float32), vhi)
+            vlo = jnp.where(eq, (lv & 0xFFFF).astype(jnp.float32), vlo)
+            ln = lnum[l : l + 1, :]
+            nhi = jnp.where(eq, (ln >> 16).astype(jnp.float32), nhi)
+            nlo = jnp.where(eq, (ln & 0xFFFF).astype(jnp.float32), nlo)
+        planes = jnp.concatenate([found, vhi, vlo, nhi, nlo], axis=1)  # [Q, 5C]
+        iota_q = lax.broadcasted_iota(jnp.int32, (tb, q), 1)
+        one_i = jnp.int32(1)
+
+        def gather_slot(qidx_c):
+            """One expression slot's per-node view: (found 0/1, value id
+            i32, numeric i32 — both recombined exactly from 16-bit
+            halves)."""
+            onehot = (qidx_c == iota_q).astype(jnp.float32)       # [TB, Q]
+            g = jnp.dot(onehot, planes, preferred_element_type=jnp.float32)
+            fi = (g[:, :c] > 0.5).astype(jnp.int32)
+            v = (
+                g[:, c : 2 * c].astype(jnp.int32) * 65536
+                + g[:, 2 * c : 3 * c].astype(jnp.int32)
+            )
+            x = (
+                g[:, 3 * c : 4 * c].astype(jnp.int32) * 65536
+                + g[:, 4 * c :].astype(jnp.int32)
+            )
+            return fi, v, x
+
+        def eval_slot(qidx_c, op_c, num_c, vals_c):
+            """match_expressions semantics (ops/label_match.py) for one
+            [TB, 1] expression slot against the chunk; returns i32 0/1."""
+            fi, v, x = gather_slot(qidx_c)
+            in_set = jnp.zeros((tb, c), jnp.int32)
+            for vi in range(vals_c.shape[1]):
+                in_set = jnp.maximum(
+                    in_set,
+                    (v == vals_c[:, vi : vi + 1]).astype(jnp.int32),
+                )
+            num_ok = (
+                fi
+                * (x != NO_NUMERIC).astype(jnp.int32)
+                * (num_c != NO_NUMERIC).astype(jnp.int32)
+            )
+            return jnp.where(
+                op_c == SEL_OP_IN, fi * in_set,
+                jnp.where(
+                    op_c == SEL_OP_NOT_IN, one_i - fi * in_set,
+                    jnp.where(
+                        op_c == SEL_OP_EXISTS, fi,
+                        jnp.where(
+                            op_c == SEL_OP_DOES_NOT_EXIST, one_i - fi,
+                            jnp.where(
+                                op_c == SEL_OP_GT,
+                                num_ok * (x > num_c).astype(jnp.int32),
+                                jnp.where(
+                                    op_c == SEL_OP_LT,
+                                    num_ok * (x < num_c).astype(jnp.int32),
+                                    jnp.zeros((tb, c), jnp.int32),
+                                ),
+                            ),
+                        ),
+                    ),
+                ),
+            )
+
+        # spec.nodeSelector: ANDed exact matches.
+        sel_pass = jnp.ones((tb, c), jnp.int32)
+        for si in range(sel_qidx.shape[1]):
+            fi, v, _ = gather_slot(sel_qidx[:, si : si + 1])
+            ok = fi * (v == sel_val[:, si : si + 1]).astype(jnp.int32)
+            inactive = (sel_valid[:, si : si + 1] == 0).astype(jnp.int32)
+            sel_pass = sel_pass * jnp.maximum(ok, inactive)
+
+        # required terms: OR of ANDed-expression terms.
+        t_slots = req_tv.shape[1]
+        e_slots = req_ev.shape[1] // t_slots
+        v_slots = req_vals.shape[1] // req_ev.shape[1]
+        aff_any = jnp.zeros((tb, c), jnp.int32)
+        for t in range(t_slots):
+            tm = jnp.ones((tb, c), jnp.int32)
+            he = jnp.zeros((tb, 1), jnp.int32)
+            for e in range(e_slots):
+                j = t * e_slots + e
+                r = eval_slot(
+                    req_qidx[:, j : j + 1],
+                    req_op[:, j : j + 1],
+                    req_num[:, j : j + 1],
+                    req_vals[:, j * v_slots : (j + 1) * v_slots],
+                )
+                ev = (req_ev[:, j : j + 1] != 0).astype(jnp.int32)
+                tm = tm * jnp.maximum(r, one_i - ev)
+                he = jnp.maximum(he, ev)
+            live = (req_tv[:, t : t + 1] != 0).astype(jnp.int32) * he
+            aff_any = jnp.maximum(aff_any, tm * live)
+        has_terms = jnp.sum(
+            (req_tv[:] != 0).astype(jnp.int32), axis=1, keepdims=True
+        )
+        aff_pass = jnp.where(has_terms > 0, aff_any, jnp.ones((tb, c), jnp.int32))
+
+        # preferred terms: matched-weight sum, normalized (scores.py
+        # node_affinity_score).
+        p_slots = pref_tv.shape[1]
+        pe_slots = pref_ev.shape[1] // p_slots
+        pv_slots = pref_vals.shape[1] // pref_ev.shape[1]
+        na_acc = jnp.zeros((tb, c), jnp.float32)
+        wtot = jnp.zeros((tb, 1), jnp.float32)
+        for p in range(p_slots):
+            tm = jnp.ones((tb, c), jnp.int32)
+            he = jnp.zeros((tb, 1), jnp.int32)
+            for e in range(pe_slots):
+                j = p * pe_slots + e
+                r = eval_slot(
+                    pref_qidx[:, j : j + 1],
+                    pref_op[:, j : j + 1],
+                    pref_num[:, j : j + 1],
+                    pref_vals[:, j * pv_slots : (j + 1) * pv_slots],
+                )
+                ev = (pref_ev[:, j : j + 1] != 0).astype(jnp.int32)
+                tm = tm * jnp.maximum(r, one_i - ev)
+                he = jnp.maximum(he, ev)
+            live = (pref_tv[:, p : p + 1] != 0).astype(jnp.int32) * he
+            w = (live * pref_w[:, p : p + 1]).astype(jnp.float32)  # [TB, 1]
+            na_acc = na_acc + (tm * live).astype(jnp.float32) * w
+            wtot = wtot + w
+        na_score = 100.0 * na_acc / jnp.maximum(wtot, 1.0)
+
     score = jnp.zeros((tb, c), jnp.int32)
     if w_la:
         score += jnp.floor(la).astype(jnp.int32) * w_la
@@ -175,12 +382,16 @@ def _kernel(
         score += jnp.floor(ba).astype(jnp.int32) * w_ba
     if w_tt:
         score += jnp.floor(tt_score).astype(jnp.int32) * w_tt
+    if with_aff and w_na:
+        score += jnp.floor(na_score).astype(jnp.int32) * w_na
 
     # ---- pack priority (ops/priority.py semantics, hash jitter).
     rows = lax.broadcasted_iota(jnp.int32, (tb, c), 0) + b_i * tb
     cols = lax.broadcasted_iota(jnp.int32, (tb, c), 1) + c_i * chunk
     jitter = _hash_jitter(seed_ref[0, 0], rows, cols)
     mask = fits & nn_ok & taint_ok & (p_valid[:] != 0)
+    if with_aff:
+        mask = mask & (sel_pass > 0) & (aff_pass > 0)
     prio = jnp.where(
         mask,
         (jnp.clip(score, 0, MAX_SCORE) << JITTER_BITS) | jitter,
@@ -224,19 +435,24 @@ def _kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("chunk", "k", "w_la", "w_ba", "w_tt", "interpret"),
+    static_argnames=(
+        "chunk", "k", "w_la", "w_ba", "w_tt", "w_na", "with_aff", "interpret",
+    ),
 )
 def _call(
     seed,
     cpu_alloc, mem_alloc, pods_alloc, cpu_req, mem_req, pods_req, name_id,
     taint_id_t, taint_eff_t,
     p_cpu, p_mem, p_valid, p_nnid, untol,
+    aff_args,       # () or the 20-tuple of affinity arrays (see below)
     *,
     chunk: int,
     k: int,
     w_la: int,
     w_ba: int,
     w_tt: int,
+    w_na: int,
+    with_aff: bool,
     interpret: bool,
 ):
     n = cpu_alloc.shape[0]
@@ -255,21 +471,68 @@ def _call(
     pod = pl.BlockSpec(
         (tb, 1), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM
     )
+
+    def podw(w):    # [TB, W] pod-row block of width w
+        return pl.BlockSpec(
+            (tb, w), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM
+        )
+
     out = pl.BlockSpec((tb, k), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM)
 
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda bi, ci: (0, 0), memory_space=pltpu.SMEM),
+        col, col, col, col, col, col, col,
+        taint, taint,
+    ]
+    args = [
+        seed.reshape(1, 1),
+        cpu_alloc.reshape(1, n), mem_alloc.reshape(1, n),
+        pods_alloc.reshape(1, n),
+        cpu_req.reshape(1, n), mem_req.reshape(1, n), pods_req.reshape(1, n),
+        name_id.reshape(1, n),
+        taint_id_t, taint_eff_t,
+    ]
+    if with_aff:
+        (lkey_t, lval_t, lnum_t, qkey,
+         sel_valid, sel_qidx, sel_val,
+         req_tv, req_ev, req_qidx, req_op, req_num, req_vals,
+         pref_tv, pref_w, pref_ev, pref_qidx, pref_op, pref_num,
+         pref_vals) = aff_args
+        l = lkey_t.shape[0]
+        label = pl.BlockSpec(
+            (l, chunk), lambda bi, ci: (0, ci), memory_space=pltpu.VMEM
+        )
+        qn = qkey.shape[0]
+        in_specs += [
+            label, label, label,
+            pl.BlockSpec((qn, 1), lambda bi, ci: (0, 0), memory_space=pltpu.VMEM),
+        ]
+        args += [lkey_t, lval_t, lnum_t, qkey.reshape(qn, 1)]
+    in_specs += [pod, pod, pod, pod, podw(m)]
+    args += [
+        p_cpu.reshape(b, 1), p_mem.reshape(b, 1),
+        p_valid.reshape(b, 1).astype(jnp.int32),
+        p_nnid.reshape(b, 1),
+        untol,
+    ]
+    if with_aff:
+        aff_pod = [
+            sel_valid, sel_qidx, sel_val,
+            req_tv, req_ev, req_qidx, req_op, req_num, req_vals,
+            pref_tv, pref_w, pref_ev, pref_qidx, pref_op, pref_num, pref_vals,
+        ]
+        aff_pod = [a.astype(jnp.int32) for a in aff_pod]
+        in_specs += [podw(a.shape[1]) for a in aff_pod]
+        args += aff_pod
+
     kernel = functools.partial(
-        _kernel, chunk=chunk, k=k, w_la=w_la, w_ba=w_ba, w_tt=w_tt
+        _kernel, chunk=chunk, k=k,
+        w_la=w_la, w_ba=w_ba, w_tt=w_tt, w_na=w_na, with_aff=with_aff,
     )
     idx, prio = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda bi, ci: (0, 0), memory_space=pltpu.SMEM),
-            col, col, col, col, col, col, col,
-            taint, taint,
-            pod, pod, pod, pod,
-            pl.BlockSpec((tb, m), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(out, out),
         out_shape=(
             jax.ShapeDtypeStruct((b, k), jnp.int32),
@@ -283,18 +546,7 @@ def _call(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
-    )(
-        seed.reshape(1, 1),
-        cpu_alloc.reshape(1, n), mem_alloc.reshape(1, n),
-        pods_alloc.reshape(1, n),
-        cpu_req.reshape(1, n), mem_req.reshape(1, n), pods_req.reshape(1, n),
-        name_id.reshape(1, n),
-        taint_id_t, taint_eff_t,
-        p_cpu.reshape(b, 1), p_mem.reshape(b, 1),
-        p_valid.reshape(b, 1).astype(jnp.int32),
-        p_nnid.reshape(b, 1),
-        untol,
-    )
+    )(*args)
     return idx, prio
 
 
@@ -306,18 +558,22 @@ def fused_topk(
     *,
     chunk: int,
     k: int,
+    with_affinity: bool = True,
     interpret: bool | None = None,
 ):
     """(idx i32[B,K], prio i32[B,K]) — global-row candidates, -1 = none.
 
     ``seed`` is an i32 scalar (fold the batch counter in host-side).
+    ``with_affinity=False`` compiles the cheaper base kernel for waves
+    whose pods carry no selectors (the coordinator knows from the packed
+    field groups); it changes cost, never semantics, for such waves.
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same
     tests run on the CPU mesh.
     """
     if not supports(profile):
         raise ValueError(
-            "pallas backend supports only the base profile "
-            "(node_affinity/topology_spread/interpod_affinity weights 0); "
+            "pallas backend supports only stateless profiles "
+            "(topology_spread/interpod_affinity weights 0); "
             f"got {profile}"
         )
     if interpret is None:
@@ -325,6 +581,30 @@ def fused_topk(
     n = table.num_rows
     if n % chunk:
         raise ValueError(f"table rows {n} not divisible by chunk {chunk}")
+    if with_affinity:
+        _check_slots(batch)
+        b = batch.batch
+        aff_args = (
+            jnp.transpose(table.label_key),
+            jnp.transpose(table.label_val),
+            jnp.transpose(table.label_num),
+            batch.qkey,
+            batch.sel_valid, batch.sel_qidx, batch.sel_val,
+            batch.req_term_valid,
+            batch.req_expr_valid.reshape(b, -1),
+            batch.req_qidx.reshape(b, -1),
+            batch.req_op.reshape(b, -1),
+            batch.req_num.reshape(b, -1),
+            batch.req_vals.reshape(b, -1),
+            batch.pref_term_valid, batch.pref_weight,
+            batch.pref_expr_valid.reshape(b, -1),
+            batch.pref_qidx.reshape(b, -1),
+            batch.pref_op.reshape(b, -1),
+            batch.pref_num.reshape(b, -1),
+            batch.pref_vals.reshape(b, -1),
+        )
+    else:
+        aff_args = ()
     return _call(
         jnp.asarray(seed, jnp.int32),
         table.cpu_alloc, table.mem_alloc, table.pods_alloc,
@@ -332,10 +612,13 @@ def fused_topk(
         jnp.transpose(table.taint_id), jnp.transpose(table.taint_effect),
         batch.cpu, batch.mem, batch.valid, batch.node_name_id,
         1.0 - batch.tolerated.astype(jnp.float32),
+        aff_args,
         chunk=chunk, k=k,
         w_la=profile.least_allocated,
         w_ba=profile.balanced_allocation,
         w_tt=profile.taint_toleration,
+        w_na=profile.node_affinity,
+        with_aff=with_affinity,
         interpret=interpret,
     )
 
@@ -354,9 +637,10 @@ def pallas_candidates(
     chunk: int,
     k: int,
     row_offset=0,
+    with_affinity: bool = True,
     interpret: bool | None = None,
 ):
-    """Drop-in for engine.filter_score_topk on the base profile.
+    """Drop-in for engine.filter_score_topk on stateless profiles.
 
     Returns engine.cycle.Candidates with the same payload columns (free
     capacity + topology domains gathered at the candidate rows).
@@ -365,7 +649,7 @@ def pallas_candidates(
 
     idx, prio = fused_topk(
         table, batch, seed_of(key), profile,
-        chunk=chunk, k=k, interpret=interpret,
+        chunk=chunk, k=k, with_affinity=with_affinity, interpret=interpret,
     )
     safe = jnp.clip(idx, 0)
     free_cpu, free_mem, free_pods = table.free()
@@ -381,7 +665,10 @@ def pallas_candidates(
     )
 
 
-def np_reference_topk(table, batch, seed: int, profile: Profile, k: int):
+def np_reference_topk(
+    table, batch, seed: int, profile: Profile, k: int,
+    with_affinity: bool = True,
+):
     """Pure-numpy oracle of the kernel (for differential tests): same
     filters, scores, hash jitter, and first-position tie rule."""
     ca = np.asarray(table.cpu_alloc, np.int64)
@@ -431,6 +718,65 @@ def np_reference_topk(table, batch, seed: int, profile: Profile, k: int):
         + np.floor(tt).astype(np.int64) * profile.taint_toleration
     )
 
+    if with_affinity:
+        lk = np.asarray(table.label_key)
+        lv = np.asarray(table.label_val)
+        ln = np.asarray(table.label_num)
+        qk = np.asarray(batch.qkey)
+        leq = (qk[:, None, None] == lk[None]) & (lk[None] != NONE_ID)
+        found = leq.any(-1)                               # [Q, N]
+        val = np.where(leq, lv[None], 0).sum(-1)
+        num = np.where(leq, ln[None], 0).sum(-1).astype(np.int32)
+
+        def match(expr_valid, qidx, op, vals, numo):
+            f = found[qidx]                               # [..., E, N]
+            v = val[qidx]
+            x = num[qidx]
+            in_set = (v[..., None] == vals[..., None, :]).any(-1)
+            ok_num = (
+                f
+                & (x != NO_NUMERIC)
+                & (numo[..., None] != NO_NUMERIC)
+            )
+            o = op[..., None]
+            r = np.select(
+                [o == SEL_OP_IN, o == SEL_OP_NOT_IN, o == SEL_OP_EXISTS,
+                 o == SEL_OP_DOES_NOT_EXIST, o == SEL_OP_GT, o == SEL_OP_LT],
+                [f & in_set, ~(f & in_set), f, ~f,
+                 ok_num & (x > numo[..., None]), ok_num & (x < numo[..., None])],
+                default=False,
+            )
+            tm = (r | ~expr_valid[..., None]).all(axis=-2)
+            return tm, expr_valid.any(-1)
+
+        sv = np.asarray(batch.sel_valid)
+        f = found[np.asarray(batch.sel_qidx)]
+        v = val[np.asarray(batch.sel_qidx)]
+        ok = f & (v == np.asarray(batch.sel_val)[..., None])
+        sel_pass = (ok | ~sv[..., None]).all(axis=1)
+
+        tm, he = match(
+            np.asarray(batch.req_expr_valid), np.asarray(batch.req_qidx),
+            np.asarray(batch.req_op), np.asarray(batch.req_vals),
+            np.asarray(batch.req_num),
+        )
+        live = np.asarray(batch.req_term_valid) & he
+        any_term = (tm & live[..., None]).any(axis=1)
+        has_terms = np.asarray(batch.req_term_valid).any(axis=1)
+        aff_pass = np.where(has_terms[:, None], any_term, True)
+
+        ptm, phe = match(
+            np.asarray(batch.pref_expr_valid), np.asarray(batch.pref_qidx),
+            np.asarray(batch.pref_op), np.asarray(batch.pref_vals),
+            np.asarray(batch.pref_num),
+        )
+        plive = np.asarray(batch.pref_term_valid) & phe
+        w = np.where(plive, np.asarray(batch.pref_weight), 0)
+        matched = (ptm & plive[..., None]) * w[..., None]
+        total = np.maximum(w.sum(axis=1), 1)
+        na = 100.0 * matched.sum(axis=1).astype(np.float32) / total[:, None]
+        score = score + np.floor(na).astype(np.int64) * profile.node_affinity
+
     b, n = score.shape
     rows = np.arange(b, dtype=np.uint32)[:, None]
     cols = np.arange(n, dtype=np.uint32)[None, :]
@@ -447,6 +793,8 @@ def np_reference_topk(table, batch, seed: int, profile: Profile, k: int):
     jitter = (h & np.uint32((1 << JITTER_BITS) - 1)).astype(np.int64)
 
     mask = fits & nn_ok & (hard_cnt == 0) & pv
+    if with_affinity:
+        mask = mask & sel_pass & aff_pass
     prio = np.where(
         mask, (np.clip(score, 0, MAX_SCORE) << JITTER_BITS) | jitter, -1
     ).astype(np.int64)

@@ -1,0 +1,370 @@
+"""Randomized CONSTRAINT differential: the stateful plugins under the
+sweep SURVEY §7 calls non-negotiable.
+
+Spread + inter-pod (anti)affinity pods are scheduled one per batch; after
+every placement an independent python shadow of the domain-count
+semantics (plugins/topology.py's documented contract) checks:
+
+- a bound pod landed on a shadow-feasible node,
+- its node's total score (base plugins via the existing oracle +
+  constraint scores recomputed from shadow counts) equals the maximum
+  shadow score over all feasible nodes (jitter only breaks ties between
+  EQUAL scores, so the chosen node's score must be maximal),
+- an unbound pod truly had no feasible node.
+
+Adversarial shapes included: nodes missing the zone/region label (empty
+topology domains / missing-key fail), maxSkew boundaries (every skew
+check sits on the +self-1 edge by construction), ScheduleAnyway refs
+(score, never block), anti-affinity exhaustion, and the symmetry rule
+(own_* tables).
+"""
+
+import collections
+
+import jax
+import numpy as np
+import pytest
+
+from k8s1m_tpu.config import (
+    PodSpec,
+    SPREAD_DO_NOT_SCHEDULE,
+    SPREAD_SCHEDULE_ANYWAY,
+    TOPO_HOSTNAME,
+    TOPO_REGION,
+    TOPO_ZONE,
+    TableSpec,
+)
+from k8s1m_tpu.engine import schedule_batch
+from k8s1m_tpu.oracle import oracle_score
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot import NodeInfo, NodeTableHost, PodBatchHost, PodInfo
+from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
+from k8s1m_tpu.snapshot.node_table import REGION_LABEL, ZONE_LABEL
+
+SPEC = TableSpec(
+    max_nodes=32, max_zones=8, max_regions=4, spread_slots=4, affinity_slots=4
+)
+PROFILE = Profile()
+TOPOS = (TOPO_HOSTNAME, TOPO_ZONE, TOPO_REGION)
+N_NODES = 24
+
+
+def build_nodes(host: NodeTableHost) -> dict[int, NodeInfo]:
+    """Ample capacity (constraints, not resources, decide placement);
+    node 7 misses the zone label and node 11 the region label — the
+    empty-domain / missing-topology-key adversarial rows."""
+    infos = {}
+    for i in range(N_NODES):
+        labels = {}
+        if i != 7:
+            labels[ZONE_LABEL] = f"z{i % 5}"
+        if i != 11:
+            labels[REGION_LABEL] = f"r{i % 3}"
+        nd = NodeInfo(
+            f"n{i}",
+            cpu_milli=1_000_000,
+            mem_kib=1 << 30,
+            pods=10_000,
+            labels=labels,
+        )
+        host.upsert(nd)
+        infos[host.row_of(nd.name)] = nd
+    return infos
+
+
+class Shadow:
+    """Independent python model of the constraint semantics."""
+
+    def __init__(self, host: NodeTableHost, infos: dict[int, NodeInfo]):
+        self.host = host
+        self.infos = infos
+        self.spread = collections.Counter()   # (cid, topo, dom) -> count
+        self.tgt = collections.Counter()      # (tid, topo, dom)
+        self.own = collections.Counter()      # (tid, topo, dom)
+        self.req = collections.defaultdict(lambda: [0, 0, 0])  # row -> cpu,mem,pods
+
+    def dom(self, row: int, topo: int) -> int:
+        if topo == TOPO_HOSTNAME:
+            return row
+        if topo == TOPO_ZONE:
+            return int(self.host.zone[row])
+        return int(self.host.region[row])
+
+    def present(self, topo: int) -> set[int]:
+        doms = {self.dom(r, topo) for r in self.infos}
+        if topo != TOPO_HOSTNAME:
+            doms.discard(0)   # domain 0 = "label missing", never a domain
+        return doms
+
+    def spread_minmax(self, cid: int, topo: int) -> tuple[int, int]:
+        vals = [self.spread[(cid, topo, d)] for d in self.present(topo)]
+        return (min(vals), max(vals)) if vals else (0, 0)
+
+    def tgt_stats(self, tid: int) -> tuple[int, int]:
+        mx = 0
+        for topo in TOPOS:
+            for d in self.present(topo):
+                mx = max(mx, self.tgt[(tid, topo, d)])
+        total = sum(v for (t, _, _), v in self.tgt.items() if t == tid)
+        return mx, total
+
+    def feasible(self, pod: PodInfo, row: int) -> bool:
+        nd = self.infos[row]
+        rc, rm, rp = self.req[row]
+        if pod.cpu_milli > nd.cpu_milli - rc:
+            return False
+        if pod.mem_kib > nd.mem_kib - rm:
+            return False
+        if nd.pods - rp < 1:
+            return False
+        for ref in pod.spread_refs:
+            if ref.mode != SPREAD_DO_NOT_SCHEDULE:
+                continue
+            d = self.dom(row, ref.topo)
+            if ref.topo != TOPO_HOSTNAME and d == 0:
+                return False   # node missing the topology key fails
+            mn, _ = self.spread_minmax(ref.cid, ref.topo)
+            inc = 1 if ref.self_match else 0
+            if self.spread[(ref.cid, ref.topo, d)] + inc - mn > ref.max_skew:
+                return False
+        for ref in pod.affinity_refs:
+            if not ref.required:
+                continue
+            d = self.dom(row, ref.topo)
+            dom_ok = ref.topo == TOPO_HOSTNAME or d != 0
+            cnt = self.tgt[(ref.tid, ref.topo, d)]
+            if not ref.anti:
+                _, total = self.tgt_stats(ref.tid)
+                bootstrap = total == 0 and ref.self_match
+                if not (dom_ok and (cnt > 0 or bootstrap)):
+                    return False
+            else:
+                if dom_ok and cnt > 0:
+                    return False
+        # Symmetry: an existing pod's required anti-affinity term that
+        # matches THIS pod blocks sharing its domain.
+        for slot, topo in pod.ipa_incs:
+            d = self.dom(row, topo)
+            dom_ok = topo == TOPO_HOSTNAME or d != 0
+            if dom_ok and self.own[(slot, topo, d)] > 0:
+                return False
+        return True
+
+    def score(self, pod: PodInfo, row: int) -> int:
+        """Device-parity integer score (f32 arithmetic like the kernels)."""
+        f32 = np.float32
+        nd = self.infos[row]
+        base = oracle_score(
+            nd, pod, tuple(self.req[row]), taint_slots=SPEC.taint_slots
+        )
+        score = base
+        if pod.spread_refs:
+            acc = f32(0)
+            for ref in pod.spread_refs:
+                d = self.dom(row, ref.topo)
+                dom_ok = ref.topo == TOPO_HOSTNAME or d != 0
+                mn, mx = self.spread_minmax(ref.cid, ref.topo)
+                denom = f32(max(mx - mn, 1))
+                cnt = self.spread[(ref.cid, ref.topo, d)]
+                s = f32(100.0) * f32(mx - cnt) / denom
+                acc += np.clip(s, f32(0), f32(100)) if dom_ok else f32(0)
+            spread = acc / f32(len(pod.spread_refs))
+            score += int(np.floor(spread)) * PROFILE.topology_spread
+        pref = [r for r in pod.affinity_refs if not r.required]
+        if pref:
+            raw = 0
+            bound = 0
+            for ref in pref:
+                d = self.dom(row, ref.topo)
+                dom_ok = ref.topo == TOPO_HOSTNAME or d != 0
+                cnt = self.tgt[(ref.tid, ref.topo, d)] if dom_ok else 0
+                sign = -ref.weight if ref.anti else ref.weight
+                raw += cnt * sign
+                mx, _ = self.tgt_stats(ref.tid)
+                bound += abs(ref.weight) * mx
+            s = f32(50.0) + f32(50.0) * f32(raw) / f32(max(bound, 1))
+            ipa = np.clip(s, f32(0), f32(100))
+            score += int(np.floor(ipa)) * PROFILE.interpod_affinity
+        return score
+
+    def commit(self, pod: PodInfo, row: int) -> None:
+        r = self.req[row]
+        r[0] += pod.cpu_milli
+        r[1] += pod.mem_kib
+        r[2] += 1
+        for slot, topo in pod.spread_incs:
+            self.spread[(slot, topo, self.dom(row, topo))] += 1
+        for slot, topo in pod.ipa_incs:
+            self.tgt[(slot, topo, self.dom(row, topo))] += 1
+        for ref in pod.affinity_refs:
+            if ref.required and ref.anti:
+                self.own[(ref.tid, ref.topo, self.dom(row, ref.topo))] += 1
+
+
+def random_workload(rng, tracker: ConstraintTracker) -> list[PodInfo]:
+    """Interleaved deployments exercising every constraint shape."""
+    from k8s1m_tpu.cluster.workload import affinity_deployment, spread_deployment
+
+    pods: list[PodInfo] = []
+    n_spread = int(rng.integers(1, 3))
+    for d in range(n_spread):
+        pods += spread_deployment(
+            tracker,
+            f"sp{d}",
+            int(rng.integers(4, 10)),
+            topo=int(rng.choice(TOPOS)),
+            max_skew=int(rng.integers(1, 3)),
+            mode=int(
+                rng.choice([SPREAD_DO_NOT_SCHEDULE, SPREAD_SCHEDULE_ANYWAY])
+            ),
+        )
+    kinds = rng.permutation(["anti", "aff", "pref"])[: int(rng.integers(1, 3))]
+    for i, kind in enumerate(kinds):
+        if kind == "anti":
+            pods += affinity_deployment(
+                tracker, f"an{i}", int(rng.integers(3, 8)),
+                topo=int(rng.choice([TOPO_HOSTNAME, TOPO_ZONE])),
+                required=True, anti=True,
+            )
+        elif kind == "aff":
+            pods += affinity_deployment(
+                tracker, f"af{i}", int(rng.integers(3, 6)),
+                topo=int(rng.choice([TOPO_ZONE, TOPO_REGION])),
+                required=True, anti=False,
+            )
+        else:
+            pods += affinity_deployment(
+                tracker, f"pf{i}", int(rng.integers(3, 6)),
+                topo=TOPO_ZONE, required=False,
+                anti=bool(rng.random() < 0.5),
+                weight=int(rng.integers(1, 50)),
+            )
+    order = rng.permutation(len(pods))
+    return [pods[i] for i in order]
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_constraint_differential(seed):
+    rng = np.random.default_rng(1000 + seed)
+    host = NodeTableHost(SPEC)
+    infos = build_nodes(host)
+    shadow = Shadow(host, infos)
+    tracker = ConstraintTracker(SPEC)
+    pods = random_workload(rng, tracker)
+    enc = PodBatchHost(PodSpec(batch=8), SPEC, host.vocab)
+
+    table = host.to_device()
+    cons = empty_constraints(SPEC)
+    rows = list(infos)
+    for i, pod in enumerate(pods):
+        batch = enc.encode([pod])
+        table, cons, asg = schedule_batch(
+            table, batch, jax.random.key(seed * 1000 + i),
+            profile=PROFILE, constraints=cons, chunk=16,
+        )
+        row = int(asg.node_row[0])
+        feas = {r: shadow.feasible(pod, r) for r in rows}
+        if row < 0:
+            assert not any(feas.values()), (
+                f"seed {seed}: device left {pod.name} unbound but shadow "
+                f"says feasible rows {[r for r, f in feas.items() if f]}"
+            )
+            continue
+        assert feas[row], (
+            f"seed {seed}: device bound {pod.name} to shadow-infeasible "
+            f"node n{row}"
+        )
+        got = shadow.score(pod, row)
+        best = max(shadow.score(pod, r) for r, f in feas.items() if f)
+        assert got == best, (
+            f"seed {seed}: {pod.name} on n{row} scored {got}, shadow max "
+            f"feasible score is {best}"
+        )
+        shadow.commit(pod, row)
+
+
+def test_max_skew_exact_boundary():
+    """Deterministic pin: count+self-min == maxSkew passes, +1 fails."""
+    from k8s1m_tpu.cluster.workload import spread_deployment
+
+    host = NodeTableHost(SPEC)
+    infos = build_nodes(host)
+    shadow = Shadow(host, infos)
+    tracker = ConstraintTracker(SPEC)
+    # Zone z0 has rows {0, 5, 10, 15, 20} (i%5==0, minus node 7 which has
+    # no zone); 5 zones present overall.
+    pods = spread_deployment(tracker, "edge", 7, topo=TOPO_ZONE, max_skew=1)
+    enc = PodBatchHost(PodSpec(batch=8), SPEC, host.vocab)
+    table = host.to_device()
+    cons = empty_constraints(SPEC)
+    placed_zone = collections.Counter()
+    for i, pod in enumerate(pods):
+        batch = enc.encode([pod])
+        table, cons, asg = schedule_batch(
+            table, batch, jax.random.key(i), profile=PROFILE,
+            constraints=cons, chunk=16,
+        )
+        row = int(asg.node_row[0])
+        assert row >= 0
+        assert shadow.feasible(pod, row)
+        shadow.commit(pod, row)
+        placed_zone[shadow.dom(row, TOPO_ZONE)] += 1
+    # 7 replicas over 5 zones at maxSkew=1: no zone may exceed 2, and at
+    # least two zones hold 2 (boundary exercised in both directions).
+    assert max(placed_zone.values()) == 2
+    assert min(placed_zone[shadow.dom(r, TOPO_ZONE)] for r in infos
+               if shadow.dom(r, TOPO_ZONE) != 0) >= 1
+
+
+def test_anti_affinity_exhaustion_and_symmetry():
+    """Hostname anti-affinity binds one per node then exhausts; a later
+    pod matching an anti-owner's selector is blocked everywhere the
+    owners sit (symmetry via own_* tables)."""
+    from k8s1m_tpu.cluster.workload import affinity_deployment
+
+    spec = TableSpec(
+        max_nodes=8, max_zones=8, max_regions=4,
+        spread_slots=4, affinity_slots=4,
+    )
+    host = NodeTableHost(spec)
+    infos = {}
+    for i in range(4):
+        nd = NodeInfo(f"n{i}", cpu_milli=10_000, mem_kib=1 << 24, pods=100,
+                      labels={ZONE_LABEL: f"z{i % 2}"})
+        host.upsert(nd)
+        infos[host.row_of(nd.name)] = nd
+    shadow = Shadow(host, infos)
+    tracker = ConstraintTracker(spec)
+    anti = affinity_deployment(tracker, "solo", 6, topo=TOPO_HOSTNAME,
+                               required=True, anti=True)
+    enc = PodBatchHost(PodSpec(batch=8), spec, host.vocab)
+    table = host.to_device()
+    cons = empty_constraints(spec)
+    bound_rows = []
+    for i, pod in enumerate(anti):
+        batch = enc.encode([pod])
+        table, cons, asg = schedule_batch(
+            table, batch, jax.random.key(i), profile=PROFILE,
+            constraints=cons, chunk=8,
+        )
+        row = int(asg.node_row[0])
+        if row >= 0:
+            assert shadow.feasible(pod, row)
+            shadow.commit(pod, row)
+            bound_rows.append(row)
+    # 4 nodes -> exactly 4 of 6 bind, one per node.
+    assert sorted(bound_rows) == sorted(infos)
+    # Symmetry: a plain pod labeled app=solo (matching the anti owners'
+    # selector) is blocked on every node.
+    intruder = PodInfo(
+        "intruder", labels={"app": "solo"},
+        spread_incs=tracker.spread_matches("default", {"app": "solo"}),
+        ipa_incs=tracker.affinity_matches("default", {"app": "solo"}),
+    )
+    batch = enc.encode([intruder])
+    table, cons, asg = schedule_batch(
+        table, batch, jax.random.key(99), profile=PROFILE,
+        constraints=cons, chunk=8,
+    )
+    assert int(asg.node_row[0]) == -1
+    assert not any(shadow.feasible(intruder, r) for r in infos)

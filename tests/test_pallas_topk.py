@@ -27,7 +27,14 @@ from k8s1m_tpu.ops.pallas_topk import (
 from k8s1m_tpu.ops.priority import unpack_score
 from k8s1m_tpu.plugins.registry import Profile, score_and_filter
 from k8s1m_tpu.snapshot.node_table import NodeInfo, NodeTableHost, Taint
-from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo, Toleration
+from k8s1m_tpu.snapshot.pod_encoding import (
+    NodeSelectorTerm,
+    PodBatchHost,
+    PodInfo,
+    PreferredSchedulingTerm,
+    SelectorRequirement,
+    Toleration,
+)
 
 BASE = Profile(node_affinity=0, topology_spread=0, interpod_affinity=0)
 N = 256
@@ -181,3 +188,161 @@ def test_node_name_filter(rng):
     assert idx[0, 0] == host.row_of("node-17")
     assert (idx[0, 1:] == -1).all()
     assert (np.asarray(prio)[1] >= 0).all()
+
+
+# ---- NodeAffinity on the fused kernel ---------------------------------
+
+AFF = Profile(topology_spread=0, interpod_affinity=0)   # default minus constraints
+
+
+def build_labeled(rng, num_nodes=N):
+    """Nodes with tiered labels + numeric labels for Gt/Lt (values beyond
+    f32's 2^24 integer range to pin the exact-compare path)."""
+    spec = TableSpec(max_nodes=num_nodes, max_taint_ids=16)
+    host = NodeTableHost(spec)
+    for i in range(num_nodes - 8):
+        labels = {
+            "tier": ("web", "db", "cache")[i % 3],
+            "disk": ("ssd", "hdd")[i % 2],
+            "gen": str(100_000_000 + i * 7_919),   # > 2^24: f32 would round
+        }
+        if i % 4 == 0:
+            labels["gpu"] = "true"
+        host.upsert(
+            NodeInfo(
+                f"node-{i}",
+                cpu_milli=int(rng.integers(500, 8000)),
+                mem_kib=int(rng.integers(1 << 20, 16 << 20)),
+                pods=8,
+                labels=labels,
+            )
+        )
+    return spec, host
+
+
+def affinity_pods(host, spec, batch=16):
+    from k8s1m_tpu.config import (
+        SEL_OP_DOES_NOT_EXIST,
+        SEL_OP_EXISTS,
+        SEL_OP_GT,
+        SEL_OP_IN,
+        SEL_OP_LT,
+        SEL_OP_NOT_IN,
+    )
+
+    enc = PodBatchHost(PodSpec(batch=batch), spec, host.vocab)
+    infos = [
+        # nodeSelector exact match
+        PodInfo("sel", node_selector={"tier": "db"}),
+        # required: In
+        PodInfo("req-in", required_terms=[NodeSelectorTerm([
+            SelectorRequirement("tier", SEL_OP_IN, ["web", "cache"])])]),
+        # required: NotIn + Exists ANDed
+        PodInfo("req-and", required_terms=[NodeSelectorTerm([
+            SelectorRequirement("disk", SEL_OP_NOT_IN, ["hdd"]),
+            SelectorRequirement("gpu", SEL_OP_EXISTS)])]),
+        # required: OR of two terms
+        PodInfo("req-or", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("tier", SEL_OP_IN, ["db"])]),
+            NodeSelectorTerm([SelectorRequirement("gpu", SEL_OP_EXISTS)])]),
+        # required: Gt/Lt on a >2^24 numeric label
+        PodInfo("req-gt", required_terms=[NodeSelectorTerm([
+            SelectorRequirement("gen", SEL_OP_GT, ["100500000"]),
+            SelectorRequirement("gen", SEL_OP_LT, ["101000000"])])]),
+        # required: DoesNotExist
+        PodInfo("req-dne", required_terms=[NodeSelectorTerm([
+            SelectorRequirement("gpu", SEL_OP_DOES_NOT_EXIST)])]),
+        # unsatisfiable: selector value never interned
+        PodInfo("req-none", node_selector={"tier": "never-seen"}),
+        # preferred only: scoring, no filtering
+        PodInfo("pref", preferred_terms=[
+            PreferredSchedulingTerm(3, NodeSelectorTerm([
+                SelectorRequirement("tier", SEL_OP_IN, ["db"])])),
+            PreferredSchedulingTerm(1, NodeSelectorTerm([
+                SelectorRequirement("disk", SEL_OP_IN, ["ssd"])]))]),
+        # plain pod: affinity stage must be a no-op for it
+        PodInfo("plain"),
+    ]
+    return enc.encode(infos)
+
+
+def test_affinity_matches_numpy_oracle(rng):
+    spec, host = build_labeled(rng)
+    batch = affinity_pods(host, spec)
+    table = host.to_device()
+    idx, prio = fused_topk(table, batch, jnp.int32(99), AFF, chunk=CHUNK, k=4)
+    ref_i, ref_p = np_reference_topk(table, batch, 99, AFF, k=4)
+    np.testing.assert_array_equal(np.asarray(prio), ref_p)
+    np.testing.assert_array_equal(np.asarray(idx), ref_i)
+
+
+def test_affinity_matches_xla_path(rng):
+    """Same feasible sets and integer scores as the XLA plugin path for
+    every selector shape (all six ops, OR terms, preferred weights)."""
+    spec, host = build_labeled(rng)
+    batch = affinity_pods(host, spec)
+    table = host.to_device()
+
+    idx, prio = fused_topk(table, batch, jnp.int32(5), AFF, chunk=CHUNK, k=4)
+    mask, score = score_and_filter(table, batch, AFF)
+    mask = np.asarray(mask & batch.valid[:, None] & table.valid[None, :])
+    score = np.asarray(jnp.where(mask, score, -1))
+    idx, prio = np.asarray(idx), np.asarray(prio)
+    for b in range(batch.batch):
+        expect_k = min(4, int(mask[b].sum()))
+        assert (prio[b] >= 0).sum() == expect_k, b
+        order = np.sort(score[b][mask[b]])[::-1]
+        for j in range(expect_k):
+            assert score[b, idx[b, j]] == (prio[b, j] >> 20), (b, j)
+        np.testing.assert_array_equal(
+            np.sort(prio[b, :expect_k] >> 20)[::-1], order[:expect_k]
+        )
+
+
+def test_affinity_semantics_spot_checks(rng):
+    """Direct semantic pins, independent of the XLA path."""
+    spec, host = build_labeled(rng)
+    batch = affinity_pods(host, spec)
+    table = host.to_device()
+    idx, prio = fused_topk(table, batch, jnp.int32(1), AFF, chunk=CHUNK, k=4)
+    idx, prio = np.asarray(idx), np.asarray(prio)
+    tiers = {i: ("web", "db", "cache")[i % 3] for i in range(N - 8)}
+
+    # sel: every candidate is a db node.
+    assert (prio[0] >= 0).all()
+    assert all(tiers[int(r)] == "db" for r in idx[0])
+    # req-in: web or cache only.
+    assert all(tiers[int(r)] in ("web", "cache") for r in idx[1] if r >= 0)
+    # req-and: ssd AND gpu -> i % 2 == 0 and i % 4 == 0.
+    for r in idx[2]:
+        if r >= 0:
+            assert int(r) % 4 == 0
+    # req-gt: 100.5M < 100M + 7919*i < 101M.
+    for r in idx[4]:
+        if r >= 0:
+            g = 100_000_000 + int(r) * 7_919
+            assert 100_500_000 < g < 101_000_000
+    # req-dne: no gpu label -> i % 4 != 0.
+    for r in idx[5]:
+        if r >= 0:
+            assert int(r) % 4 != 0
+    # unsatisfiable selector: no candidates.
+    assert (idx[6] == -1).all()
+    # plain pod unaffected by the affinity stage.
+    assert (prio[8] >= 0).all()
+
+
+def test_affinity_backend_parity_end_to_end(rng):
+    spec, host = build_labeled(rng)
+    batch = affinity_pods(host, spec)
+    key = jax.random.key(11)
+    _, _, asg_x = schedule_batch(
+        host.to_device(), batch, key, profile=AFF, chunk=CHUNK, k=4,
+        backend="xla",
+    )
+    _, _, asg_p = schedule_batch(
+        host.to_device(), batch, key, profile=AFF, chunk=CHUNK, k=4,
+        backend="pallas",
+    )
+    np.testing.assert_array_equal(np.asarray(asg_x.bound), np.asarray(asg_p.bound))
+    np.testing.assert_array_equal(np.asarray(asg_x.score), np.asarray(asg_p.score))

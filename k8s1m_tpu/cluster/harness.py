@@ -51,6 +51,16 @@ class ClusterSpec:
     nodes: int = 1000
     kwok_groups: int = 2
     coordinators: int = 2          # leader + standbys
+    # >1 switches the control plane to a scheduler shard set
+    # (control/shardset.py): N cooperating coordinators splitting the pod
+    # stream by FNV hash and the node space by ownership masks, with a
+    # lease-elected rebalancer — the reference's 256-replica scale-out
+    # topology (schedulerset.go, leader_activities.go).  ``coordinators``
+    # is ignored in shard mode.
+    shards: int = 1
+    # Minimum simulated seconds between rebalance rounds (the reference's
+    # 30 s floor, leader_activities.go).
+    rebalance_interval_s: float = 30.0
     zones: int = 8
     regions: int = 4
     wal_mode: str = "buffered"
@@ -123,19 +133,50 @@ class Cluster:
         atexit.register(self.shutdown)
         wait_for_port(self.port)
 
-        for i in range(spec.coordinators):
-            store = self._client()
-            self.coordinators.append(
-                HACoordinator(
-                    LeaderElector(store, f"coordinator-{i}"),
-                    lambda store=store: Coordinator(
-                        store, spec.table_spec(), PodSpec(batch=spec.pod_batch),
-                        spec.profile, chunk=spec.chunk, backend=spec.backend,
-                        with_constraints=spec.profile.topology_spread > 0
-                        or spec.profile.interpod_affinity > 0,
-                    ),
+        self.shard_members: list = []
+        self._rebalancer = None
+        self._reb_elector = None
+        if spec.shards > 1:
+            from k8s1m_tpu.control.shardset import Rebalancer, ShardMember
+
+            for i in range(spec.shards):
+                store = self._client()
+                coord = Coordinator(
+                    store, spec.table_spec(), PodSpec(batch=spec.pod_batch),
+                    spec.profile, chunk=spec.chunk, backend=spec.backend,
+                    with_constraints=spec.profile.topology_spread > 0
+                    or spec.profile.interpod_affinity > 0,
                 )
+                self.shard_members.append(
+                    ShardMember(store, coord, i, spec.shards)
+                )
+            for m in self.shard_members:
+                m.start(now=0.0)
+            # The rebalancer runs wherever the control-plane lease lands
+            # (any member's host view works — they all track every node).
+            self._reb_elector = LeaderElector(
+                self._client(), "rebalancer", name="shardset-rebalancer"
             )
+            self._rebalancer = Rebalancer(
+                self._clients[0], self.shard_members[0].coordinator.host,
+                spec.shards, min_interval=spec.rebalance_interval_s,
+            )
+        else:
+            for i in range(spec.coordinators):
+                store = self._client()
+                self.coordinators.append(
+                    HACoordinator(
+                        LeaderElector(store, f"coordinator-{i}"),
+                        lambda store=store: Coordinator(
+                            store, spec.table_spec(),
+                            PodSpec(batch=spec.pod_batch),
+                            spec.profile, chunk=spec.chunk,
+                            backend=spec.backend,
+                            with_constraints=spec.profile.topology_spread > 0
+                            or spec.profile.interpod_affinity > 0,
+                        ),
+                    )
+                )
         self.kwoks = [
             KwokController(self._client(), group=g)
             for g in range(spec.kwok_groups)
@@ -154,6 +195,17 @@ class Cluster:
         return c
 
     def _webhook_sink(self, obj: dict) -> None:
+        if self.shard_members:
+            # Route by the same FNV pod hash the members' intake filters
+            # use (the reference webhook resolves GetTargetForScoring the
+            # same way, schedulerset.go:130-143).
+            from k8s1m_tpu.control.shardset import pod_shard
+
+            meta = obj.get("metadata", {})
+            key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+            idx = pod_shard(key, len(self.shard_members))
+            self.shard_members[idx].coordinator.submit_external(obj)
+            return
         for ha in self.coordinators:
             if ha.elector.is_leader:
                 ha.submit_external(obj)
@@ -190,6 +242,9 @@ class Cluster:
                 k.bootstrap(now)
             self._kwok_bootstrapped = True
         bound = sum(ha.tick(now) for ha in self.coordinators)
+        bound += sum(m.tick(now) for m in self.shard_members)
+        if self._rebalancer is not None and self._reb_elector.tick(now):
+            self._rebalancer.run_once(now)
         kwok = [k.tick(now) for k in self.kwoks]
         if now >= self._next_compact:
             # Windowed compaction like the apiserver's: compact away
@@ -312,6 +367,11 @@ class Cluster:
             self.webhook.stop()
         for ha in self.coordinators:
             ha.stop()
+        for m in self.shard_members:
+            try:
+                m.close()
+            except Exception:
+                pass
         for k in self.kwoks:
             k.close()
         for c in self._clients:

@@ -171,6 +171,7 @@ class Coordinator:
         adaptive_batch: bool = False,
         watch_queue_cap: int = DEEP_WATCH_QUEUE,
         score_pct: int = 100,
+        intake_filter=None,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -247,8 +248,34 @@ class Coordinator:
         self._nodes_watch: Watcher | None = None
         self._pods_watch: Watcher | None = None
         self.unschedulable: dict[str, PodInfo] = {}
+        # Shard-set hooks (control/shardset.py): pods whose key fails the
+        # intake filter are another shard's to schedule (their binds are
+        # still tracked as external); the row mask restricts candidate
+        # rows to this shard's slice of the node space.
+        self.intake_filter = intake_filter
+        self._row_mask_np: np.ndarray | None = None
+        self._row_mask_dev = None
 
         _LIVE.add(self)
+
+    def set_row_mask(self, mask: np.ndarray | None) -> None:
+        """Install (or clear) the owned-node mask for sharded scheduling.
+
+        The mask is a traced argument of the packed step, so rebalancing
+        (flipping bits) never recompiles — the TPU re-expression of the
+        reference's node-label rebalancer moving nodes between replicas
+        (reference cmd/dist-scheduler/leader_activities.go:227-343)."""
+        if mask is None:
+            self._row_mask_np = None
+            self._row_mask_dev = None
+            return
+        mask = np.ascontiguousarray(np.asarray(mask, bool))
+        if mask.shape != (self.table_spec.max_nodes,):
+            raise ValueError(
+                f"row mask shape {mask.shape} != ({self.table_spec.max_nodes},)"
+            )
+        self._row_mask_np = mask
+        self._row_mask_dev = jax.device_put(mask)
 
     # ---- bootstrap -----------------------------------------------------
 
@@ -333,6 +360,10 @@ class Coordinator:
         if pod.scheduler_name != self.scheduler_name:
             # Not ours to schedule (the reference's webhook/watch intake
             # applies the same schedulerName filter, webhook.go:102-125).
+            return
+        if self.intake_filter is not None and not self.intake_filter(pod.key):
+            # Another shard's pod (pod-hash intake partition); its bind
+            # arrives via watch and is accounted as external above.
             return
         if pod.key in self._queued_keys or pod.key in self._bound:
             # _bound: a webhook-intake pod can bind before its original
@@ -564,6 +595,10 @@ class Coordinator:
                 continue
             if pod.node_name or pod.scheduler_name != self.scheduler_name:
                 continue
+            if self.intake_filter is not None and not self.intake_filter(
+                pod.key
+            ):
+                continue
             if pod.key in self._queued_keys or pod.key in self._bound:
                 continue
             self._queued_keys.add(pod.key)
@@ -634,6 +669,7 @@ class Coordinator:
                 sample_offset=(
                     self._next_window() if self._sample_rows else 0
                 ),
+                row_mask=self._row_mask_dev,
             )
         # Start the device->host copy of the bind decision now: by the
         # time _complete runs (a drain + encode later), the bytes are

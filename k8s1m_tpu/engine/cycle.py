@@ -317,17 +317,22 @@ def _schedule_batch_impl(
     k: int,
     backend: str = "xla",
     with_affinity: bool = True,
+    src: NodeTable | None = None,
 ):
+    # ``src`` (default: the table itself) is the candidate-selection view;
+    # binds always commit into ``table`` — the split that makes ownership
+    # masks (mask_rows) work without touching commit state.
+    src = table if src is None else src
     if backend == "pallas":
         from k8s1m_tpu.ops.pallas_topk import pallas_candidates
 
         cand = pallas_candidates(
-            table, batch, key, profile, chunk=chunk, k=k,
+            src, batch, key, profile, chunk=chunk, k=k,
             with_affinity=with_affinity,
         )
     else:
         cand = filter_score_topk(
-            table, batch, key, profile,
+            src, batch, key, profile,
             chunk=chunk, k=k, constraints=constraints,
         )
     return finalize_batch(table, constraints, cand, commit_fields_of(batch))
@@ -399,11 +404,22 @@ def schedule_batch(
     return table, cons, asg
 
 
+def mask_rows(table, row_mask):
+    """A candidate-selection view where rows outside ``row_mask`` are
+    infeasible on both backends: ``valid`` feeds the XLA filter chain and
+    ``pods_alloc == 0`` is the fused kernel's row-validity convention.
+    Commit state is untouched — binds land in the unmasked table."""
+    return table.replace(
+        valid=table.valid & row_mask,
+        pods_alloc=jnp.where(row_mask, table.pods_alloc, 0),
+    )
+
+
 @functools.lru_cache(maxsize=256)
 def _jitted_schedule_packed(
     profile: Profile, chunk: int, k: int, with_constraints: bool,
     backend: str, pod_spec, table_spec, groups: frozenset,
-    sample_rows: int | None,
+    sample_rows: int | None, with_mask: bool = False,
 ):
     from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
 
@@ -411,12 +427,14 @@ def _jitted_schedule_packed(
     # fused kernel entirely; the packed field groups already say so.
     aff = bool(groups & {"sel", "req", "pref"})
 
-    def impl(table, ints, bools, key, offset, constraints):
+    def impl(table, ints, bools, key, offset, row_mask, constraints):
         batch = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
+        src = table if row_mask is None else mask_rows(table, row_mask)
         if sample_rows is None:
             table, cons, asg = _schedule_batch_impl(
                 table, batch, key, constraints, profile, chunk, k, backend,
                 with_affinity=aff,
+                src=None if row_mask is None else src,
             )
         else:
             # percentageOfNodesToScore: filter+score only a rotating
@@ -428,7 +446,7 @@ def _jitted_schedule_packed(
             # to global.
             view = jax.tree.map(
                 lambda a: lax.dynamic_slice_in_dim(a, offset, sample_rows, 0),
-                table,
+                src,
             )
             if backend == "pallas":
                 from k8s1m_tpu.ops.pallas_topk import pallas_candidates
@@ -453,13 +471,19 @@ def _jitted_schedule_packed(
         rows = jnp.where(asg.bound, asg.node_row, -1).astype(jnp.int32)
         return table, cons, asg, rows
 
-    if with_constraints:
+    if with_constraints and with_mask:
+        fn = impl
+    elif with_constraints:
         fn = lambda table, ints, bools, key, offset, constraints: impl(
-            table, ints, bools, key, offset, constraints
+            table, ints, bools, key, offset, None, constraints
+        )
+    elif with_mask:
+        fn = lambda table, ints, bools, key, offset, row_mask: impl(
+            table, ints, bools, key, offset, row_mask, None
         )
     else:
         fn = lambda table, ints, bools, key, offset: impl(
-            table, ints, bools, key, offset, None
+            table, ints, bools, key, offset, None, None
         )
     return jax.jit(fn)
 
@@ -476,6 +500,7 @@ def schedule_batch_packed(
     backend: str = "xla",
     sample_rows: int | None = None,
     sample_offset: int = 0,
+    row_mask=None,
 ):
     """schedule_batch over a PackedPodBatch: the pod features cross the
     host->device boundary as two buffers and the bind decision comes back
@@ -488,6 +513,12 @@ def schedule_batch_packed(
     (the caller rotates the offset).  The offset is a traced scalar — no
     recompile per window.  Not supported with constraint state (spread /
     inter-pod affinity need global domain statistics).
+
+    ``row_mask`` (bool[N] device array) restricts candidate selection to
+    the masked rows — the node-space sharding predicate of a scheduler
+    shard set (control/shardset.py): every shard holds the full table,
+    ownership is a mask, rebalancing flips mask bits instead of moving
+    table data.  Traced, so reassignment never recompiles.
 
     Returns (new_table, new_constraints, Assignment, rows).
     """
@@ -504,8 +535,12 @@ def schedule_batch_packed(
     step = _jitted_schedule_packed(
         profile, chunk, k, constraints is not None, backend,
         packed.spec, packed.table_spec, packed.groups, sample_rows,
+        row_mask is not None,
     )
     offset = np.int32(sample_offset)
-    if constraints is None:
-        return step(table, packed.ints, packed.bools, key, offset)
-    return step(table, packed.ints, packed.bools, key, offset, constraints)
+    args = (table, packed.ints, packed.bools, key, offset)
+    if row_mask is not None:
+        args += (row_mask,)
+    if constraints is not None:
+        args += (constraints,)
+    return step(*args)

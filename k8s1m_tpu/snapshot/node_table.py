@@ -155,6 +155,21 @@ class NodeTableHost:
         self._row_of: dict[str, int] = {}
         self._free_rows: list[int] = []
         self._next_row = 0
+        # Bumped on every row->name mapping change (new node, removal,
+        # row reuse) — consumers holding derived per-row state (the shard
+        # set's ownership mask) refresh when this moves.
+        self.epoch = 0
+        # Opt-in delta journal of those changes: (name, row, alive)
+        # appended in order, so a consumer can update per-row state
+        # incrementally instead of re-scanning 1M rows per change.  The
+        # consumer owns draining it (enable_row_journal returns the list;
+        # clear after consuming).
+        self._row_journal: list[tuple[str, int, bool]] | None = None
+
+    def enable_row_journal(self) -> list[tuple[str, int, bool]]:
+        if self._row_journal is None:
+            self._row_journal = []
+        return self._row_journal
 
     # ---- row management -------------------------------------------------
 
@@ -174,6 +189,9 @@ class NodeTableHost:
                 )
             self._next_row += 1
         self._row_of[name] = row
+        self.epoch += 1
+        if self._row_journal is not None:
+            self._row_journal.append((name, row, True))
         return row
 
     def alloc_rows(self, names: list[str]) -> np.ndarray:
@@ -264,6 +282,9 @@ class NodeTableHost:
         ):
             arr[row] = 0
         self._free_rows.append(row)
+        self.epoch += 1
+        if self._row_journal is not None:
+            self._row_journal.append((name, row, False))
         return row
 
     def add_pod(self, name: str, cpu_milli: int, mem_kib: int) -> None:

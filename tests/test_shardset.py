@@ -1,0 +1,277 @@
+"""Scheduler shard set: pod-hash intake partition + node-space masks +
+leader rebalancing (control/shardset.py).
+
+Tick-driven multi-coordinator correctness over one shared store — the
+unit-scale analogue of the reference's 256 cooperating dist-scheduler
+replicas with leader-driven node-label rebalancing (reference
+pkg/schedulerset/schedulerset.go:130-143,
+cmd/dist-scheduler/leader_activities.go:227-343).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
+from k8s1m_tpu.control.shardset import (
+    NUM_GROUPS,
+    Assignment,
+    Rebalancer,
+    ShardMember,
+    group_of,
+    init_assignment,
+    load_assignment,
+    pod_shard,
+    rebalance_groups,
+)
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.node_table import NodeInfo
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+from k8s1m_tpu.store.native import MemStore
+
+PROFILE = Profile(topology_spread=0, interpod_affinity=0)
+SPEC = TableSpec(max_nodes=64, max_zones=16, max_regions=8)
+PODS = PodSpec(batch=16)
+
+
+@pytest.fixture()
+def store():
+    with MemStore() as s:
+        yield s
+
+
+def put_node(store, name, cpu=4000, mem=8 << 20, pods=32):
+    labels = {"topology.kubernetes.io/zone": "z0"}
+    store.put(
+        node_key(name),
+        encode_node(
+            NodeInfo(name=name, cpu_milli=cpu, mem_kib=mem, pods=pods,
+                     labels=labels)
+        ),
+    )
+
+
+def put_pod(store, name, ns="default", cpu=100, mem=200 << 10):
+    store.put(
+        pod_key(ns, name),
+        encode_pod(PodInfo(name=name, namespace=ns, cpu_milli=cpu, mem_kib=mem)),
+    )
+
+
+def make_member(store, idx, n, **kw):
+    kw.setdefault("with_constraints", False)
+    c = Coordinator(store, SPEC, PODS, PROFILE, chunk=32, k=4, **kw)
+    return ShardMember(store, c, idx, n)
+
+
+def run_until_idle(members, max_ticks=200):
+    """Round-robin member ticks until no member has pending work."""
+    bound = 0
+    now = 0.0
+    for _ in range(max_ticks):
+        now += 1.0
+        progressed = 0
+        for m in members:
+            progressed += m.tick(now)
+        bound += progressed
+        if progressed == 0 and all(
+            not m.coordinator.queue and not m.coordinator._inflights
+            for m in members
+        ):
+            break
+    return bound
+
+
+def bound_node(store, ns, name):
+    kv = store.get(pod_key(ns, name))
+    return json.loads(kv.value)["spec"].get("nodeName")
+
+
+# ---- pure rebalance function ------------------------------------------
+
+
+def test_rebalance_evens_out_and_minimizes_moves():
+    load = np.ones(NUM_GROUPS, np.int64)
+    groups = [0] * NUM_GROUPS                     # everything on shard 0
+    out = rebalance_groups(groups, load, alive={0, 1}, max_moves=1000)
+    c0, c1 = out.count(0), out.count(1)
+    assert abs(c0 - c1) <= 1
+    # Only the groups that had to move moved.
+    assert sum(1 for a, b in zip(groups, out) if a != b) == c1
+
+
+def test_rebalance_respects_move_cap():
+    load = np.ones(NUM_GROUPS, np.int64)
+    out = rebalance_groups([0] * NUM_GROUPS, load, alive={0, 1}, max_moves=5)
+    assert sum(1 for g in out if g == 1) == 5
+
+
+def test_rebalance_evacuates_dead_shards_past_cap():
+    load = np.ones(NUM_GROUPS, np.int64)
+    groups = [g % 3 for g in range(NUM_GROUPS)]
+    out = rebalance_groups(groups, load, alive={0, 1}, max_moves=0)
+    assert all(g in (0, 1) for g in out)          # dead shard 2 fully drained
+
+
+def test_rebalance_noop_when_balanced():
+    load = np.ones(NUM_GROUPS, np.int64)
+    groups = [g % 2 for g in range(NUM_GROUPS)]
+    assert rebalance_groups(groups, load, alive={0, 1}) == groups
+
+
+# ---- multi-coordinator end-to-end -------------------------------------
+
+
+def test_shards_split_pods_and_nodes_disjointly(store):
+    n_shards = 3
+    for i in range(24):
+        put_node(store, f"n{i}")
+    for i in range(60):
+        put_pod(store, f"p{i}")
+    members = [make_member(store, i, n_shards) for i in range(n_shards)]
+    for m in members:
+        m.start(now=0.0)
+
+    # Masks are disjoint and cover every live node.
+    masks = [m.coordinator._row_mask_np for m in members]
+    union = np.zeros_like(masks[0])
+    for a in masks:
+        for b in masks:
+            if a is not b:
+                assert not (a & b).any()
+        union |= a
+    assert union.sum() == 24
+
+    bound = run_until_idle(members)
+    assert bound == 60
+    asg = load_assignment(store)
+    for i in range(60):
+        node = bound_node(store, "default", f"p{i}")
+        assert node is not None, f"p{i} never bound"
+        # The binding shard = the pod's hash shard; it only binds nodes
+        # whose group it owns.
+        shard = pod_shard(f"default/p{i}", n_shards)
+        assert asg.groups[group_of(node)] == shard
+    for m in members:
+        m.close()
+
+
+def test_intake_filter_excludes_foreign_pods(store):
+    for i in range(8):
+        put_node(store, f"n{i}")
+    for i in range(40):
+        put_pod(store, f"p{i}")
+    m = make_member(store, 0, 2)
+    m.start(now=0.0)
+    mine = [i for i in range(40) if pod_shard(f"default/p{i}", 2) == 0]
+    run_until_idle([m])
+    for i in range(40):
+        node = bound_node(store, "default", f"p{i}")
+        if i in mine:
+            assert node is not None
+        else:
+            assert node is None                   # other shard's pod untouched
+    m.close()
+
+
+def test_external_binds_fold_into_every_shard(store):
+    """A pod bound by shard 1 must appear in shard 0's usage accounting."""
+    for i in range(4):
+        put_node(store, f"n{i}")
+    for i in range(20):
+        put_pod(store, f"p{i}", cpu=500)
+    members = [make_member(store, i, 2) for i in range(2)]
+    for m in members:
+        m.start(now=0.0)
+    run_until_idle(members)
+    # Every shard's host table sees ALL bound pods' usage, not just its own.
+    total_req = [int(m.coordinator.host.cpu_req.sum()) for m in members]
+    assert total_req[0] == total_req[1] == 20 * 500
+    for m in members:
+        m.close()
+
+
+def test_rebalancer_rebalances_skew_and_members_follow(store):
+    n_shards = 2
+    for i in range(32):
+        put_node(store, f"n{i}")
+    # Skewed initial assignment: shard 0 owns everything.
+    a = Assignment(1, n_shards, [0] * NUM_GROUPS)
+    store.cas(b"/registry/k8s1m/scheduler-set/assignment", a.encode(),
+              required_version=0)
+    members = [make_member(store, i, n_shards) for i in range(n_shards)]
+    for m in members:
+        m.start(now=0.0)
+    assert members[1].coordinator._row_mask_np.sum() == 0
+
+    reb = Rebalancer(store, members[0].coordinator.host, n_shards,
+                     min_interval=0.0, max_moves=NUM_GROUPS, dead_after=60.0)
+    assert reb.run_once(now=1.0, force=True)
+    # Two ticks: gained groups are claimed one tick after the drop
+    # (drop-before-claim handoff).
+    for t in (2.0, 3.0):
+        for m in members:
+            m.tick(now=t)
+    owned = [int(m.coordinator._row_mask_np.sum()) for m in members]
+    assert sum(owned) == 32
+    assert abs(owned[0] - owned[1]) <= max(2, 32 // 4)
+    for m in members:
+        m.close()
+
+
+def test_rebalancer_evacuates_dead_member(store):
+    n_shards = 2
+    for i in range(16):
+        put_node(store, f"n{i}")
+    members = [make_member(store, i, n_shards) for i in range(n_shards)]
+    for m in members:
+        m.start(now=0.0)
+    # Shard 1 goes silent; shard 0 keeps heartbeating.
+    members[0].heartbeat(now=100.0)
+    reb = Rebalancer(store, members[0].coordinator.host, n_shards,
+                     min_interval=0.0, dead_after=15.0)
+    assert reb.run_once(now=100.0, force=True)
+    members[0].tick(now=101.0)
+    members[0].tick(now=102.0)      # deferred claim lands on the 2nd tick
+    assert members[0].coordinator._row_mask_np.sum() == 16
+    for m in members:
+        m.close()
+
+
+def test_init_assignment_races_converge(store):
+    a1 = init_assignment(store, 3)
+    a2 = init_assignment(store, 3)
+    assert a1.groups == a2.groups and a1.version == a2.version
+
+
+def test_drop_before_claim_handoff(store):
+    """During a rebalance, the donor drops a group before the receiver
+    claims it — at no tick do two masks overlap, and moved nodes are
+    briefly owned by nobody rather than by both."""
+    for i in range(16):
+        put_node(store, f"n{i}")
+    a = Assignment(1, 2, [0] * NUM_GROUPS)     # shard 0 owns everything
+    store.cas(b"/registry/k8s1m/scheduler-set/assignment", a.encode(),
+              required_version=0)
+    members = [make_member(store, i, 2) for i in range(2)]
+    for m in members:
+        m.start(now=0.0)
+    reb = Rebalancer(store, members[0].coordinator.host, 2,
+                     min_interval=0.0, max_moves=NUM_GROUPS, dead_after=60.0)
+    assert reb.run_once(now=1.0, force=True)
+
+    for m in members:
+        m.tick(now=2.0)
+    m0, m1 = (m.coordinator._row_mask_np for m in members)
+    assert not (m0 & m1).any()
+    assert m1.sum() == 0                        # receiver has not claimed yet
+    assert m0.sum() < 16                        # donor already dropped
+
+    for m in members:
+        m.tick(now=3.0)
+    m0, m1 = (m.coordinator._row_mask_np for m in members)
+    assert not (m0 & m1).any()
+    assert m0.sum() + m1.sum() == 16            # claim landed, full coverage

@@ -134,7 +134,7 @@ def random_pods(rng, b, node_names):
     return pods
 
 
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("seed", range(20))
 def test_differential_masks_and_scores(seed):
     rng = np.random.default_rng(seed)
     n, b = 48, 24

@@ -1,0 +1,169 @@
+"""Scaled differential sweep: chunk/padding boundaries at 4K nodes.
+
+The small sweep (test_differential.py) checks every (pod, node) pair
+against the python oracle at 48 nodes; this one runs the same randomized
+generators at 4,096 table rows (8 scan chunks, ~100 invalid padding
+rows), 512-pod batches, and many seeds — the scale where chunk-boundary,
+padding-row, and vocab-overflow bugs live (SURVEY §7's non-negotiable
+sweep at representative scale).
+
+Full-matrix oracle comparison would be ~2M python evals per seed, so the
+checks split by cost:
+- the [B, N] device mask/score matrix is validated against the python
+  oracle on a random SAMPLE of pairs plus every selected candidate;
+- structural invariants (padding rows infeasible, unseen-value selectors
+  never match, top-k = the k best scores of the full matrix, pallas ==
+  XLA scores) are asserted over the WHOLE matrix — they need no python
+  loop.
+"""
+
+import numpy as np
+import pytest
+
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.engine.cycle import filter_score_topk
+from k8s1m_tpu.oracle import oracle_feasible, oracle_score
+from k8s1m_tpu.ops.priority import JITTER_BITS
+from k8s1m_tpu.plugins.registry import Profile, score_and_filter
+from k8s1m_tpu.snapshot import NodeTableHost, PodBatchHost
+
+import jax
+import jax.numpy as jnp
+
+from test_differential import random_nodes, random_pods
+
+SPEC = TableSpec(max_nodes=4096, max_zones=16, max_regions=8, max_taint_ids=64)
+PROFILE = Profile(topology_spread=0, interpod_affinity=0)
+CHUNK = 512
+LIVE_NODES = 4000              # ~96 invalid padding rows
+BATCH = 512
+POD_SPEC = PodSpec(
+    batch=BATCH, aff_terms=2, aff_exprs=2, aff_values=4, pref_terms=2,
+)
+SAMPLED_PAIRS = 4000
+LIVE_PODS = 500                # 12 padding pod slots
+
+
+def build(seed):
+    rng = np.random.default_rng(seed)
+    nodes = random_nodes(rng, LIVE_NODES)
+    host = NodeTableHost(SPEC)
+    requested = {}
+    for nd in nodes:
+        host.upsert(nd)
+        if rng.random() < 0.3:
+            c = int(rng.integers(0, nd.cpu_milli))
+            m = int(rng.integers(0, nd.mem_kib))
+            host.add_pod(nd.name, c, m)
+            requested[nd.name] = (c, m, 1)
+    pods = random_pods(rng, LIVE_PODS, [nd.name for nd in nodes])
+    enc = PodBatchHost(POD_SPEC, SPEC, host.vocab)
+    batch = enc.encode(pods)
+    return rng, nodes, pods, host, requested, batch
+
+
+@pytest.fixture(scope="module")
+def matrix_fn():
+    @jax.jit
+    def fn(table, batch):
+        mask, score = score_and_filter(table, batch, PROFILE)
+        mask = mask & batch.valid[:, None] & table.valid[None, :]
+        return mask, jnp.where(mask, score, -1)
+
+    return fn
+
+
+@pytest.fixture(scope="module")
+def topk_fn():
+    @jax.jit
+    def fn(table, batch, key):
+        return filter_score_topk(table, batch, key, PROFILE, chunk=CHUNK, k=4)
+
+    return fn
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_scaled_differential(seed, matrix_fn, topk_fn):
+    rng, nodes, pods, host, requested, batch = build(seed)
+    table = host.to_device()
+    mask, score = matrix_fn(table, batch)
+    mask, score = np.asarray(mask), np.asarray(score)
+
+    # Padding rows (invalid) and padding pod slots are infeasible
+    # everywhere.
+    valid_rows = np.asarray(table.valid)
+    assert not mask[:, ~valid_rows].any()
+    assert not mask[len(pods):].any()
+
+    # Sampled oracle agreement across the full [B, N] extent — the
+    # sample is uniform, so chunk edges and high row indices are covered.
+    rows = {nd.name: host.row_of(nd.name) for nd in nodes}
+    bi = rng.integers(0, len(pods), SAMPLED_PAIRS)
+    ni = rng.integers(0, len(nodes), SAMPLED_PAIRS)
+    for b, n in zip(bi, ni):
+        nd, pod = nodes[n], pods[b]
+        j = rows[nd.name]
+        req = requested.get(nd.name, (0, 0, 0))
+        want = oracle_feasible(nd, pod, req)
+        assert mask[b, j] == want, (
+            f"seed {seed}: mask mismatch pod {pod.name} node {nd.name}"
+        )
+        if want:
+            ws = oracle_score(nd, pod, req, taint_slots=SPEC.taint_slots)
+            assert score[b, j] == ws, (
+                f"seed {seed}: score mismatch pod {pod.name} node {nd.name}"
+            )
+
+    # Top-k candidates: all feasible, packed score matches the matrix,
+    # and the k candidates are exactly the k best scores per pod.
+    cand = topk_fn(table, batch, jax.random.key(seed))
+    idx = np.asarray(cand.idx)
+    prio = np.asarray(cand.prio)
+    name_by_row = {r: n for n, r in rows.items()}
+    node_by_name = {nd.name: nd for nd in nodes}
+    for b in range(len(pods)):
+        feasible = int(mask[b].sum())
+        expect_k = min(4, feasible)
+        assert (prio[b] >= 0).sum() == expect_k
+        order = np.sort(score[b][mask[b]])[::-1]
+        for j in range(expect_k):
+            row = idx[b, j]
+            assert mask[b, row], f"seed {seed}: infeasible candidate"
+            assert score[b, row] == prio[b, j] >> JITTER_BITS
+            # Candidate pairs get the full python-oracle treatment.
+            nd = node_by_name[name_by_row[row]]
+            req = requested.get(nd.name, (0, 0, 0))
+            assert oracle_feasible(nd, pods[b], req)
+            assert score[b, row] == oracle_score(
+                nd, pods[b], req, taint_slots=SPEC.taint_slots
+            )
+        np.testing.assert_array_equal(
+            np.sort(prio[b, :expect_k] >> JITTER_BITS)[::-1], order[:expect_k]
+        )
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+def test_scaled_pallas_matches_xla(seed, matrix_fn):
+    """The fused kernel at multi-chunk scale: same feasible count and the
+    same k best integer scores as the XLA matrix, affinity included."""
+    from k8s1m_tpu.ops.pallas_topk import fused_topk
+
+    _, nodes, pods, host, _, batch = build(seed)
+    table = host.to_device()
+    mask, score = matrix_fn(table, batch)
+    mask, score = np.asarray(mask), np.asarray(score)
+
+    idx, prio = fused_topk(
+        table, batch, jnp.int32(seed), PROFILE, chunk=CHUNK, k=4
+    )
+    idx, prio = np.asarray(idx), np.asarray(prio)
+    for b in range(len(pods)):
+        expect_k = min(4, int(mask[b].sum()))
+        assert (prio[b] >= 0).sum() == expect_k
+        order = np.sort(score[b][mask[b]])[::-1]
+        for j in range(expect_k):
+            assert mask[b, idx[b, j]]
+            assert score[b, idx[b, j]] == prio[b, j] >> JITTER_BITS
+        np.testing.assert_array_equal(
+            np.sort(prio[b, :expect_k] >> JITTER_BITS)[::-1], order[:expect_k]
+        )

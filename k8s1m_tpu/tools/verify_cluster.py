@@ -1,0 +1,113 @@
+"""Cluster verification helpers: the kwok repo's shell one-liners as
+store-native tools (reference kwok/count_ready.sh, kwok/find-gaps.sh).
+
+- ``count-ready`` tallies nodes by Ready condition and pods by phase
+  (count_ready.sh pipes ``kubectl get nodes`` through awk|sort|uniq).
+- ``find-gaps`` scans kwok-node-<i> / any <prefix>-<i> numbering for
+  holes — the smoke test that make_nodes/make_pods delivered a dense
+  index range (find-gaps.sh's awk gap detector).
+
+Both stream the store with paginated keys-only/value ranges rather than
+materializing the object list, so they stay cheap at 1M objects.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import re
+import sys
+
+from k8s1m_tpu.store.native import prefix_end
+
+NODES_PREFIX = b"/registry/minions/"
+PODS_PREFIX = b"/registry/pods/"
+
+
+def _scan(store, prefix: bytes, *, keys_only: bool = False, limit: int = 5000):
+    """Yield KVs under a prefix in paginated ranges."""
+    start, end = prefix, prefix_end(prefix)
+    while True:
+        res = store.range(start, end, limit=limit, keys_only=keys_only)
+        yield from res.kvs
+        if not res.more or not res.kvs:
+            return
+        start = res.kvs[-1].key + b"\x00"
+
+
+def count_ready(store) -> dict:
+    """{'nodes': {status: count}, 'pods': {phase: count}}."""
+    nodes: collections.Counter = collections.Counter()
+    for kv in _scan(store, NODES_PREFIX):
+        try:
+            obj = json.loads(kv.value)
+            ready = "Unknown"
+            for cond in obj.get("status", {}).get("conditions", []):
+                if cond.get("type") == "Ready":
+                    ready = cond.get("status", "Unknown")
+            nodes["Ready" if ready == "True" else f"NotReady({ready})"] += 1
+        except Exception:
+            nodes["undecodable"] += 1
+    pods: collections.Counter = collections.Counter()
+    for kv in _scan(store, PODS_PREFIX):
+        try:
+            obj = json.loads(kv.value)
+            phase = obj.get("status", {}).get("phase", "Pending")
+            if not obj.get("spec", {}).get("nodeName"):
+                phase = f"{phase}(unbound)"
+            pods[phase] += 1
+        except Exception:
+            pods["undecodable"] += 1
+    return {"nodes": dict(nodes), "pods": dict(pods)}
+
+
+def find_gaps(store, prefix: bytes = NODES_PREFIX, pattern: str = r"-(\d+)$"):
+    """Missing indices in a dense <name>-<i> keyspace; list of (lo, hi)
+    inclusive gap ranges."""
+    rx = re.compile(pattern.encode())
+    seen = []
+    for kv in _scan(store, prefix, keys_only=True):
+        m = rx.search(kv.key)
+        if m:
+            seen.append(int(m.group(1)))
+    seen.sort()
+    gaps = []
+    for a, b in zip(seen, seen[1:]):
+        if b != a and b != a + 1:
+            gaps.append((a + 1, b - 1))
+    return gaps
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="cluster state verification")
+    ap.add_argument("--target", default=None,
+                    help="remote store addr (default: in-process test store)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("count-ready")
+    g = sub.add_parser("find-gaps")
+    g.add_argument("--prefix", default="/registry/minions/")
+    args = ap.parse_args(argv)
+
+    if args.target:
+        from k8s1m_tpu.store.remote import RemoteStore
+
+        store = RemoteStore(args.target)
+    else:
+        ap.error("--target is required outside tests")
+    try:
+        if args.cmd == "count-ready":
+            print(json.dumps(count_ready(store)))
+        else:
+            gaps = find_gaps(store, args.prefix.encode())
+            for lo, hi in gaps:
+                print(f"Gap detected: {lo} to {hi}")
+            print(json.dumps({"gaps": len(gaps)}))
+            return 1 if gaps else 0
+    finally:
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -172,3 +172,54 @@ def test_kwok_waiting_parking_lot_is_bounded(env):
         assert sum(len(w) for w in c._waiting.values()) <= 16
     finally:
         kc.MAX_WAITING_PODS = old
+
+
+def test_verify_cluster_counts_and_gaps():
+    """count_ready / find_gaps: the kwok verification one-liners."""
+    from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
+    from k8s1m_tpu.snapshot import NodeInfo, PodInfo
+    from k8s1m_tpu.store.native import MemStore
+    from k8s1m_tpu.tools.verify_cluster import count_ready, find_gaps
+
+    with MemStore() as store:
+        for i in (0, 1, 2, 4, 5, 9):        # holes at 3, 6-8
+            store.put(
+                node_key(f"kwok-node-{i}"),
+                encode_node(NodeInfo(name=f"kwok-node-{i}", cpu_milli=1000,
+                                     mem_kib=1 << 20, pods=8)),
+            )
+        store.put(pod_key("default", "a-0"),
+                  encode_pod(PodInfo("a-0", cpu_milli=1, mem_kib=1)))
+        bound = json.loads(encode_pod(PodInfo("a-1", cpu_milli=1, mem_kib=1)))
+        bound["spec"]["nodeName"] = "kwok-node-0"
+        bound["status"] = {"phase": "Running"}
+        store.put(pod_key("default", "a-1"), json.dumps(bound).encode())
+
+        counts = count_ready(store)
+        assert sum(counts["nodes"].values()) == 6
+        assert counts["pods"].get("Running") == 1
+        assert counts["pods"].get("Pending(unbound)") == 1
+
+        assert find_gaps(store) == [(3, 3), (6, 8)]
+
+
+def test_docs_build_renders_site(tmp_path):
+    from k8s1m_tpu.tools.docs_build import build, md_to_html
+
+    html_out = md_to_html(
+        "# Title\n\npara with `code` and **bold**\n\n"
+        "| a | b |\n|---|---|\n| 1 | [x](other.md) |\n\n"
+        "```\nliteral <tags> & stuff\n```\n- item\n"
+    )
+    assert "<h1>Title</h1>" in html_out
+    assert "<code>code</code>" in html_out and "<strong>bold</strong>" in html_out
+    assert "<table>" in html_out and '<a href="other.html">x</a>' in html_out
+    assert "literal &lt;tags&gt; &amp; stuff" in html_out
+    assert "<li>item</li>" in html_out
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "README.md").write_text("# Hello\n\ndocs body\n")
+    written = build(repo, tmp_path / "site", ["README.md", "MISSING.md"])
+    assert set(written) == {"readme.html", "index.html"}
+    assert "docs body" in (tmp_path / "site" / "readme.html").read_text()

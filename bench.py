@@ -6,10 +6,18 @@ Prints ONE JSON line:
 Baseline (BASELINE.md): the reference's 1M-node run schedules ~14K pods/s
 on 289 scheduler replicas / 8,670 AMD Turin cores (reference
 README.adoc:730,783-787).  This measures the TPU scheduling cycle on the
-single real chip: filter+score over all 1M nodes per batch, top-k,
-conflict resolution, capacity commit — i.e. the work the Go fleet spreads
-over 256 shards, minus the apiserver bind write (which the reference also
-excludes from its scheduling-rate metric).
+single real chip: filter+score+top-k, conflict resolution, capacity
+commit — i.e. the work the Go fleet spreads over 256 shards, minus the
+apiserver bind write (which the reference also excludes from its
+scheduling-rate metric).
+
+``--score-pct`` defaults to 5 — the SAME percentageOfNodesToScore the
+reference's production 1M-node configuration runs (reference
+terraform/kubernetes/dist-scheduler.tf:562, README.adoc:525-531), so the
+headline number is apples-to-apples with the 14K/s baseline: each batch
+filters+scores one rotating chunk-aligned ~5% window of the table and
+commits binds into the full table.  ``--score-pct 100`` scores every
+node for every pod (20x the per-pod work of the baseline config).
 """
 
 from __future__ import annotations
@@ -23,7 +31,11 @@ import numpy as np
 
 from k8s1m_tpu.config import PodSpec, TableSpec
 from k8s1m_tpu.cluster import populate_kwok_nodes, uniform_pods
-from k8s1m_tpu.engine.cycle import schedule_batch
+from k8s1m_tpu.engine.cycle import (
+    sample_offset_for,
+    sample_rows_for,
+    schedule_batch_packed,
+)
 from k8s1m_tpu.plugins.registry import Profile
 from k8s1m_tpu.snapshot import NodeTableHost, PodBatchHost
 
@@ -41,6 +53,12 @@ def main():
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument(
+        "--score-pct", type=int, default=None,
+        help="percentageOfNodesToScore (default 5, the reference's "
+        "production 1M config — constraint plugins included: domain "
+        "statistics stay global, only candidate scan follows the window)",
+    )
     ap.add_argument(
         "--backend", choices=("xla", "pallas"), default=None,
         help="filter+score+top-k backend; pallas is the fused kernel "
@@ -73,6 +91,12 @@ def main():
         # Sweet spots: VMEM-sized tiles for the fused kernel, bigger scan
         # chunks for the XLA path.
         args.chunk = (1 << 12) if args.backend == "pallas" else (1 << 14)
+    if args.score_pct is None:
+        args.score_pct = 5
+    if not 1 <= args.score_pct <= 100:
+        ap.error("--score-pct must be in [1, 100]")
+    # Rotating sample window, the coordinator's exact rule (engine helpers).
+    sample_rows = sample_rows_for(args.nodes, args.score_pct, args.chunk)
 
     spec = TableSpec(max_nodes=args.nodes)
     host = NodeTableHost(spec)
@@ -129,53 +153,55 @@ def main():
         )
         pods = uniform_pods(args.batch)
 
-    # Uniform pods carry no selectors, so the base config compiles the
-    # selector-free kernel (the packed production path derives the same
-    # flag per wave from its field groups).
-    with_affinity = bool(args.affinity)
-
     enc = PodBatchHost(pod_spec, spec, host.vocab)
     table = host.to_device()
-    batch = enc.encode(pods)
-    key = jax.random.key(0)
+    packed = enc.encode_packed(pods)
+    # The production coordinator path: packed pod buffers in, one i32[B]
+    # bind-row array out (engine schedule_batch_packed — also the path
+    # that supports the rotating percentageOfNodesToScore window).
+    # schedule_batch_packed jits internally; keys are pre-split and bind
+    # counts stay on-device so the loop is pure async dispatch.
+    # Keys pre-split into a host list so the timed loop dispatches ONLY
+    # the scheduling step (a device-array index or a separate count
+    # program would each add a relay round trip per step).
+    keys = list(jax.random.split(jax.random.key(0), args.warmup + args.steps))
 
-    # One jitted step; bind counts stay on-device until the end so the
-    # timing loop is pure async dispatch (matching production use, where
-    # the coordinator pipelines batches and reads assignments in bulk).
-    # NB: the batch is an *argument*, never a closure — device arrays
-    # captured as jit constants are re-uploaded per call on this backend
-    # (~90ms/call through the axon relay).
-    @jax.jit
-    def step(table, constraints, batch, key):
-        k1, k2 = jax.random.split(key)
-        table, constraints, asg = schedule_batch(
-            table, batch, k1, profile=profile, constraints=constraints,
+    def window(i: int) -> int:
+        if sample_rows is None:
+            return 0
+        return sample_offset_for(i, args.nodes, sample_rows)
+
+    def step(table, constraints, i):
+        table, constraints, _asg, rows = schedule_batch_packed(
+            table, packed, keys[i], profile=profile, constraints=constraints,
             chunk=args.chunk, k=args.k, backend=args.backend,
-            with_affinity=with_affinity,
+            sample_rows=sample_rows, sample_offset=window(i),
         )
-        return table, constraints, k2, asg.bound.sum(dtype=jax.numpy.int32)
+        return table, constraints, rows
 
     t0 = time.perf_counter()
-    for _ in range(args.warmup):
-        table, constraints, key, bound = step(table, constraints, batch, key)
-    jax.device_get(bound)
+    for i in range(args.warmup):
+        table, constraints, rows = step(table, constraints, i)
+    jax.device_get(rows)
     warm_s = time.perf_counter() - t0
 
     # NB: the final sync must be a device_get INSIDE the timed window —
     # on this backend jax.block_until_ready returns before the deferred
     # relay work has actually executed, which silently turns the loop
     # into a dispatch-rate benchmark (~70x optimistic).
-    counts = []
+    all_rows = []
     t0 = time.perf_counter()
-    for _ in range(args.steps):
-        table, constraints, key, bound = step(table, constraints, batch, key)
-        counts.append(bound)
-    # Sync on the LAST count only: it depends on the whole table chain, so
+    for i in range(args.steps):
+        table, constraints, rows = step(table, constraints, args.warmup + i)
+        all_rows.append(rows)
+    # Sync on the LAST wave only: it depends on the whole table chain, so
     # fetching it forces every step — without paying one fetch round trip
-    # per step inside the window.
-    jax.device_get(counts[-1])
+    # per step inside the window.  Counting happens on host, after.
+    jax.device_get(all_rows[-1])
     elapsed = time.perf_counter() - t0
-    total_bound = int(np.sum(jax.device_get(counts)))
+    total_bound = int(sum(
+        (np.asarray(jax.device_get(r)) >= 0).sum() for r in all_rows
+    ))
 
     binds_per_sec = total_bound / elapsed
     if args.verbose:
@@ -190,6 +216,10 @@ def main():
         else "_affinity" if args.affinity
         else ""
     )
+    if sample_rows is not None:
+        # Only when a window is actually in effect: chunk rounding can
+        # promote a small table's pct window to a full scan.
+        suffix += f"_pct{args.score_pct}"
     print(json.dumps({
         "metric": f"pod_binds_per_sec_{args.nodes}_nodes{suffix}",
         "value": round(binds_per_sec, 1),

@@ -56,6 +56,8 @@ from k8s1m_tpu.engine.cycle import (
     adjust_constraints,
     commit_fields_np,
     commit_fields_of,
+    sample_offset_for,
+    sample_rows_for,
     schedule_batch_packed,
 )
 from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram
@@ -106,9 +108,9 @@ _BIND_LATENCY = Histogram(
     # Finer than the default pow2 ladder in the SLO range: the default's
     # 164ms -> 328ms jump makes a ~170ms p50 report as 328.
     buckets=(
-        0.005, 0.01, 0.02, 0.03, 0.04, 0.05, 0.065, 0.08, 0.1, 0.13,
-        0.165, 0.2, 0.25, 0.33, 0.42, 0.55, 0.7, 0.9, 1.2, 1.6, 2.1,
-        2.8, 3.7, 5.0, 8.0, 15.0, 30.0, 60.0,
+        0.005, 0.01, 0.02, 0.03, 0.04, 0.05, 0.06, 0.07, 0.08, 0.09,
+        0.1, 0.11, 0.13, 0.165, 0.2, 0.25, 0.33, 0.42, 0.55, 0.7, 0.9,
+        1.2, 1.6, 2.1, 2.8, 3.7, 5.0, 8.0, 15.0, 30.0, 60.0,
     ),
 )
 
@@ -195,15 +197,9 @@ class Coordinator:
         # filters+scores one rotating chunk-aligned window of the table.
         if not 1 <= score_pct <= 100:
             raise ValueError(f"score_pct must be in [1, 100], got {score_pct}")
-        if score_pct < 100 and with_constraints:
-            raise ValueError(
-                "score_pct < 100 requires with_constraints=False (spread/"
-                "inter-pod affinity need global domain statistics)"
-            )
-        n = table_spec.max_nodes
-        rows = -(-n * score_pct // 100)             # ceil
-        rows = -(-rows // chunk) * chunk            # round up to chunk
-        self._sample_rows = None if rows >= n else rows
+        self._sample_rows = sample_rows_for(
+            table_spec.max_nodes, score_pct, chunk
+        )
         self._window_i = 0
 
         self.host = NodeTableHost(table_spec)
@@ -645,15 +641,11 @@ class Coordinator:
         return batch_pods, batch
 
     def _next_window(self) -> int:
-        """Rotating sample-window offset covering every row over
-        ceil(N/S) cycles (the tail window is anchored at N-S)."""
-        n = self.table_spec.max_nodes
-        s = self._sample_rows
-        w = n // s
-        total = w + (1 if n % s else 0)
-        i = self._window_i % total
+        i = self._window_i
         self._window_i += 1
-        return n - s if i == w else i * s
+        return sample_offset_for(
+            i, self.table_spec.max_nodes, self._sample_rows
+        )
 
     def _launch(self, batch_pods, batch):
         """Enqueue the device step for an encoded batch (async — no

@@ -404,6 +404,25 @@ def schedule_batch(
     return table, cons, asg
 
 
+def sample_rows_for(nodes: int, score_pct: int, chunk: int) -> int | None:
+    """percentageOfNodesToScore -> chunk-aligned window rows (None = the
+    rounded window covers the whole table, i.e. scan everything)."""
+    if score_pct >= 100:
+        return None
+    rows = -(-nodes * score_pct // 100)          # ceil
+    rows = -(-rows // chunk) * chunk             # round up to chunk
+    return None if rows >= nodes else rows
+
+
+def sample_offset_for(i: int, nodes: int, rows: int) -> int:
+    """Rotating window offset covering every row over ceil(N/S) steps
+    (the tail window is anchored at N-S)."""
+    w = nodes // rows
+    total = w + (1 if nodes % rows else 0)
+    i %= total
+    return nodes - rows if i == w else i * rows
+
+
 def mask_rows(table, row_mask):
     """A candidate-selection view where rows outside ``row_mask`` are
     infeasible on both backends: ``valid`` feeds the XLA filter chain and
@@ -456,8 +475,25 @@ def _jitted_schedule_packed(
                     with_affinity=aff,
                 )
             else:
+                stats = None
+                view_cons = None
+                if constraints is not None:
+                    # Constraint plugins under sampling: domain statistics
+                    # are GLOBAL reductions over the full count tables
+                    # (the prologue never depended on the scan window);
+                    # only the per-node count columns follow the window.
+                    from k8s1m_tpu.plugins import topology
+                    from k8s1m_tpu.snapshot.constraints import (
+                        slice_constraints,
+                    )
+
+                    stats = topology.prologue(table, constraints)
+                    view_cons = slice_constraints(
+                        constraints, offset, sample_rows
+                    )
                 cand = filter_score_topk(
-                    view, batch, key, profile, chunk=chunk, k=k
+                    view, batch, key, profile, chunk=chunk, k=k,
+                    constraints=view_cons, stats=stats,
                 )
             cand = cand.replace(
                 idx=jnp.where(cand.idx >= 0, cand.idx + offset, -1)
@@ -511,8 +547,11 @@ def schedule_batch_packed(
     ``sample_rows``/``sample_offset`` implement percentageOfNodesToScore:
     only rows [offset, offset+sample_rows) are filtered+scored this cycle
     (the caller rotates the offset).  The offset is a traced scalar — no
-    recompile per window.  Not supported with constraint state (spread /
-    inter-pod affinity need global domain statistics).
+    recompile per window.  Works with constraint state: domain statistics
+    are global prologue reductions over the full count tables, so only
+    the per-node count columns follow the window (the reference's
+    production config runs the full plugin set at pct 5 the same way,
+    dist-scheduler.tf:551-570).
 
     ``row_mask`` (bool[N] device array) restricts candidate selection to
     the masked rows — the node-space sharding predicate of a scheduler
@@ -530,8 +569,6 @@ def schedule_batch_packed(
                 "backend='pallas' requires a stateless profile and no "
                 "constraint state (see ops/pallas_topk.py)"
             )
-    if sample_rows is not None and constraints is not None:
-        raise ValueError("node sampling requires constraints=None")
     step = _jitted_schedule_packed(
         profile, chunk, k, constraints is not None, backend,
         packed.spec, packed.table_spec, packed.groups, sample_rows,

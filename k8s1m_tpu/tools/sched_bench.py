@@ -53,6 +53,11 @@ def parse_args(argv=None):
     )
     ap.add_argument("--no-pipeline", action="store_true")
     ap.add_argument(
+        "--depth", type=int, default=2,
+        help="scheduling pipeline depth (in-flight waves; >2 helps when "
+        "the device round trip dominates the wave, e.g. a remote relay)",
+    )
+    ap.add_argument(
         "--churn", action="store_true",
         help="BASELINE config 5 shape: delete the pods bound two waves "
         "ago while new waves arrive — sustained create+delete churn "
@@ -98,7 +103,7 @@ def main(argv=None):
     coord = Coordinator(
         store, TableSpec(max_nodes=cap), PodSpec(batch=args.batch),
         profile, chunk=args.chunk, with_constraints=False,
-        backend=args.backend, pipeline=not args.no_pipeline,
+        backend=args.backend, pipeline=not args.no_pipeline, depth=args.depth,
         score_pct=args.score_pct, adaptive_batch=bool(args.rate),
     )
     t0 = time.perf_counter()

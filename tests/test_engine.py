@@ -101,3 +101,42 @@ def test_chunking_invariant_scores():
     _, _, a2 = schedule_batch(table, batch, jax.random.key(0), profile=PROFILE,chunk=16)
     np.testing.assert_array_equal(np.asarray(a1.score), np.asarray(a2.score))
     np.testing.assert_array_equal(np.asarray(a1.bound), np.asarray(a2.bound))
+
+
+def test_sampled_window_with_constraints_matches_full():
+    """percentageOfNodesToScore + constraint plugins: a window covering
+    every valid row must reproduce the full-scan result bit-for-bit
+    (domain statistics are global prologue reductions either way)."""
+    from k8s1m_tpu.cluster.workload import spread_deployment
+    from k8s1m_tpu.engine.cycle import schedule_batch_packed
+    from k8s1m_tpu.snapshot.constraints import (
+        ConstraintTracker,
+        empty_constraints,
+    )
+
+    spec = TableSpec(max_nodes=128, max_zones=8, max_regions=4)
+    host = NodeTableHost(spec)
+    for i in range(64):                      # rows 64..127 stay invalid
+        host.upsert(NodeInfo(
+            name=f"n{i}", cpu_milli=4000, mem_kib=1 << 20, pods=16,
+            labels={"topology.kubernetes.io/zone": f"z{i % 4}"},
+        ))
+    tracker = ConstraintTracker(spec)
+    pods = spread_deployment(tracker, "d", 24, topo=1)
+    enc = PodBatchHost(PodSpec(batch=32), spec, host.vocab)
+    packed = enc.encode_packed(pods)
+    key = jax.random.key(3)
+    profile = Profile()
+
+    outs = []
+    for sample_rows in (None, 64):
+        table = host.to_device()
+        cons = empty_constraints(spec)
+        t, c, asg, rows = schedule_batch_packed(
+            table, packed, key, profile=profile, constraints=cons,
+            chunk=32, k=4, sample_rows=sample_rows, sample_offset=0,
+        )
+        outs.append((np.asarray(rows), np.asarray(c.spread_zone)))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+    assert (outs[0][0] >= 0).sum() == 24

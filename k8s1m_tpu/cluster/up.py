@@ -35,6 +35,9 @@ def parse_args(argv=None):
 
 
 def main(argv=None):
+    from k8s1m_tpu.envboot import tune_gc
+
+    tune_gc()
     args = parse_args(argv)
     spec = ClusterSpec(
         nodes=args.nodes,

@@ -68,6 +68,9 @@ from k8s1m_tpu.snapshot.node_table import NodeTableHost
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
 from k8s1m_tpu.store.native import (
     BIND_INVALID,
+    POD_CANONICAL,
+    POD_HAS_NODE,
+    POD_SCHED_MATCH,
     MemStore,
     Watcher,
     drain_events_light,
@@ -115,13 +118,21 @@ _BIND_LATENCY = Histogram(
 )
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class PendingPod:
-    pod: PodInfo
+    # None = native-intake fast lane: the pod is canonical and label-less
+    # (store/native.py poll_pods parsed it in C), so the full PodInfo is
+    # materialized only if a slow path actually needs it (ensure_pod).
+    pod: PodInfo | None
     # None = webhook intake: the object wasn't persisted at admission
     # time, so the bind path resolves the live revision instead.
     mod_revision: int | None
     enqueued_at: float
+    # Scheduling-relevant scalars, always populated (from the native
+    # parse or from the PodInfo) so the hot bind path never touches pod.
+    cpu_milli: int = 0
+    mem_kib: int = 0
+    key_str: str = ""        # "<ns>/<name>"
     attempts: int = 0
     # Raw stored bytes at intake revision — lets the bind CAS splice
     # nodeName into the bytes without a JSON decode/encode round trip.
@@ -129,6 +140,15 @@ class PendingPod:
     # Store key bytes, captured at intake so the bind wave never
     # re-formats /registry/pods/<ns>/<name> per pod.
     key_bytes: bytes = b""
+
+    def ensure_pod(self) -> PodInfo:
+        if self.pod is None:
+            ns, name = self.key_str.split("/", 1)
+            self.pod = PodInfo(
+                name=name, namespace=ns,
+                cpu_milli=self.cpu_milli, mem_kib=self.mem_kib,
+            )
+        return self.pod
 
 
 # Structural splice marker: encode_pod always opens spec with
@@ -223,6 +243,12 @@ class Coordinator:
 
         self.queue: collections.deque[PendingPod] = collections.deque()
         self._queued_keys: set[str] = set()
+        self._sched_bytes = scheduler_name.encode()
+        # Per-namespace tracker matches for the EMPTY label set, keyed by
+        # the tracker's registration counts (registration only grows).
+        # Label-less pods can still match constraints whose selector is
+        # empty; the fast lane must not lose those.
+        self._empty_incs_cache: dict[tuple[int, int, str], tuple] = {}
         # Webhook-intake staging: appended from server threads, drained
         # into the queue at the top of each cycle (deque+set aren't
         # thread-safe to mutate from the handler directly).
@@ -243,6 +269,10 @@ class Coordinator:
         self._dirty_rows: set[int] = set()
         self._nodes_watch: Watcher | None = None
         self._pods_watch: Watcher | None = None
+        # True when the store's bind_batch can suppress our own watch
+        # echo (native store only; set at bootstrap once the pods watch
+        # exists — its id is read at every bind so resync stays correct).
+        self._bind_excludes = False
         self.unschedulable: dict[str, PodInfo] = {}
         # Shard-set hooks (control/shardset.py): pods whose key fails the
         # intake filter are another shard's to schedule (their binds are
@@ -296,6 +326,7 @@ class Coordinator:
                 PODS_PREFIX, prefix_end(PODS_PREFIX),
                 start_revision=pods.revision + 1, queue_cap=self.watch_queue_cap,
             )
+            self._bind_excludes = isinstance(self._pods_watch, Watcher)
             self.table = self.host.to_device()
 
     # ---- watch delta application --------------------------------------
@@ -371,7 +402,9 @@ class Coordinator:
         self._queued_keys.add(pod.key)
         self.queue.append(
             PendingPod(
-                pod, mod_revision, time.perf_counter(), raw=data,
+                pod, mod_revision, time.perf_counter(),
+                cpu_milli=pod.cpu_milli, mem_kib=pod.mem_kib,
+                key_str=pod.key, raw=data,
                 key_bytes=key or pod_key(pod.namespace, pod.name),
             )
         )
@@ -445,8 +478,25 @@ class Coordinator:
         the row->node mapping — so it is safe to run while a wave is in
         flight.  Drain to (momentarily) empty: a single capped poll per
         cycle would let backlog accumulate into an overflow resync under
-        heavy churn; drain_events_light's bound keeps the cycle live
-        against a producer that outruns the decode pass."""
+        heavy churn; the per-call bound keeps the cycle live against a
+        producer that outruns the decode pass.
+
+        The native store's poll_pods drains AND parses canonical pods in
+        C (columnar arrays, no per-event Python objects); watchers
+        without it (RemoteWatcher) take the per-event decode path."""
+        if getattr(self._pods_watch, "poll_pods", None) is not None:
+            n = 0
+            batch = min(max_events, 10000)
+            with _CYCLE_TIME.time(stage="drain"):
+                while True:
+                    evb = self._pods_watch.poll_pods(
+                        batch, self._sched_bytes
+                    )
+                    if evb.n:
+                        self._apply_pod_batch(evb)
+                        n += evb.n
+                    if evb.n < batch or n >= 20 * max_events:
+                        return n
         n = 0
         with _CYCLE_TIME.time(stage="drain"):
             for etype, key, value, mrev in drain_events_light(
@@ -458,6 +508,131 @@ class Coordinator:
                 else:
                     self._on_pod_delete(key)
         return n
+
+    def _apply_pod_batch(self, evb) -> None:
+        """Apply one columnar poll_pods drain (store/native.py
+        PodEventBatch).  Flag semantics decided natively: CANONICAL means
+        the C parser accepted the exact encode_pod shape (label-less);
+        everything else falls back to _on_pod_put's full decode."""
+        plen = len(PODS_PREFIX)
+        koff = evb.koff.tolist()
+        kb = evb.key_blob
+        etype = evb.etype
+        flags = evb.flags
+        # The fast lane: canonical pending pods for this scheduler.
+        fast = POD_CANONICAL | POD_SCHED_MATCH
+        fastmask = (etype == 0) & (
+            (flags & (fast | POD_HAS_NODE)) == fast
+        )
+        now = time.perf_counter()
+        tr = self.tracker
+        has_constraints = bool(tr._spread or tr._affinity)
+        if fastmask.all() and not has_constraints:
+            # Pure create wave (the make_pods steady state): one batched
+            # tolist per column, no per-event branching.
+            cpu_l = evb.cpu.tolist()
+            mem_l = evb.mem.tolist()
+            mrev_l = evb.mrev.tolist()
+            queued = self._queued_keys
+            bound = self._bound
+            q = self.queue
+            filt = self.intake_filter
+            for i in range(evb.n):
+                key = kb[koff[i] : koff[i + 1]]
+                ks = key[plen:].decode()
+                if ks in queued or ks in bound:
+                    continue
+                if filt is not None and not filt(ks):
+                    continue
+                queued.add(ks)
+                q.append(PendingPod(
+                    None, mrev_l[i], now,
+                    cpu_milli=cpu_l[i], mem_kib=mem_l[i],
+                    key_str=ks, key_bytes=key,
+                ))
+            return
+        aoff = evb.aoff.tolist()
+        ab = evb.aux_blob
+        cpu_l = evb.cpu.tolist()
+        mem_l = evb.mem.tolist()
+        mrev_l = evb.mrev.tolist()
+        flags_l = flags.tolist()
+        etype_l = etype.tolist()
+        for i in range(evb.n):
+            key = kb[koff[i] : koff[i + 1]]
+            if etype_l[i] == 1:
+                self._on_pod_delete(key)
+                continue
+            f = flags_l[i]
+            if not f & POD_CANONICAL:
+                self._on_pod_put(ab[aoff[i] : aoff[i + 1]], mrev_l[i], key)
+                continue
+            ks = key[plen:].decode()
+            if f & POD_HAS_NODE:
+                # A bind: ours echoing back (suppressed at the store for
+                # native binds, but the slow _bind path still echoes), or
+                # an external writer's.
+                if ks in self._bound:
+                    self._queued_keys.discard(ks)
+                    continue
+                node_name = ab[aoff[i] : aoff[i + 1]].decode()
+                ns, name = ks.split("/", 1)
+                pod = PodInfo(
+                    name=name, namespace=ns,
+                    cpu_milli=cpu_l[i], mem_kib=mem_l[i],
+                    node_name=node_name,
+                )
+                if has_constraints:
+                    si, ii = self._empty_incs(ns)
+                    pod.spread_incs = list(si)
+                    pod.ipa_incs = list(ii)
+                if node_name in self.host._row_of:
+                    self._orphan_bound.pop(ks, None)
+                    self.host.add_pod(node_name, pod.cpu_milli, pod.mem_kib)
+                    self._dirty_rows.add(self.host.row_of(node_name))
+                    self._note_bound(pod, node_name, external=True)
+                else:
+                    self._orphan_bound[ks] = pod
+                self._queued_keys.discard(ks)
+                continue
+            if not f & POD_SCHED_MATCH:
+                continue
+            if ks in self._queued_keys or ks in self._bound:
+                continue
+            if self.intake_filter is not None and not self.intake_filter(ks):
+                continue
+            pod = None
+            if has_constraints:
+                ns, name = ks.split("/", 1)
+                si, ii = self._empty_incs(ns)
+                if si or ii:
+                    # Matches an empty-selector constraint: not plain.
+                    pod = PodInfo(
+                        name=name, namespace=ns,
+                        cpu_milli=cpu_l[i], mem_kib=mem_l[i],
+                    )
+                    pod.spread_incs = list(si)
+                    pod.ipa_incs = list(ii)
+            self._queued_keys.add(ks)
+            self.queue.append(PendingPod(
+                pod, mrev_l[i], now,
+                cpu_milli=cpu_l[i], mem_kib=mem_l[i],
+                key_str=ks, key_bytes=key,
+            ))
+
+    def _empty_incs(self, namespace: str) -> tuple:
+        """Cached tracker matches for a label-less pod in ``namespace``
+        (cache key includes the registration counts, which only grow)."""
+        tr = self.tracker
+        key = (len(tr._spread), len(tr._affinity), namespace)
+        incs = self._empty_incs_cache.get(key)
+        if incs is None:
+            incs = (
+                tuple(tr.spread_matches(namespace, {})),
+                tuple(tr.affinity_matches(namespace, {})),
+            )
+            self._empty_incs_cache[key] = incs
+        return incs
 
     def resync(self) -> int:
         """Full relist after watch overflow: reconcile host state against
@@ -601,6 +776,8 @@ class Coordinator:
             self.queue.append(
                 PendingPod(
                     pod, None, time.perf_counter(),
+                    cpu_milli=pod.cpu_milli, mem_kib=pod.mem_kib,
+                    key_str=pod.key,
                     key_bytes=pod_key(pod.namespace, pod.name),
                 )
             )
@@ -633,11 +810,20 @@ class Coordinator:
         while self.queue and len(batch_pods) < self.pod_spec.batch:
             batch_pods.append(self.queue.popleft())
         for p in batch_pods:
-            self._queued_keys.discard(p.pod.key)
+            self._queued_keys.discard(p.key_str)
         with _CYCLE_TIME.time(stage="encode"):
-            batch = self._encoder_for(len(batch_pods)).encode_packed(
-                [p.pod for p in batch_pods]
-            )
+            enc = self._encoder_for(len(batch_pods))
+            if all(p.pod is None for p in batch_pods):
+                # Native-intake fast lane: a wave of plain pods encodes
+                # from two int columns, no per-pod Python.
+                batch = enc.encode_packed_plain(
+                    [p.cpu_milli for p in batch_pods],
+                    [p.mem_kib for p in batch_pods],
+                )
+            else:
+                batch = enc.encode_packed(
+                    [p.ensure_pod() for p in batch_pods]
+                )
         return batch_pods, batch
 
     def _next_window(self) -> int:
@@ -742,7 +928,12 @@ class Coordinator:
                 failed[i] = True
                 self._retry(p)
             if wave:
-                results = self.store.bind_batch(entries)
+                if self._bind_excludes:
+                    results = self.store.bind_batch(
+                        entries, self._pods_watch.id
+                    )
+                else:
+                    results = self.store.bind_batch(entries)
                 now = time.perf_counter()
                 ok_rows: list[int] = []
                 ok_cpu: list[int] = []
@@ -751,14 +942,17 @@ class Coordinator:
                 bound_dict = self._bound
                 for (i, p, name, row, zone, region), rev in zip(wave, results):
                     if rev > 0:
-                        pod = p.pod
                         ok_rows.append(row)
-                        ok_cpu.append(pod.cpu_milli)
-                        ok_mem.append(pod.mem_kib)
+                        ok_cpu.append(p.cpu_milli)
+                        ok_mem.append(p.mem_kib)
                         lats.append(now - p.enqueued_at)
-                        keep = pod if self._constraintful(pod) else None
-                        bound_dict[pod.key] = (
-                            name, pod.cpu_milli, pod.mem_kib, zone, region, keep,
+                        keep = (
+                            p.pod
+                            if p.pod is not None and self._constraintful(p.pod)
+                            else None
+                        )
+                        bound_dict[p.key_str] = (
+                            name, p.cpu_milli, p.mem_kib, zone, region, keep,
                         )
                         continue
                     if rev == BIND_INVALID and self._bind(p, name):
@@ -868,7 +1062,7 @@ class Coordinator:
 
     def _bind(self, p: PendingPod, node_name: str) -> bool:
         """CAS spec.nodeName into the pod object; False on conflict."""
-        key = pod_key(p.pod.namespace, p.pod.name)
+        key = p.key_bytes
         if p.mod_revision is not None and p.raw is not None:
             # Fast path: splice nodeName into the intake-revision bytes.
             # The CAS itself proves the object hasn't changed since, so
@@ -881,8 +1075,8 @@ class Coordinator:
                 if not ok:
                     _PODS_SCHEDULED.inc(outcome="conflict")
                     return False
-                self.host.add_pod(node_name, p.pod.cpu_milli, p.pod.mem_kib)
-                self._note_bound(p.pod, node_name, external=False)
+                self.host.add_pod(node_name, p.cpu_milli, p.mem_kib)
+                self._note_bound(p.ensure_pod(), node_name, external=False)
                 _PODS_SCHEDULED.inc(outcome="bound")
                 return True
         cur = self.store.get(key)
@@ -914,8 +1108,8 @@ class Coordinator:
             return False
         # Keep host accounting; the watch echo of our own write is
         # deduped via _bound.
-        self.host.add_pod(node_name, p.pod.cpu_milli, p.pod.mem_kib)
-        self._note_bound(p.pod, node_name, external=False)
+        self.host.add_pod(node_name, p.cpu_milli, p.mem_kib)
+        self._note_bound(p.ensure_pod(), node_name, external=False)
         _PODS_SCHEDULED.inc(outcome="bound")
         return True
 
@@ -923,26 +1117,29 @@ class Coordinator:
         p.attempts += 1
         if p.attempts >= self.max_attempts:
             _PODS_SCHEDULED.inc(outcome="unschedulable")
-            self.unschedulable[p.pod.key] = p.pod
+            self.unschedulable[p.key_str] = p.ensure_pod()
             return
         _PODS_SCHEDULED.inc(outcome="retry")
         # Re-read AND re-decode: the CAS may have failed because an external
         # writer bound the pod (retrying would overwrite their bind and
         # double-account) or changed its spec (retrying with stale
         # cpu/mem would overcommit the node).
-        cur = self.store.get(pod_key(p.pod.namespace, p.pod.name))
+        cur = self.store.get(p.key_bytes)
         if cur is None:
             return
         fresh = decode_pod(cur.value, self.tracker)
         if fresh.node_name:
             return  # bound externally; the watch echo handles accounting
         p.pod = fresh
+        p.cpu_milli = fresh.cpu_milli
+        p.mem_kib = fresh.mem_kib
+        p.key_str = fresh.key
         p.mod_revision = cur.mod_revision
         # Refresh the splice-source bytes too — stale raw at the new
         # revision would CAS the OLD object body back in, silently
         # reverting whatever spec change made the first CAS fail.
         p.raw = cur.value
-        self._queued_keys.add(p.pod.key)
+        self._queued_keys.add(p.key_str)
         self.queue.append(p)
 
     def close(self) -> None:

@@ -213,6 +213,15 @@ class Histogram(Metric):
         finally:
             self.observe(time.perf_counter() - t0, **labels)
 
+    def sum(self, **labels) -> float:
+        """Total of observed values for one label set (bench reporting)."""
+        with self._lock:
+            return self._sums.get(self._key(labels), 0.0)
+
+    def label_keys(self) -> list[tuple]:
+        with self._lock:
+            return list(self._counts)
+
     def quantile(self, q: float, **labels) -> float:
         """Approximate quantile from bucket upper bounds (test/bench helper)."""
         key = self._key(labels)

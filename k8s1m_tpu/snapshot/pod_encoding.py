@@ -369,6 +369,41 @@ class PodBatchHost:
             ints, bools, out, self.spec, self.table_spec, groups
         )
 
+    def encode_packed_plain(self, cpu, mem) -> PackedPodBatch:
+        """Packed encode of a wave of *plain* pods (no selectors,
+        tolerations, affinity, or constraint refs) given just their
+        cpu/mem columns — fully vectorized, no per-pod Python.
+
+        This is the native-intake fast lane (store/native.py poll_pods):
+        canonical label-less pods arrive from the watch as int columns,
+        and a wave of them needs exactly two array writes here.  The
+        result is identical to encode_packed on the equivalent PodInfos:
+        a plain pod tolerates nothing (``tolerated`` stays False, like
+        pod_tolerates_taint on an empty toleration list) and sets no
+        selector groups.
+        """
+        specs = batch_field_specs(self.spec, self.table_spec)
+        out = {
+            name: np.zeros(shape, np.bool_ if is_bool else np.int32)
+            for name, is_bool, shape in specs
+        }
+        n = len(cpu)
+        if n > self.spec.batch:
+            raise ValueError(f"{n} pods > batch {self.spec.batch}")
+        out["valid"][:n] = True
+        out["cpu"][:n] = cpu
+        out["mem"][:n] = mem
+        groups: frozenset = frozenset()
+        int_parts, bool_parts = [], []
+        for name, is_bool, _shape in specs:
+            if _GROUP_OF.get(name) is not None:
+                continue
+            (bool_parts if is_bool else int_parts).append(out[name].ravel())
+        return PackedPodBatch(
+            np.concatenate(int_parts), np.concatenate(bool_parts), out,
+            self.spec, self.table_spec, groups,
+        )
+
     def encode(self, pods: list[PodInfo]) -> PodBatch:
         specs = batch_field_specs(self.spec, self.table_spec)
         out = {

@@ -81,6 +81,64 @@ class WatchEvent:
     prev_kv: KeyValue | None = None
 
 
+# ms_watch_poll_pods flag bits (memstore.h MS_POD_*).
+POD_CANONICAL = 1
+POD_HAS_NODE = 2
+POD_SCHED_MATCH = 4
+
+
+@dataclasses.dataclass
+class PodEventBatch:
+    """Columnar view of one ms_watch_poll_pods drain (zero-copy numpy
+    views into the single result buffer; layout in memstore.h)."""
+
+    n: int
+    canceled: bool
+    etype: "object"     # u8[n]   0 PUT, 1 DELETE
+    flags: "object"     # u8[n]   POD_* bits
+    mrev: "object"      # i64[n]
+    cpu: "object"       # i32[n]
+    mem: "object"       # i32[n]
+    koff: "object"      # u32[n+1] offsets into key_blob
+    aoff: "object"      # u32[n+1] offsets into aux_blob
+    key_blob: bytes
+    aux_blob: bytes
+
+    @staticmethod
+    def empty() -> "PodEventBatch":
+        import numpy as np
+
+        z = np.zeros(0, np.uint8)
+        o = np.zeros(1, np.uint32)
+        return PodEventBatch(
+            0, False, z, z, np.zeros(0, np.int64), np.zeros(0, np.int32),
+            np.zeros(0, np.int32), o, o, b"", b"",
+        )
+
+    @staticmethod
+    def parse(data: bytes) -> "PodEventBatch":
+        import numpy as np
+
+        (n,) = _U32.unpack_from(data, 0)
+        canceled = bool(data[4])
+        off = 8
+        etype = np.frombuffer(data, np.uint8, n, off); off += n
+        flags = np.frombuffer(data, np.uint8, n, off); off += n
+        off += (8 - off % 8) % 8
+        mrev = np.frombuffer(data, np.int64, n, off); off += 8 * n
+        cpu = np.frombuffer(data, np.int32, n, off); off += 4 * n
+        mem = np.frombuffer(data, np.int32, n, off); off += 4 * n
+        koff = np.frombuffer(data, np.uint32, n + 1, off); off += 4 * (n + 1)
+        aoff = np.frombuffer(data, np.uint32, n + 1, off); off += 4 * (n + 1)
+        klen = int(koff[-1])
+        key_blob = data[off : off + klen]; off += klen
+        aux_blob = data[off : off + int(aoff[-1])]
+        return PodEventBatch(
+            int(n), canceled, etype, flags, mrev, cpu, mem, koff, aoff,
+            key_blob, aux_blob,
+        )
+
+
 _KV_FIXED = struct.Struct("<IIqqqq")  # klen, vlen, create, mod, version, lease
 _U32 = struct.Struct("<I")
 _U32X2 = struct.Struct("<II")
@@ -176,8 +234,13 @@ def _load_lib():
     ]
     lib.ms_bind_batch.restype = c.c_int
     lib.ms_bind_batch.argtypes = [
-        c.c_void_p, c.c_char_p, c.c_size_t, c.c_int,
+        c.c_void_p, c.c_char_p, c.c_size_t, c.c_int, c.c_int64,
         c.POINTER(c.POINTER(c.c_int64)),
+    ]
+    lib.ms_watch_poll_pods.restype = c.c_int
+    lib.ms_watch_poll_pods.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int, c.c_char_p, c.c_size_t,
+        c.POINTER(P8), c.POINTER(c.c_size_t),
     ]
     lib.ms_wal_sync.restype = c.c_int
     lib.ms_wal_sync.argtypes = [c.c_void_p]
@@ -277,6 +340,30 @@ class Watcher:
                 off += size + pklen + pvlen
             events.append((etype, key, val, mrev))
         return events
+
+    def poll_pods(
+        self, max_events: int = 10000, scheduler_name: bytes = b""
+    ) -> "PodEventBatch":
+        """Native drain + canonical-pod parse (ms_watch_poll_pods): the
+        coordinator's intake firehose comes back as columnar numpy arrays
+        instead of per-event Python objects — ~6x less host time per
+        event than poll_light + decode_pod_fast."""
+        lib = _lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = lib.ms_watch_poll_pods(
+            self._store._h, self.id, max_events,
+            scheduler_name, len(scheduler_name),
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if rc == _ERR_NOT_FOUND:
+            self.canceled = True
+            return PodEventBatch.empty()
+        data = _take_buf(lib, out, out_len)
+        evb = PodEventBatch.parse(data)
+        if evb.canceled:
+            self.canceled = True
+        return evb
 
     @property
     def dropped(self) -> int:
@@ -425,26 +512,32 @@ class MemStore:
         return _lib().ms_put_batch(self._h, frame, len(frame), count, lease)
 
     def bind_batch(
-        self, binds: list[tuple[bytes, int, bytes]]
+        self, binds: list[tuple[bytes, int, bytes]],
+        exclude_watcher: int = -1,
     ) -> list[int]:
         """Splice spec.nodeName into stored pods under mod-revision CAS —
         the whole bind wave in one native call.  ``binds`` entries are
         (key, required_mod, node_name); returns per-entry new revision,
-        or _ERR_CAS / _ERR_INVALID (caller falls back to the slow path)."""
-        rc, results = self.bind_frame(pack_bind_frame(binds), len(binds))
+        or _ERR_CAS / _ERR_INVALID (caller falls back to the slow path).
+        ``exclude_watcher`` suppresses the bind events on that one watcher
+        (the issuing coordinator's own intake — see memstore.h)."""
+        rc, results = self.bind_frame(
+            pack_bind_frame(binds), len(binds), exclude_watcher
+        )
         if rc < 0:
             raise ValueError(f"ms_bind_batch rc={rc}")
         return results
 
     def bind_frame(
-        self, frame: bytes, count: int
+        self, frame: bytes, count: int, exclude_watcher: int = -1
     ) -> tuple[int, list[int]]:
         """bind_batch over a pre-packed frame (see pack_bind_frame).
         Returns (bound_count_or_negative_error, per_record_revisions)."""
         lib = _lib()
         out = ctypes.POINTER(ctypes.c_int64)()
         rc = lib.ms_bind_batch(
-            self._h, frame, len(frame), count, ctypes.byref(out)
+            self._h, frame, len(frame), count, exclude_watcher,
+            ctypes.byref(out)
         )
         if rc < 0:
             return rc, []

@@ -53,6 +53,9 @@ async def amain(args):
 
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
+    from k8s1m_tpu.envboot import tune_gc
+
+    tune_gc()
     asyncio.run(amain(parse_args(argv)))
 
 

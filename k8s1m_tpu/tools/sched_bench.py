@@ -22,6 +22,7 @@ import time
 
 from k8s1m_tpu.config import PodSpec, TableSpec
 from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.envboot import tune_gc
 from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
 from k8s1m_tpu.plugins.registry import Profile
 from k8s1m_tpu.snapshot.pod_encoding import PodInfo
@@ -29,6 +30,22 @@ from k8s1m_tpu.store.native import MemStore
 from k8s1m_tpu.tools.make_nodes import build_node
 
 REFERENCE_E2E = 14_000.0
+
+
+def _print_stage_stats(window_s: float) -> None:
+    """Per-stage coordinator time totals over the measured window."""
+    import sys
+
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    cyc = REGISTRY.get("coordinator_cycle_seconds")
+    for key in sorted(cyc.label_keys()):
+        stage = dict(zip(cyc.labelnames, key)).get("stage", "?")
+        print(
+            f"# stage {stage:10s} {cyc.sum(stage=stage)*1e3:9.1f} ms "
+            f"total ({cyc.sum(stage=stage)/window_s*100:5.1f}% of window)",
+            file=sys.stderr,
+        )
 
 
 def parse_args(argv=None):
@@ -52,6 +69,11 @@ def parse_args(argv=None):
         "config uses 5, terraform tfvars percentageOfNodesToScore: 5)",
     )
     ap.add_argument("--no-pipeline", action="store_true")
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="after the run, print per-stage coordinator time totals "
+        "(drain/encode/device/sync_out/bind) to stderr",
+    )
     ap.add_argument(
         "--depth", type=int, default=2,
         help="scheduling pipeline depth (in-flight waves; >2 helps when "
@@ -163,6 +185,9 @@ def main(argv=None):
                     store.put(kk, vv)
             coord.run_until_idle()
         REGISTRY.get("coordinator_schedule_to_bind_seconds").reset()
+        if args.stats:
+            REGISTRY.get("coordinator_cycle_seconds").reset()
+        tune_gc()
 
         # Paced producer: emit pods on the offered-load schedule, step
         # the coordinator continuously, measure intake-to-bind latency.
@@ -185,6 +210,8 @@ def main(argv=None):
         sched_s = time.perf_counter() - t0
         lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
         e2e = bound / sched_s if sched_s else 0.0
+        if args.stats:
+            _print_stage_stats(sched_s)
         print(json.dumps({
             "metric": f"e2e_p50_bind_ms_{args.nodes}_nodes_at_{args.rate}",
             "value": round(lat.quantile(0.5) * 1e3, 2),
@@ -203,6 +230,9 @@ def main(argv=None):
         return
 
     wave = args.batch
+    if args.stats:
+        REGISTRY.get("coordinator_cycle_seconds").reset()
+    tune_gc()
     t0 = time.perf_counter()
     bound = 0
     off = 1
@@ -233,6 +263,9 @@ def main(argv=None):
 
     lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
     p50_ms = round(lat.quantile(0.5) * 1e3, 2) if lat else None
+
+    if args.stats:
+        _print_stage_stats(sched_s)
 
     suffix = f"_pct{args.score_pct}" if args.score_pct != 100 else ""
     print(json.dumps({

@@ -429,8 +429,14 @@ struct ms_store {
     return MS_ERR_COMPACTED;
   }
 
+  // Watcher id excluded from dispatch for the current write (set only
+  // inside the exclusive ms_bind_batch critical section; -1 = none).
+  // See ms_bind_batch's exclude_watcher contract in memstore.h.
+  int64_t dispatch_exclude = -1;
+
   void dispatch(const std::string& key, const Event& ev) {
     for (auto& [id, w] : watchers) {
+      if (id == dispatch_exclude) continue;
       if (!w->matches(key)) continue;
       if (ev.kv.mod_rev < w->min_rev) continue;
       std::lock_guard<std::mutex> g(w->m);
@@ -803,7 +809,7 @@ bool json_plain(const uint8_t* p, size_t n) {
 }  // namespace
 
 int ms_bind_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
-                  int64_t** out) {
+                  int64_t exclude_watcher, int64_t** out) {
   if (n < 0) return MS_ERR_INVALID;
   // Pre-validate the whole frame (see ms_put_batch): reject atomically
   // before any bind commits.
@@ -825,6 +831,7 @@ int ms_bind_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
   bool fsync_wait = false;
   {
     WGuard g(s, ms_store::M_BIND_BATCH);
+    s->dispatch_exclude = exclude_watcher;
     size_t off = 0;
     std::string spliced;
     for (int i = 0; i < n; i++) {
@@ -874,6 +881,7 @@ int ms_bind_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
       }
       fsync_wait |= fw;
     }
+    s->dispatch_exclude = -1;
   }
   if (fsync_wait && last > 0) s->wal->WaitPersisted(last);
   *out = results;
@@ -1153,6 +1161,185 @@ int ms_watch_poll(ms_store* s, int64_t watcher_id, int max_events,
   }
   *out = to_malloc(b, out_len);
   return static_cast<int>(events.size());
+}
+
+namespace {
+
+// ---- canonical pod fast parser -------------------------------------------
+// The exact byte landmarks of this framework's encode_pod for label-less
+// pods (k8s1m_tpu/control/objects.py decode_pod_fast is the Python twin;
+// the two parsers accept the same inputs so the fast lane and the fallback
+// path can never disagree).  Anything else — labels, selectors, escapes —
+// is left for the caller's full JSON parser.
+constexpr char kPodHead[] =
+    "{\"apiVersion\":\"v1\",\"kind\":\"Pod\",\"metadata\":{\"name\":\"";
+constexpr char kPodNs[] = "\",\"namespace\":\"";
+constexpr char kPodLabels[] = "\",\"labels\":{}},\"spec\":{";
+constexpr char kPodNode[] = "\"nodeName\":\"";
+constexpr char kPodSched[] = "\"schedulerName\":\"";
+constexpr char kPodContainers[] =
+    "\",\"containers\":[{\"name\":\"app\",\"image\":\"img\","
+    "\"resources\":{\"requests\":{\"cpu\":\"";
+constexpr char kPodMem[] = "\",\"memory\":\"";
+constexpr char kPodTail[] = "\"}}}]},\"status\":{\"phase\":\"Pending\"}}";
+constexpr char kPodNodeTail[] = "\"}}}],\"nodeName\":\"";
+constexpr char kPodStatus[] = "\"},\"status\":{\"phase\":\"Pending\"}}";
+
+struct PodParse {
+  bool has_node = false;
+  bool sched_match = false;
+  int32_t cpu = 0, mem = 0;
+  const char* node = nullptr;
+  size_t node_len = 0;
+};
+
+inline bool lit_at(const std::string& v, size_t pos, const char* lit,
+                   size_t lit_len) {
+  return pos + lit_len <= v.size() && memcmp(v.data() + pos, lit, lit_len) == 0;
+}
+
+// Parse an int span with a required suffix; false on overflow/non-digit.
+bool parse_qty(const char* p, size_t n, const char* suffix, size_t suffix_len,
+               int32_t* out) {
+  if (n <= suffix_len || memcmp(p + n - suffix_len, suffix, suffix_len) != 0)
+    return false;
+  n -= suffix_len;
+  if (n == 0 || n > 9) return false;
+  int32_t acc = 0;
+  for (size_t i = 0; i < n; i++) {
+    if (p[i] < '0' || p[i] > '9') return false;
+    acc = acc * 10 + (p[i] - '0');
+  }
+  *out = acc;
+  return true;
+}
+
+bool parse_pod(const std::string& v, const uint8_t* sched, size_t sched_len,
+               PodParse* out) {
+#define LIT(name) name, sizeof(name) - 1
+  if (!lit_at(v, 0, LIT(kPodHead))) return false;
+  if (memchr(v.data(), '\\', v.size()) != nullptr) return false;
+  size_t i = sizeof(kPodHead) - 1;
+  size_t j = v.find('"', i);
+  if (j == std::string::npos || !lit_at(v, j, LIT(kPodNs))) return false;
+  i = j + sizeof(kPodNs) - 1;
+  j = v.find('"', i);
+  if (j == std::string::npos || !lit_at(v, j, LIT(kPodLabels))) return false;
+  i = j + sizeof(kPodLabels) - 1;
+  if (lit_at(v, i, LIT(kPodNode))) {
+    i += sizeof(kPodNode) - 1;
+    j = v.find('"', i);
+    if (j == std::string::npos || !lit_at(v, j, "\",", 2)) return false;
+    out->has_node = true;
+    out->node = v.data() + i;
+    out->node_len = j - i;
+    i = j + 2;
+  }
+  if (!lit_at(v, i, LIT(kPodSched))) return false;
+  i += sizeof(kPodSched) - 1;
+  j = v.find('"', i);
+  if (j == std::string::npos) return false;
+  out->sched_match =
+      (j - i) == sched_len && memcmp(v.data() + i, sched, sched_len) == 0;
+  if (!lit_at(v, j, LIT(kPodContainers))) return false;
+  i = j + sizeof(kPodContainers) - 1;
+  j = v.find('"', i);
+  if (j == std::string::npos || !parse_qty(v.data() + i, j - i, "m", 1, &out->cpu))
+    return false;
+  if (!lit_at(v, j, LIT(kPodMem))) return false;
+  i = j + sizeof(kPodMem) - 1;
+  j = v.find('"', i);
+  if (j == std::string::npos || !parse_qty(v.data() + i, j - i, "Ki", 2, &out->mem))
+    return false;
+  if (v.size() - j == sizeof(kPodTail) - 1 && lit_at(v, j, LIT(kPodTail)))
+    return true;
+  // Bind-spliced form appends nodeName after containers instead.
+  if (out->has_node || !lit_at(v, j, LIT(kPodNodeTail))) return false;
+  i = j + sizeof(kPodNodeTail) - 1;
+  j = v.find('"', i);
+  if (j == std::string::npos) return false;
+  if (v.size() - j != sizeof(kPodStatus) - 1 || !lit_at(v, j, LIT(kPodStatus)))
+    return false;
+  out->has_node = true;
+  out->node = v.data() + i;
+  out->node_len = j - i;
+  return true;
+#undef LIT
+}
+
+}  // namespace
+
+int ms_watch_poll_pods(ms_store* s, int64_t watcher_id, int max_events,
+                       const uint8_t* sched, size_t sched_len, uint8_t** out,
+                       size_t* out_len) {
+  std::shared_ptr<Watcher> w;
+  {
+    RGuard g(s, ms_store::M_WATCH);
+    auto it = s->watchers.find(watcher_id);
+    if (it != s->watchers.end()) w = it->second;
+  }
+  if (!w) return MS_ERR_NOT_FOUND;
+
+  std::vector<Event> events;
+  bool canceled;
+  {
+    std::unique_lock<std::mutex> g(w->m);
+    canceled = w->canceled;
+    while (!w->q.empty() && static_cast<int>(events.size()) < max_events) {
+      events.push_back(std::move(w->q.front()));
+      w->q.pop_front();
+    }
+  }
+
+  const size_t n = events.size();
+  std::vector<uint8_t> etype(n), flags(n);
+  std::vector<int64_t> mrev(n);
+  std::vector<int32_t> cpu(n, 0), mem(n, 0);
+  std::vector<uint32_t> koff(n + 1, 0), aoff(n + 1, 0);
+  std::string keys, aux;
+  for (size_t i = 0; i < n; i++) {
+    const Event& ev = events[i];
+    etype[i] = ev.type;
+    mrev[i] = ev.kv.mod_rev;
+    keys.append(ev.key);
+    koff[i + 1] = static_cast<uint32_t>(keys.size());
+    uint8_t f = 0;
+    if (ev.type == 0 && ev.kv.val) {
+      PodParse p;
+      if (parse_pod(*ev.kv.val, sched, sched_len, &p)) {
+        f |= MS_POD_CANONICAL;
+        if (p.sched_match) f |= MS_POD_SCHED_MATCH;
+        if (p.has_node) {
+          f |= MS_POD_HAS_NODE;
+          aux.append(p.node, p.node_len);
+        }
+        cpu[i] = p.cpu;
+        mem[i] = p.mem;
+      } else {
+        aux.append(*ev.kv.val);
+      }
+    }
+    flags[i] = f;
+    aoff[i + 1] = static_cast<uint32_t>(aux.size());
+  }
+
+  std::string b;
+  b.reserve(8 + 2 * n + 8 + 16 * n + 8 * (n + 1) + keys.size() + aux.size());
+  put_u32(b, static_cast<uint32_t>(n));
+  put_u8(b, canceled ? 1 : 0);
+  b.append(3, '\0');
+  b.append(reinterpret_cast<const char*>(etype.data()), n);
+  b.append(reinterpret_cast<const char*>(flags.data()), n);
+  b.append((8 - (b.size() % 8)) % 8, '\0');
+  b.append(reinterpret_cast<const char*>(mrev.data()), 8 * n);
+  b.append(reinterpret_cast<const char*>(cpu.data()), 4 * n);
+  b.append(reinterpret_cast<const char*>(mem.data()), 4 * n);
+  b.append(reinterpret_cast<const char*>(koff.data()), 4 * (n + 1));
+  b.append(reinterpret_cast<const char*>(aoff.data()), 4 * (n + 1));
+  b.append(keys);
+  b.append(aux);
+  *out = to_malloc(b, out_len);
+  return static_cast<int>(n);
 }
 
 int64_t ms_watch_dropped(ms_store* s, int64_t watcher_id) {

@@ -111,9 +111,18 @@ int64_t ms_put_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
  * MS_ERR_CAS (revision mismatch / key absent), or MS_ERR_INVALID (value
  * not spliceable or name needs JSON escaping — caller falls back to its
  * slow path).  Returns the number of successful binds, or MS_ERR_INVALID
- * on a malformed buffer. */
+ * on a malformed buffer.
+ *
+ * exclude_watcher (-1 = none): watcher id whose queue should NOT receive
+ * the bind events from this wave.  A scheduling coordinator passes its
+ * own pod watcher here: it already accounted the binds it just issued,
+ * and at 20K+ binds/s the echo events are half the watch firehose.  The
+ * reference's scheduler cache solves the same problem by assuming the
+ * pod before the informer echo arrives (its informer then dedups against
+ * the assumed state); suppressing at the dispatch point is the
+ * store-native equivalent.  All other watchers observe every event. */
 int ms_bind_batch(ms_store* s, const uint8_t* buf, size_t len, int n,
-                  int64_t** out);
+                  int64_t exclude_watcher, int64_t** out);
 
 /* ---- reads ------------------------------------------------------------ */
 
@@ -173,6 +182,44 @@ int ms_watch_cancel(ms_store* s, int64_t watcher_id);
  * (MS_ERR_NOT_FOUND for unknown/canceled watcher). */
 int ms_watch_poll(ms_store* s, int64_t watcher_id, int max_events,
                   int timeout_ms, uint8_t** out, size_t* out_len);
+
+/* Drain + parse pod events in one call — the scheduling coordinator's
+ * intake firehose.  Same queue semantics as ms_watch_poll (non-blocking,
+ * max_events bound), but each PUT value in the canonical encoded-pod
+ * shape (the exact byte shape this framework's encode_pod emits for
+ * label-less pods, including the bind-spliced form — the restricted
+ * fast-parser contract, mirroring how the reference supports exactly the
+ * one Txn shape Kubernetes emits, reference kv_service.rs:126-337) is
+ * parsed natively, so the consumer never JSON-decodes its own steady-
+ * state traffic.  Non-canonical values are returned whole for the
+ * caller's full parser.
+ *
+ * sched/sched_len: expected spec.schedulerName; parsed pods are flagged
+ * with MS_POD_SCHED_MATCH when equal.
+ *
+ * Columnar result buffer layout (little-endian; sections in order):
+ *   u32 n | u8 canceled | u8 pad[3]
+ *   u8  etype[n]            0 PUT, 1 DELETE
+ *   u8  flags[n]            MS_POD_* bits below
+ *   u8  pad[(-2n) mod 8]
+ *   i64 mod_revision[n]
+ *   i32 cpu_milli[n]        0 unless canonical
+ *   i32 mem_kib[n]
+ *   u32 key_off[n+1]        offsets into the key blob
+ *   u32 aux_off[n+1]        offsets into the aux blob
+ *   key blob | aux blob
+ * aux holds: node name (canonical PUT with nodeName), the whole value
+ * (non-canonical PUT), or nothing (canonical PUT without nodeName,
+ * DELETE).  Returns the event count or MS_ERR_NOT_FOUND. */
+int ms_watch_poll_pods(ms_store* s, int64_t watcher_id, int max_events,
+                       const uint8_t* sched, size_t sched_len, uint8_t** out,
+                       size_t* out_len);
+
+enum {
+  MS_POD_CANONICAL = 1,  /* value parsed natively; cpu/mem/flags valid */
+  MS_POD_HAS_NODE = 2,   /* spec.nodeName present (aux = node name) */
+  MS_POD_SCHED_MATCH = 4 /* spec.schedulerName == sched argument */
+};
 
 /* Events dropped on this watcher because its queue (10,000 deep, like
  * reference store.rs:27) overflowed; the server should cancel such

@@ -296,3 +296,74 @@ def test_pipelined_matches_unpipelined_accounting(store):
         assert c.host.pods_req[c.host.row_of(name)] == count
     assert c.host.pods_req.sum() == 64
     assert int(np.asarray(c.table.pods_req).sum()) == 64
+
+
+def test_fast_lane_pending_pods_have_no_podinfo(store):
+    """Canonical label-less pods ride the native intake: the coordinator
+    queues them without materializing PodInfo, and scheduling still binds
+    them correctly."""
+    for i in range(4):
+        put_node(store, f"n{i}")
+    c = make_coord(store)
+    c.bootstrap()
+    for i in range(8):
+        put_pod(store, f"fast-{i}", cpu=10)
+    c.drain_watches()
+    assert len(c.queue) == 8
+    assert all(p.pod is None for p in c.queue)
+    assert {p.key_str for p in c.queue} == {
+        f"default/fast-{i}" for i in range(8)
+    }
+    assert c.run_until_idle() == 8
+    res = store.range(b"/registry/pods/", prefix_end(b"/registry/pods/"))
+    for kv in res.kvs:
+        assert json.loads(kv.value)["spec"].get("nodeName")
+
+
+def test_fast_lane_respects_empty_selector_constraints(store):
+    """A topologySpreadConstraint with an empty selector matches label-less
+    pods; the fast lane must still record the constraint increments (the
+    invariant: PendingPod.pod is None only for pods with no tracker
+    matches)."""
+    from k8s1m_tpu.config import SPREAD_DO_NOT_SCHEDULE, TOPO_ZONE
+    from k8s1m_tpu.snapshot.pod_encoding import SpreadConstraintRef
+
+    for i in range(4):
+        put_node(store, f"n{i}", zone=f"z{i % 2}")
+    c = Coordinator(store, SPEC, PODS, Profile(interpod_affinity=0),
+                    chunk=64, k=4, with_constraints=True)
+    # Register an empty-selector spread constraint before intake.
+    slot = c.tracker.spread_slot("default", {}, TOPO_ZONE)
+    c.bootstrap()
+    for i in range(6):
+        put_pod(store, f"sp-{i}")
+    c.drain_watches()
+    assert len(c.queue) == 6
+    # Empty-selector match forces the slow-lane PodInfo with incs.
+    for p in c.queue:
+        assert p.pod is not None
+        assert (slot, TOPO_ZONE) in p.pod.spread_incs
+    assert c.run_until_idle() == 6
+
+
+def test_fast_lane_external_bind_accounting(store):
+    """A bind written by an external writer (canonical spliced shape)
+    arrives via the fast lane and is accounted exactly like the slow
+    path: capacity assumed, _bound recorded, dedup against re-queue."""
+    from k8s1m_tpu.control.coordinator import splice_node_name
+
+    for i in range(2):
+        put_node(store, f"n{i}")
+    c = make_coord(store)
+    c.bootstrap()
+    raw = encode_pod(PodInfo("ext", cpu_milli=70, mem_kib=512))
+    store.put(pod_key("default", "ext"), splice_node_name(raw, "n1"))
+    c.drain_watches()
+    assert not c.queue
+    assert c._bound["default/ext"][0] == "n1"
+    row = c.host.row_of("n1")
+    assert c.host.cpu_req[row] == 70 and c.host.pods_req[row] == 1
+    # The delete decrements it again.
+    store.delete(pod_key("default", "ext"))
+    c.drain_watches()
+    assert c.host.pods_req[row] == 0 and c.host.cpu_req[row] == 0

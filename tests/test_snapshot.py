@@ -167,3 +167,33 @@ def test_pod_encoding():
     # unknown nodeName must match nothing, not "unset"
     ghost = enc.encode([PodInfo(name="g", node_name="no-such-node")])
     assert int(ghost.node_name_id[0]) == -1
+
+
+def test_encode_packed_plain_matches_encode_packed():
+    """The native-intake fast lane's columnar encode must be bit-identical
+    to encode_packed over the equivalent plain PodInfos — including when
+    the vocab holds taints (a plain pod tolerates nothing either way)."""
+    import numpy as np
+
+    from k8s1m_tpu.config import PodSpec, TableSpec
+    from k8s1m_tpu.snapshot.node_table import NodeTableHost, NodeInfo, Taint
+    from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
+
+    spec = TableSpec(max_nodes=8)
+    host = NodeTableHost(spec)
+    host.upsert(NodeInfo(name="n0", taints=[Taint("k", "v", 1)]))
+    enc = PodBatchHost(PodSpec(batch=8), spec, host.vocab)
+
+    cpu = [100, 250, 1]
+    mem = [1024, 2048, 7]
+    pods = [
+        PodInfo(f"p{i}", cpu_milli=c, mem_kib=m)
+        for i, (c, m) in enumerate(zip(cpu, mem))
+    ]
+    a = enc.encode_packed(pods)
+    b = enc.encode_packed_plain(cpu, mem)
+    assert a.groups == b.groups == frozenset()
+    np.testing.assert_array_equal(a.ints, b.ints)
+    np.testing.assert_array_equal(a.bools, b.bools)
+    for name in a.fields:
+        np.testing.assert_array_equal(a.fields[name], b.fields[name], name)

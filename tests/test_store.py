@@ -504,3 +504,138 @@ def test_lock_metrics_rendered(tmp_path):
     finally:
         loop.close()
         s.close()
+
+
+# ---- native pod intake (ms_watch_poll_pods) + echo suppression -----------
+# The C fast parser and Python's decode_pod_fast accept the same canonical
+# shape; these tests pin the frame layout, the parity, and the
+# exclude_watcher contract (memstore.h).
+
+
+def _pods_watch(store, **kw):
+    p = b"/registry/pods/"
+    return store.watch(p, prefix_end(p), **kw)
+
+
+def test_poll_pods_columnar_frame(store):
+    from k8s1m_tpu.control.objects import encode_pod, pod_key
+    from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+    from k8s1m_tpu.store.native import (
+        POD_CANONICAL,
+        POD_HAS_NODE,
+        POD_SCHED_MATCH,
+    )
+
+    w = _pods_watch(store)
+    v1 = encode_pod(PodInfo("p1", cpu_milli=250, mem_kib=2048))
+    store.put(pod_key("default", "p1"), v1)
+    v2 = encode_pod(PodInfo("p2", labels={"a": "b"}))       # non-canonical
+    store.put(pod_key("default", "p2"), v2)
+    v3 = encode_pod(PodInfo("p3", scheduler_name="default-scheduler"))
+    store.put(pod_key("default", "p3"), v3)
+    store.delete(pod_key("default", "p3"))
+
+    evb = w.poll_pods(100, b"dist-scheduler")
+    assert evb.n == 4
+    assert evb.etype.tolist() == [0, 0, 0, 1]
+    assert evb.flags.tolist() == [
+        POD_CANONICAL | POD_SCHED_MATCH, 0, POD_CANONICAL, 0,
+    ]
+    assert evb.cpu.tolist()[0] == 250 and evb.mem.tolist()[0] == 2048
+    keys = [
+        evb.key_blob[evb.koff[i]: evb.koff[i + 1]] for i in range(evb.n)
+    ]
+    assert keys == [
+        pod_key("default", "p1"), pod_key("default", "p2"),
+        pod_key("default", "p3"), pod_key("default", "p3"),
+    ]
+    # Non-canonical PUT carries the whole value in aux; others nothing.
+    aux = [evb.aux_blob[evb.aoff[i]: evb.aoff[i + 1]] for i in range(evb.n)]
+    assert aux == [b"", v2, b"", b""]
+    assert evb.mrev.tolist() == [2, 3, 4, 5]
+    assert w.poll_pods(100, b"dist-scheduler").n == 0
+
+
+def test_poll_pods_parses_exactly_what_decode_pod_fast_does(store):
+    """C parser parity: every value decode_pod_fast accepts as a plain
+    label-less pod must be CANONICAL with the same cpu/mem/node, and the
+    shapes it rejects must come back whole for the Python fallback."""
+    from k8s1m_tpu.control.coordinator import splice_node_name
+    from k8s1m_tpu.control.objects import (
+        decode_pod_fast,
+        encode_pod,
+        pod_key,
+    )
+    from k8s1m_tpu.snapshot.pod_encoding import (
+        PodInfo,
+        SelectorRequirement,
+        NodeSelectorTerm,
+        Toleration,
+    )
+    from k8s1m_tpu.config import SEL_OP_IN
+    from k8s1m_tpu.store.native import POD_CANONICAL, POD_HAS_NODE
+
+    cases = [
+        encode_pod(PodInfo("a", cpu_milli=1, mem_kib=1)),
+        encode_pod(PodInfo("b", namespace="kube-system", cpu_milli=999999,
+                           mem_kib=123456789)),
+        encode_pod(PodInfo("c", node_name="n-1")),
+        splice_node_name(encode_pod(PodInfo("d")), "n-2"),
+        encode_pod(PodInfo("e", labels={"x": "y"})),
+        encode_pod(PodInfo("f", node_selector={"k": "v"})),
+        encode_pod(PodInfo("g", tolerations=[Toleration(key="k")])),
+        encode_pod(PodInfo(
+            "h",
+            required_terms=[NodeSelectorTerm([
+                SelectorRequirement("k", SEL_OP_IN, ["v"])
+            ])],
+        )),
+        encode_pod(PodInfo('esc"aped', cpu_milli=5)),   # escapes -> fallback
+    ]
+    w = _pods_watch(store)
+    for i, v in enumerate(cases):
+        store.put(pod_key("t", f"case-{i}"), v)
+    evb = w.poll_pods(100, b"dist-scheduler")
+    assert evb.n == len(cases)
+    for i, v in enumerate(cases):
+        py = decode_pod_fast(v, None)
+        # decode_pod_fast parses labeled pods too; the C lane only takes
+        # the label-less subset (labels need the Python tracker anyway).
+        py_plain = py is not None and not py.labels
+        c_canon = bool(evb.flags[i] & POD_CANONICAL)
+        assert c_canon == py_plain, f"case {i}"
+        if not c_canon:
+            assert evb.aux_blob[evb.aoff[i]: evb.aoff[i + 1]] == v
+            continue
+        assert evb.cpu[i] == py.cpu_milli and evb.mem[i] == py.mem_kib
+        if py.node_name:
+            assert evb.flags[i] & POD_HAS_NODE
+            assert (
+                evb.aux_blob[evb.aoff[i]: evb.aoff[i + 1]].decode()
+                == py.node_name
+            )
+
+
+def test_bind_batch_echo_suppression(store):
+    from k8s1m_tpu.control.objects import encode_pod, pod_key
+    from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+
+    mine = _pods_watch(store)
+    other = _pods_watch(store)
+    k = pod_key("default", "p")
+    rev = store.put(k, encode_pod(PodInfo("p")))
+    assert store.bind_batch([(k, rev, b"n-1")], exclude_watcher=mine.id) == [
+        rev + 1
+    ]
+    # The issuing watcher sees only the original create, not the bind.
+    assert [e[0] for e in mine.poll_light()] == [0]
+    assert mine.poll_light() == []
+    # Everyone else sees both events.
+    evs = other.poll_light()
+    assert len(evs) == 2
+    assert b'"nodeName":"n-1"' in evs[1][2]
+    # Default (-1) suppresses nobody.
+    k2 = pod_key("default", "q")
+    rev2 = store.put(k2, encode_pod(PodInfo("q")))
+    store.bind_batch([(k2, rev2, b"n-2")])
+    assert len(mine.poll_light()) == 2

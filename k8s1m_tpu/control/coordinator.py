@@ -566,6 +566,10 @@ class Coordinator:
             f = flags_l[i]
             if not f & POD_CANONICAL:
                 self._on_pod_put(ab[aoff[i] : aoff[i + 1]], mrev_l[i], key)
+                # decode_pod may have interned a new constraint whose
+                # empty selector matches later canonical pods in this
+                # same batch — refresh the snapshot.
+                has_constraints = bool(tr._spread or tr._affinity)
                 continue
             ks = key[plen:].decode()
             if f & POD_HAS_NODE:

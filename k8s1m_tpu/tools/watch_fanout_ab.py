@@ -37,8 +37,14 @@ def parse_args(argv=None):
     ap = argparse.ArgumentParser(description="watch fan-out A/B")
     ap.add_argument("--nodes", type=int, default=50)
     ap.add_argument("--watchers-per-node", type=int, default=3,
-                    help="client watches per node object (the reference "
-                         "counts 18 per kubelet+kube-proxy)")
+                    help="HOT client watches per node object (lease "
+                         "updates fan out to these)")
+    ap.add_argument("--idle-watches-per-node", type=int, default=0,
+                    help="additional idle watches per node on objects "
+                         "that never change (configmaps/secrets in the "
+                         "reference's 18-watches-per-kubelet profile, "
+                         "README.adoc:410-416) — they must cost the "
+                         "store nothing and deliver nothing")
     ap.add_argument("--writes", type=int, default=10000)
     ap.add_argument("--batch", type=int, default=500,
                     help="producer batch size (BatchKV wave)")
@@ -50,11 +56,26 @@ def parse_args(argv=None):
 
 async def run_one(index: str, args, store: MemStore, store_port: int) -> dict:
     lease_prefix = lease_key(LEASE_NS, "x")[:-1]    # .../kube-node-lease/
+    cm_prefix = b"/registry/configmaps/kube-system/"
+    prefixes = [lease_prefix]
+    producer = EtcdClient(f"127.0.0.1:{store_port}")
+    if args.idle_watches_per_node:
+        # The idle population watches per-node config objects that are
+        # written once and never again (the configmap/secret share of the
+        # reference's 18-watches-per-kubelet profile).
+        prefixes.append(cm_prefix)
+        await producer.put_batch([
+            (cm_prefix + f"node-cfg-{i}-{j}".encode(), b'{"data":{}}')
+            for i in range(args.nodes)
+            for j in range(args.idle_watches_per_node)
+        ])
     tier = await serve_watch_cache(
-        f"127.0.0.1:{store_port}", [lease_prefix], port=0, index=index,
+        f"127.0.0.1:{store_port}", prefixes, port=0, index=index,
     )
     cache, cache_port = tier.cache, tier.port
-    n_sessions = args.nodes * args.watchers_per_node
+    n_hot = args.nodes * args.watchers_per_node
+    n_idle = args.nodes * args.idle_watches_per_node
+    n_sessions = n_hot + n_idle
     n_channels = (n_sessions + _STREAMS_PER_CHANNEL - 1) // _STREAMS_PER_CHANNEL
     clients = [
         EtcdClient(f"127.0.0.1:{cache_port}",
@@ -62,11 +83,19 @@ async def run_one(index: str, args, store: MemStore, store_port: int) -> dict:
         for _ in range(max(1, n_channels))
     ]
     sessions = []
-    for i in range(n_sessions):
+    idle_sessions = []
+    for i in range(n_hot):
         node = f"kwok-node-{i % args.nodes}"
         s = clients[i % len(clients)].watch(lease_key(LEASE_NS, node))
         await s.__aenter__()
         sessions.append(s)
+    for i in range(n_idle):
+        key = cm_prefix + (
+            f"node-cfg-{i % args.nodes}-{i // args.nodes}".encode()
+        )
+        s = clients[(n_hot + i) % len(clients)].watch(key)
+        await s.__aenter__()
+        idle_sessions.append(s)
 
     expected = args.writes * args.watchers_per_node
     delivered = 0
@@ -91,7 +120,24 @@ async def run_one(index: str, args, store: MemStore, store_port: int) -> dict:
 
     drainers = [asyncio.create_task(drain(s)) for s in sessions]
 
-    producer = EtcdClient(f"127.0.0.1:{store_port}")
+    idle_delivered = 0
+
+    async def idle_drain(s):
+        nonlocal idle_delivered, stream_errors
+        while not done.is_set():
+            try:
+                batch = await s.next(timeout=15)
+            except asyncio.TimeoutError:
+                return      # expected: idle watches never fire
+            except Exception:
+                # A broken idle stream must not masquerade as "idle
+                # watches deliver nothing" — that's the claim under test.
+                stream_errors += 1
+                return
+            idle_delivered += len(batch.events)
+
+    drainers += [asyncio.create_task(idle_drain(s)) for s in idle_sessions]
+
     t0 = time.perf_counter()
     i = 0
     while i < args.writes:
@@ -115,7 +161,7 @@ async def run_one(index: str, args, store: MemStore, store_port: int) -> dict:
     st = cache.stats()
     for t in drainers:
         t.cancel()
-    for s in sessions:
+    for s in sessions + idle_sessions:
         await s.cancel()
     for c in clients:
         await c.close()
@@ -126,11 +172,13 @@ async def run_one(index: str, args, store: MemStore, store_port: int) -> dict:
         "index": index,
         "nodes": args.nodes,
         "client_watches": n_sessions,
+        "idle_watches": n_idle,
         "store_watches": store_watchers,     # 1 per prefix: fan-out proof
         "writes": args.writes,
         "writes_per_sec": round(args.writes / write_s, 1),
         "store_events_per_sec": round(st["events_in"] / total_s, 1),
         "delivered": delivered,
+        "idle_delivered": idle_delivered,    # must be 0: idle watches are free
         "delivered_per_sec": round(delivered / total_s, 1),
         "amplification": round(delivered / max(1, st["events_in"]), 2),
         "stream_errors": stream_errors,

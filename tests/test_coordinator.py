@@ -367,3 +367,38 @@ def test_fast_lane_external_bind_accounting(store):
     store.delete(pod_key("default", "ext"))
     c.drain_watches()
     assert c.host.pods_req[row] == 0 and c.host.cpu_req[row] == 0
+
+
+def test_mid_batch_constraint_registration_reaches_later_fast_pods(store):
+    """A constraint interned while decoding a non-canonical pod must be
+    visible to canonical pods LATER IN THE SAME drained batch: the fast
+    lane refreshes its tracker snapshot after every slow-path decode."""
+    from k8s1m_tpu.config import TOPO_ZONE
+
+    for i in range(4):
+        put_node(store, f"n{i}", zone=f"z{i % 2}")
+    c = Coordinator(store, SPEC, PODS, Profile(interpod_affinity=0),
+                    chunk=64, k=4, with_constraints=True)
+    c.bootstrap()
+    # One labeled pod carrying an inline empty-selector spread constraint
+    # (non-canonical -> slow decode interns the slot), then plain pods —
+    # all in ONE batch of watch events.
+    spread = [{
+        "topologyKey": "topology.kubernetes.io/zone",
+        "maxSkew": 1,
+        "whenUnsatisfiable": "DoNotSchedule",
+        "labelSelector": {"matchLabels": {}},
+    }]
+    from k8s1m_tpu.control.objects import encode_pod as enc
+
+    store.put_batch(
+        [(pod_key("default", "carrier"),
+          enc(PodInfo("carrier", labels={"x": "y"}), raw_spread=spread))]
+        + [(pod_key("default", f"plain-{i}"),
+            enc(PodInfo(f"plain-{i}"))) for i in range(4)]
+    )
+    c.drain_watches()
+    assert len(c.queue) == 5
+    plains = [p for p in c.queue if p.key_str.startswith("default/plain")]
+    assert plains and all(p.pod is not None for p in plains)
+    assert all(p.pod.spread_incs for p in plains)

@@ -240,3 +240,24 @@ def test_upstream_break_cancels_clients_for_relist(env):
         await s.cancel()
 
     loop.run_until_complete(go())
+
+
+def test_fanout_ab_idle_watch_profile(loop):
+    """The 18-watches-per-node profile (reference README.adoc:410-416):
+    most of a node's watches are idle (configmaps/secrets that never
+    change).  They must add zero store watches, deliver zero events, and
+    leave hot fan-out intact — the tool records all three."""
+    from k8s1m_tpu.tools.watch_fanout_ab import amain, parse_args
+
+    args = parse_args([
+        "--nodes", "4", "--watchers-per-node", "2",
+        "--idle-watches-per-node", "6", "--writes", "200",
+        "--batch", "50", "--index", "hash",
+    ])
+    (res,) = loop.run_until_complete(amain(args))
+    assert res["client_watches"] == 4 * 8
+    assert res["idle_watches"] == 24
+    assert res["store_watches"] == 2          # lease + configmap prefixes
+    assert res["delivered"] == 200 * 2        # hot fan-out
+    assert res["idle_delivered"] == 0
+    assert res["stream_errors"] == 0

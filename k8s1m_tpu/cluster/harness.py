@@ -70,6 +70,13 @@ class ClusterSpec:
     # Periodic MVCC compaction, the apiserver's --etcd-compaction-interval
     # (the reference tunes it to 20m, server.tf:28-39; simulated seconds).
     compact_interval_s: float = 1200.0
+    # Deploy the watch-cache fan-out tier (store/watch_cache.py) between
+    # the store and the node-simulation consumers: KWOK controllers —
+    # the stand-ins for the reference's kubelets, whose 18M watches hit
+    # the apiserver's watch cache and never reach etcd
+    # (README.adoc:410-416) — connect to the tier; writes proxy through.
+    watch_cache: bool = False
+    watch_cache_index: str = "hash"
     table: TableSpec | None = None
     pod_batch: int = 256
     profile: Profile = dataclasses.field(
@@ -95,9 +102,17 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def wait_for_port(port: int, timeout_s: float = 30.0) -> None:
+def wait_for_port(
+    port: int, timeout_s: float = 30.0,
+    proc: subprocess.Popen | None = None,
+) -> None:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"server for :{port} exited rc={proc.returncode} "
+                "before listening"
+            )
         try:
             with socket.create_connection(("127.0.0.1", port), timeout=1.0):
                 return
@@ -130,8 +145,28 @@ class Cluster:
         for p in spec.no_write_prefixes:
             cmd += ["--wal-no-write-prefix", p]
         self._server = subprocess.Popen(cmd)
+        self._tier = None
+        self.tier_port: int | None = None
         atexit.register(self.shutdown)
-        wait_for_port(self.port)
+        wait_for_port(self.port, proc=self._server)
+
+        if spec.watch_cache:
+            if spec.watch_cache_index not in ("hash", "btree"):
+                raise ValueError(
+                    f"watch_cache_index must be hash|btree, "
+                    f"got {spec.watch_cache_index!r}"
+                )
+            self.tier_port = _free_port()
+            self._tier = subprocess.Popen([
+                sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+                "--upstream", f"127.0.0.1:{self.port}",
+                "--host", "127.0.0.1", "--port", str(self.tier_port),
+                "--prefix", "/registry/",
+                "--index", spec.watch_cache_index,
+            ])
+            # Port bind happens after cache priming (watch_cache.py), so
+            # this doubles as the primed signal.
+            wait_for_port(self.tier_port, proc=self._tier)
 
         self.shard_members: list = []
         self._rebalancer = None
@@ -178,7 +213,7 @@ class Cluster:
                     )
                 )
         self.kwoks = [
-            KwokController(self._client(), group=g)
+            KwokController(self._kwok_client(), group=g)
             for g in range(spec.kwok_groups)
         ]
         self.webhook = WebhookServer(self._webhook_sink).start()
@@ -189,10 +224,15 @@ class Cluster:
 
     # ---- plumbing ------------------------------------------------------
 
-    def _client(self) -> RemoteStore:
-        c = RemoteStore(f"127.0.0.1:{self.port}")
+    def _client(self, port: int | None = None) -> RemoteStore:
+        c = RemoteStore(f"127.0.0.1:{port if port is not None else self.port}")
         self._clients.append(c)
         return c
+
+    def _kwok_client(self) -> RemoteStore:
+        """Node-simulation consumers connect through the watch-cache tier
+        when deployed (the kubelet→apiserver edge); else to the store."""
+        return self._client(self.tier_port)
 
     def _webhook_sink(self, obj: dict) -> None:
         if self.shard_members:
@@ -379,6 +419,14 @@ class Cluster:
                 c.close()
             except Exception:
                 pass
+        if self._tier is not None:
+            self._tier.terminate()
+            try:
+                self._tier.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._tier.kill()
+                self._tier.wait()
+            self._tier = None
         self._stop_server()
         self._server = None
 

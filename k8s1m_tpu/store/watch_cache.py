@@ -516,6 +516,45 @@ class WatchCacheService:
             for w in watchers.values():
                 cache.unregister(w)
 
+    # ---- writes: proxied to the store ----------------------------------
+    # The apiserver role: reads/watches served from the cache, every
+    # mutation forwarded to the datastore (one connection, not one per
+    # client).  With these, a client points ONLY at the tier and gets the
+    # full wire — which is what lets a whole kwok/coordinator stack sit
+    # behind it, the reference's apiserver-in-the-middle topology.
+
+    async def Put(self, req: rpc_pb2.PutRequest, ctx) -> rpc_pb2.PutResponse:
+        return await self.upstream._put(req)
+
+    async def DeleteRange(
+        self, req: rpc_pb2.DeleteRangeRequest, ctx
+    ) -> rpc_pb2.DeleteRangeResponse:
+        return await self.upstream._delete(req)
+
+    async def Txn(self, req: rpc_pb2.TxnRequest, ctx) -> rpc_pb2.TxnResponse:
+        return await self.upstream._txn(req)
+
+    async def Compact(
+        self, req: rpc_pb2.CompactionRequest, ctx
+    ) -> rpc_pb2.CompactionResponse:
+        return await self.upstream._compact(req)
+
+    async def LeaseGrant(
+        self, req: rpc_pb2.LeaseGrantRequest, ctx
+    ) -> rpc_pb2.LeaseGrantResponse:
+        return await self.upstream._lease_grant(req)
+
+    async def LeaseRevoke(
+        self, req: rpc_pb2.LeaseRevokeRequest, ctx
+    ) -> rpc_pb2.LeaseRevokeResponse:
+        return await self.upstream._lease_revoke(req)
+
+    async def PutFrame(self, req, ctx):
+        return await self.upstream._put_frame(req)
+
+    async def BindFrame(self, req, ctx):
+        return await self.upstream._bind_frame(req)
+
     # ---- Maintenance.Status --------------------------------------------
 
     async def Status(self, req: rpc_pb2.StatusRequest, ctx):
@@ -576,9 +615,41 @@ async def serve_watch_cache(
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
         ]
     )
+    from k8s1m_tpu.store.proto import batch_pb2
+
     server.add_generic_rpc_handlers((
         grpc.method_handlers_generic_handler("etcdserverpb.KV", {
             "Range": _unary(svc.Range, rpc_pb2.RangeRequest, rpc_pb2.RangeResponse),
+            "Put": _unary(svc.Put, rpc_pb2.PutRequest, rpc_pb2.PutResponse),
+            "DeleteRange": _unary(
+                svc.DeleteRange, rpc_pb2.DeleteRangeRequest,
+                rpc_pb2.DeleteRangeResponse,
+            ),
+            "Txn": _unary(svc.Txn, rpc_pb2.TxnRequest, rpc_pb2.TxnResponse),
+            "Compact": _unary(
+                svc.Compact, rpc_pb2.CompactionRequest,
+                rpc_pb2.CompactionResponse,
+            ),
+        }),
+        grpc.method_handlers_generic_handler("etcdserverpb.Lease", {
+            "LeaseGrant": _unary(
+                svc.LeaseGrant, rpc_pb2.LeaseGrantRequest,
+                rpc_pb2.LeaseGrantResponse,
+            ),
+            "LeaseRevoke": _unary(
+                svc.LeaseRevoke, rpc_pb2.LeaseRevokeRequest,
+                rpc_pb2.LeaseRevokeResponse,
+            ),
+        }),
+        grpc.method_handlers_generic_handler("k8s1m.BatchKV", {
+            "PutFrame": _unary(
+                svc.PutFrame, batch_pb2.PutFrameRequest,
+                batch_pb2.PutFrameResponse,
+            ),
+            "BindFrame": _unary(
+                svc.BindFrame, batch_pb2.BindFrameRequest,
+                batch_pb2.BindFrameResponse,
+            ),
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Watch", {
             "Watch": grpc.stream_stream_rpc_method_handler(
@@ -591,10 +662,10 @@ async def serve_watch_cache(
             "Status": _unary(svc.Status, rpc_pb2.StatusRequest, rpc_pb2.StatusResponse),
         }),
     ))
-    bound = server.add_insecure_port(f"{host}:{port}")
-    if bound == 0:
-        raise OSError(f"failed to bind {host}:{port}")
-    await server.start()
+    # Prime BEFORE binding the port: a bound-but-unprimed tier would let
+    # early clients read an empty cache (prime() loads objects without
+    # dispatching events, so a pre-prime watcher would silently miss all
+    # existing state).  Port readiness == cache readiness.
     primed_events = [asyncio.Event() for _ in prefixes]
     tasks = [
         asyncio.create_task(run_upstream(cache, upstream, p, primed=e))
@@ -602,6 +673,10 @@ async def serve_watch_cache(
     ]
     for e in primed_events:
         await e.wait()
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise OSError(f"failed to bind {host}:{port}")
+    await server.start()
     return WatchCacheTier(server, bound, cache, tasks, upstream)
 
 

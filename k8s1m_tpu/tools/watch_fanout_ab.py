@@ -128,7 +128,7 @@ async def run_one(index: str, args, store: MemStore, store_port: int) -> dict:
             try:
                 batch = await s.next(timeout=15)
             except asyncio.TimeoutError:
-                return      # expected: idle watches never fire
+                continue    # expected quiet — keep listening to the end
             except Exception:
                 # A broken idle stream must not masquerade as "idle
                 # watches deliver nothing" — that's the claim under test.

@@ -373,8 +373,6 @@ def test_mid_batch_constraint_registration_reaches_later_fast_pods(store):
     """A constraint interned while decoding a non-canonical pod must be
     visible to canonical pods LATER IN THE SAME drained batch: the fast
     lane refreshes its tracker snapshot after every slow-path decode."""
-    from k8s1m_tpu.config import TOPO_ZONE
-
     for i in range(4):
         put_node(store, f"n{i}", zone=f"z{i % 2}")
     c = Coordinator(store, SPEC, PODS, Profile(interpod_affinity=0),

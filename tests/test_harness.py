@@ -163,3 +163,26 @@ def test_shard_set_cluster_schedules_and_stays_disjoint():
                 assert not (a & b).any()
             union |= a
         assert union.sum() == 48
+
+
+def test_cluster_behind_watch_cache_tier():
+    """Full topology with the apiserver tier deployed: KWOK controllers
+    (the kubelet stand-ins) list/watch/write through the watch-cache
+    subprocess; scheduling still completes end-to-end and pods reach
+    Running via tier-proxied status writes."""
+    spec = ClusterSpec(
+        nodes=32, kwok_groups=2, coordinators=1, pod_batch=16, chunk=64,
+        wal_mode="none", watch_cache=True,
+    )
+    with Cluster(spec) as c:
+        assert c.tier_port is not None and c.tier_port != c.port
+        c.make_nodes()
+        stats = c.run_pods(20, max_ticks=60)
+        assert stats["bound"] == 20
+        assert stats["running"] == 20
+        store = c._clients[0]
+        res = store.range(b"/registry/pods/", prefix_end(b"/registry/pods/"))
+        for kv in res.kvs:
+            obj = json.loads(kv.value)
+            assert obj["spec"]["nodeName"]
+            assert obj["status"]["phase"] == "Running"

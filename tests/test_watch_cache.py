@@ -261,3 +261,46 @@ def test_fanout_ab_idle_watch_profile(loop):
     assert res["delivered"] == 200 * 2        # hot fan-out
     assert res["idle_delivered"] == 0
     assert res["stream_errors"] == 0
+
+
+def test_tier_serves_full_wire_with_write_passthrough(env):
+    """A client pointed ONLY at the tier gets the whole etcd wire: writes
+    (Put/Txn/BatchKV/Lease) proxy to the store, reads/watches come from
+    the cache — the apiserver-in-the-middle topology (reads from the
+    watch cache, mutations to the datastore)."""
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        # Put through the tier; the event returns via the upstream watch
+        # and a tier watch sees it.
+        async with cclient.watch(PFX, prefix_end(PFX)) as w:
+            rev = await cclient.put(PFX + b"wp", b"v1")
+            assert rev > 0
+            batch = await w.next(timeout=5)
+            assert batch.events[0].kv.key == PFX + b"wp"
+            # CAS bind through the tier.
+            r = await cclient.txn_cas(PFX + b"wp", b"v2", required_mod=rev)
+            assert r.succeeded
+            # Stale CAS fails with the current KV in the failure branch.
+            r2 = await cclient.txn_cas(PFX + b"wp", b"v3", required_mod=rev)
+            assert not r2.succeeded
+            # BatchKV wave through the tier.
+            await cclient.put_batch(
+                [(PFX + b"bk%d" % i, b"x") for i in range(5)]
+            )
+            # Lease + delete passthrough.
+            lid = await cclient.lease_grant(30)
+            assert lid > 0
+            assert await cclient.delete(PFX + b"wp") == 1
+        # The store saw the writes (truth), the tier serves the list.
+        for _ in range(100):
+            if cache.last_revision >= store.current_revision:
+                break
+            await asyncio.sleep(0.01)
+        resp = await cclient.prefix(PFX)
+        keys = {kv.key for kv in resp.kvs}
+        assert PFX + b"bk0" in keys and PFX + b"wp" not in keys
+        # Store-side watch count: the tier's one, not the client's.
+        assert store.stats()["watchers"] == 1
+
+    loop.run_until_complete(go())

@@ -85,6 +85,16 @@ class ClusterSpec:
     chunk: int = 1 << 10
     backend: str = "xla"
 
+    def __post_init__(self):
+        # Fail before any subprocess is spawned: a bad value raised from
+        # Cluster.__init__ after the store Popen would leak the server
+        # until interpreter exit.
+        if self.watch_cache_index not in ("hash", "btree"):
+            raise ValueError(
+                f"watch_cache_index must be hash|btree, "
+                f"got {self.watch_cache_index!r}"
+            )
+
     def table_spec(self) -> TableSpec:
         if self.table is not None:
             return self.table
@@ -151,11 +161,6 @@ class Cluster:
         wait_for_port(self.port, proc=self._server)
 
         if spec.watch_cache:
-            if spec.watch_cache_index not in ("hash", "btree"):
-                raise ValueError(
-                    f"watch_cache_index must be hash|btree, "
-                    f"got {spec.watch_cache_index!r}"
-                )
             self.tier_port = _free_port()
             self._tier = subprocess.Popen([
                 sys.executable, "-m", "k8s1m_tpu.store.watch_cache",

@@ -244,6 +244,7 @@ class Coordinator:
         self.queue: collections.deque[PendingPod] = collections.deque()
         self._queued_keys: set[str] = set()
         self._sched_bytes = scheduler_name.encode()
+        self._name_bytes: list[bytes] = []
         # Per-namespace tracker matches for the EMPTY label set, keyed by
         # the tracker's registration counts (registration only grows).
         # Label-less pods can still match constraints whose selector is
@@ -624,6 +625,16 @@ class Coordinator:
                 key_str=ks, key_bytes=key,
             ))
 
+    def _node_name_bytes(self) -> list:
+        """Encoded node names, index-parallel with vocab.node_names
+        (extended lazily; names never leave the vocab)."""
+        nb = self._name_bytes
+        tv = self.host.vocab.node_names._to_val
+        while len(nb) < len(tv):
+            v = tv[len(nb)]
+            nb.append(v.encode() if isinstance(v, str) else b"")
+        return nb
+
     def _empty_incs(self, namespace: str) -> tuple:
         """Cached tracker matches for a label-less pod in ``namespace``
         (cache key includes the registration counts, which only grow)."""
@@ -904,20 +915,26 @@ class Coordinator:
             for i in np.nonzero(rows < 0)[0].tolist():
                 self._retry(batch_pods[i])
             brows = rows[bound_idx]
-            nv = host.vocab.node_names._to_val
-            names = [nv[i] for i in host.name_id[brows].tolist()]
+            nbytes = self._node_name_bytes()
+            ids_l = host.name_id[brows].tolist()
+            brows_l = brows.tolist()
             zones = host.zone[brows].tolist()
             regions = host.region[brows].tolist()
+            bound_l = bound_idx.tolist()
 
-            wave: list[tuple[int, PendingPod, str, int, int, int]] = []
+            # Index-parallel wave: wave_j[k] is the position in bound_l
+            # of the k-th native-path record (per-pod tuple building and
+            # name.encode were a measurable slice of the bind stage).
+            wave_j: list[int] = []
             entries: list[tuple[bytes, int, bytes]] = []
-            for j, i in enumerate(bound_idx.tolist()):
+            native = bind_batch is not None
+            for j, i in enumerate(bound_l):
                 p = batch_pods[i]
-                name = names[j]
-                if bind_batch is not None and p.mod_revision is not None:
-                    wave.append((i, p, name, int(brows[j]), zones[j], regions[j]))
-                    entries.append((p.key_bytes, p.mod_revision, name.encode()))
+                if native and p.mod_revision is not None:
+                    wave_j.append(j)
+                    entries.append((p.key_bytes, p.mod_revision, nbytes[ids_l[j]]))
                     continue
+                name = nbytes[ids_l[j]].decode()
                 if self._bind(p, name):
                     nbound += 1
                     _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
@@ -931,7 +948,7 @@ class Coordinator:
                 self._dirty_rows.add(host.row_of(name))
                 failed[i] = True
                 self._retry(p)
-            if wave:
+            if entries:
                 if self._bind_excludes:
                     results = self.store.bind_batch(
                         entries, self._pods_watch.id
@@ -944,9 +961,12 @@ class Coordinator:
                 ok_mem: list[int] = []
                 lats: list[float] = []
                 bound_dict = self._bound
-                for (i, p, name, row, zone, region), rev in zip(wave, results):
+                nv = host.vocab.node_names._to_val
+                for j, rev in zip(wave_j, results):
+                    i = bound_l[j]
+                    p = batch_pods[i]
                     if rev > 0:
-                        ok_rows.append(row)
+                        ok_rows.append(brows_l[j])
                         ok_cpu.append(p.cpu_milli)
                         ok_mem.append(p.mem_kib)
                         lats.append(now - p.enqueued_at)
@@ -956,9 +976,11 @@ class Coordinator:
                             else None
                         )
                         bound_dict[p.key_str] = (
-                            name, p.cpu_milli, p.mem_kib, zone, region, keep,
+                            nv[ids_l[j]], p.cpu_milli, p.mem_kib,
+                            zones[j], regions[j], keep,
                         )
                         continue
+                    name = nbytes[ids_l[j]].decode()
                     if rev == BIND_INVALID and self._bind(p, name):
                         nbound += 1
                         _BIND_LATENCY.observe(now - p.enqueued_at)

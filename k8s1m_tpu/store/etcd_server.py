@@ -406,12 +406,24 @@ class EtcdService:
         next_id = 1
         out: asyncio.Queue = asyncio.Queue()
         last_delivered = 0
+        # Per-watch "delivered through" revision: every event <= cleared[wid]
+        # matching the watch has been written to the stream.  Advances on
+        # delivered events, and — for watches with nothing to say — on an
+        # empty poll, using a revision snapshot taken BEFORE the poll (the
+        # native queue is filled inside the store's write lock, so an empty
+        # queue proves delivery through any revision committed before the
+        # poll began).  This is what makes progress responses a true
+        # barrier (etcd semantics: a progress notification promises the
+        # client has seen everything at or below its revision).
+        cleared: dict[int, int] = {}
+        barriers: set = set()
 
         async def pump(wid: int, w: Watcher):
             nonlocal last_delivered
             loop = asyncio.get_running_loop()
             try:
                 while True:
+                    r0 = self.store.progress_revision
                     events = await loop.run_in_executor(
                         None, w.poll, _WATCH_BATCH, 0
                     )
@@ -441,6 +453,8 @@ class EtcdService:
                         )
                         return
                     if not events:
+                        if cleared.get(wid, 0) < r0:
+                            cleared[wid] = r0
                         await asyncio.sleep(_WATCH_POLL_S)
                         continue
                     resp = rpc_pb2.WatchResponse(
@@ -458,6 +472,8 @@ class EtcdService:
                             pb.prev_kv.CopyFrom(_kv_to_pb(ev.prev_kv))
                         last_delivered = max(last_delivered, ev.kv.mod_revision)
                     await out.put(resp)
+                    if cleared.get(wid, 0) < events[-1].kv.mod_revision:
+                        cleared[wid] = events[-1].kv.mod_revision
             except asyncio.CancelledError:
                 raise
 
@@ -522,14 +538,34 @@ class EtcdService:
                         )
                 elif which == "progress_request":
                     # Progress must never regress below delivered events
-                    # (reference watch_service.rs:172-176).
+                    # (reference watch_service.rs:172-176), and must not
+                    # OVERTAKE them either: the response is a barrier —
+                    # it goes out only after every watch on this stream
+                    # has delivered through the progress revision (real
+                    # etcd orders progress after prior events; the
+                    # consistent-read-from-cache protocol depends on it).
                     rev = max(self.store.progress_revision, last_delivered)
-                    await out.put(
-                        rpc_pb2.WatchResponse(
-                            header=self._header(rev), watch_id=-1
-                        )
+                    t = asyncio.create_task(
+                        progress_barrier(rev, list(watchers))
                     )
+                    barriers.add(t)
+                    t.add_done_callback(barriers.discard)
             await out.put(None)
+
+        async def progress_barrier(rev: int, wids: list[int]) -> None:
+            try:
+                while not all(
+                    wid not in watchers or cleared.get(wid, 0) >= rev
+                    for wid in wids
+                ):
+                    await asyncio.sleep(_WATCH_POLL_S)
+                await out.put(
+                    rpc_pb2.WatchResponse(
+                        header=self._header(rev), watch_id=-1
+                    )
+                )
+            except asyncio.CancelledError:
+                raise
 
         rtask = asyncio.create_task(reader())
         try:
@@ -541,6 +577,8 @@ class EtcdService:
         finally:
             rtask.cancel()
             for task in pumps.values():
+                task.cancel()
+            for task in list(barriers):
                 task.cancel()
             for w in watchers.values():
                 w.cancel()

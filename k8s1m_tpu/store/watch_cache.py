@@ -306,42 +306,58 @@ class WatchCache:
 async def run_upstream(
     cache: WatchCache, client: EtcdClient, prefix: bytes,
     *, primed: asyncio.Event | None = None,
+    handle: "UpstreamHandle | None" = None,
 ) -> None:
     """The tier's single store watch for ``prefix``: list to prime, then
     watch from the list revision, applying every event to the cache.
-    Runs until cancelled; on a broken/canceled stream it relists —
-    clients keep their watches, the cache absorbs the resync."""
+    Runs until cancelled; on a broken/canceled stream (or a failed
+    prime — the list is retried like the stream, so a store hiccup at
+    startup can't kill the task before ``primed`` fires) it relists —
+    clients keep their watches, the cache absorbs the resync.
+
+    ``handle`` tracks the live session and progress responses for the
+    consistent-read gate (event-less batches on a revision-ordered
+    stream are progress notifications)."""
     end = prefix_end(prefix)
-    first = True
+    primed_once = False
     while True:
-        if not first:
-            # Events were lost between the broken stream and this relist;
-            # cancel every client watch (they relist) and rebuild.
-            cache.invalidate()
-        first = False
-        resp = await client.prefix(prefix)
-        cache.prime(resp.kvs, resp.header.revision)
-        if primed is not None:
-            primed.set()
         try:
+            if primed_once:
+                # Events were lost between the broken stream and this
+                # relist; cancel every client watch (they relist) and
+                # rebuild.
+                cache.invalidate()
+            resp = await client.prefix(prefix)
+            cache.prime(resp.kvs, resp.header.revision)
+            primed_once = True
+            if primed is not None:
+                primed.set()
             async with client.watch(
                 prefix, end, start_revision=resp.header.revision + 1
             ) as session:
                 if session.compact_revision:
                     continue    # relist: our revision already compacted
-                while True:
-                    batch = await session.next()
-                    if batch.canceled:
-                        break   # server-side cancel -> relist
-                    for ev in batch.events:
-                        cache.apply(
-                            1 if ev.type == mvcc_pb2.Event.DELETE else 0,
-                            ev.kv.key,
-                            ev.kv.value,
-                            ev.kv.create_revision,
-                            ev.kv.mod_revision,
-                            ev.kv.version,
-                        )
+                if handle is not None:
+                    handle.session = session
+                try:
+                    while True:
+                        batch = await session.next()
+                        if batch.canceled:
+                            break   # server-side cancel -> relist
+                        for ev in batch.events:
+                            cache.apply(
+                                1 if ev.type == mvcc_pb2.Event.DELETE else 0,
+                                ev.kv.key,
+                                ev.kv.value,
+                                ev.kv.create_revision,
+                                ev.kv.mod_revision,
+                                ev.kv.version,
+                            )
+                        if not batch.events and handle is not None:
+                            handle.note_progress()
+                finally:
+                    if handle is not None:
+                        handle.session = None
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -349,17 +365,72 @@ async def run_upstream(
             await asyncio.sleep(0.2)
 
 
+class UpstreamHandle:
+    """Live view of one prefix's upstream watch stream, for the
+    consistent-read progress gate."""
+
+    def __init__(self) -> None:
+        self.session = None          # live WatchSession or None
+        self.progress_count = 0
+        self._waiters: list[tuple[int, asyncio.Event]] = []
+
+    def note_progress(self) -> None:
+        self.progress_count += 1
+        still = []
+        for c, e in self._waiters:
+            if self.progress_count > c:
+                e.set()
+            else:
+                still.append((c, e))
+        self._waiters = still
+
+    async def confirm(self, timeout: float) -> bool:
+        """Request progress on the live stream and wait for a response
+        issued after now; False if the stream is down or slow."""
+        s = self.session
+        if s is None:
+            return False
+        c0 = self.progress_count
+        try:
+            await s.request_progress()
+        except Exception:
+            return False
+        if self.progress_count > c0:
+            return True
+        e = asyncio.Event()
+        self._waiters.append((c0, e))
+        try:
+            await asyncio.wait_for(e.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+
 class WatchCacheService:
     """etcd wire services served from the cache tier."""
 
-    def __init__(self, cache: WatchCache, upstream: EtcdClient):
+    def __init__(
+        self, cache: WatchCache, upstream: EtcdClient,
+        handles: list[UpstreamHandle] | None = None,
+    ):
         self.cache = cache
         self.upstream = upstream
+        self.handles = handles or []
+
+    async def _confirm_progress(self, timeout: float = 5.0) -> bool:
+        if not self.handles:
+            return False
+        oks = await asyncio.gather(
+            *(h.confirm(timeout) for h in self.handles)
+        )
+        return all(oks)
 
     def _header(self) -> rpc_pb2.ResponseHeader:
+        return self._header_at(self.cache.last_revision)
+
+    def _header_at(self, rev: int) -> rpc_pb2.ResponseHeader:
         return rpc_pb2.ResponseHeader(
-            cluster_id=1, member_id=2, revision=self.cache.last_revision,
-            raft_term=1,
+            cluster_id=1, member_id=2, revision=rev, raft_term=1,
         )
 
     # ---- KV.Range: served from the cache -------------------------------
@@ -371,6 +442,21 @@ class WatchCacheService:
             # to etcd's exact-revision reads), so any pinned-revision
             # Range goes to the store.  revision=0 — the hot list path —
             # is what the cache exists to absorb.
+            return await self.upstream._range(req)
+        # Consistent read from cache: rev=0 on the etcd wire is
+        # linearizable, so a client that just wrote through the tier must
+        # see its write.  The gate is WATCH PROGRESS, exactly Kubernetes'
+        # consistent-watch-cache-reads protocol (and the reason the
+        # reference's store must advertise etcd >= 3.5.13,
+        # maintenance_service.rs:56): request a progress notification on
+        # every upstream watch stream and serve only after each stream
+        # has delivered one issued AFTER this read arrived — the streams
+        # are revision-ordered, so the cache then holds every write that
+        # committed before the read, per watched prefix, without a
+        # global-revision comparison (which a prefix-scoped cache could
+        # never satisfy).  Falls through to the store if a stream is
+        # reconnecting or too far behind.
+        if not await self._confirm_progress():
             return await self.upstream._range(req)
         kvs, more, count = self.cache.range(req.key, req.range_end, req.limit)
         return rpc_pb2.RangeResponse(
@@ -396,6 +482,11 @@ class WatchCacheService:
         watchers: dict[int, Downstream] = {}
         out: asyncio.Queue = asyncio.Queue()
         next_id = 1
+        # Delivered-through revisions + barrier tasks: progress responses
+        # are ordered after prior events, same contract as the store
+        # server (see etcd_server.py Watch).
+        cleared: dict[int, int] = {}
+        barriers: set = set()
 
         async def pump(wid: int, w: Downstream):
             try:
@@ -414,10 +505,12 @@ class WatchCacheService:
                             )
                         )
                         return
+                    r0 = cache.last_revision
                     while w.queue:
                         resp = rpc_pb2.WatchResponse(
                             header=self._header(), watch_id=wid
                         )
+                        last = 0
                         for _ in range(min(len(w.queue), _WATCH_BATCH)):
                             ev = w.queue.popleft()
                             pb = resp.events.add()
@@ -431,7 +524,15 @@ class WatchCacheService:
                             pb.kv.create_revision = ev.create_revision
                             pb.kv.mod_revision = ev.mod_revision
                             pb.kv.version = ev.version
+                            last = ev.mod_revision
                         await out.put(resp)
+                        if cleared.get(wid, 0) < last:
+                            cleared[wid] = last
+                        r0 = cache.last_revision
+                    # Queue observed empty at r0 (snapshot taken before the
+                    # check, no await between): delivered through r0.
+                    if cleared.get(wid, 0) < r0:
+                        cleared[wid] = r0
             except asyncio.CancelledError:
                 raise
 
@@ -475,6 +576,10 @@ class WatchCacheService:
                         )
                         continue
                     watchers[wid] = w
+                    # Owes nothing below the registration point unless a
+                    # replay queued history to deliver first.
+                    if not w.queue:
+                        cleared[wid] = cache.last_revision
                     await out.put(
                         rpc_pb2.WatchResponse(
                             header=self._header(), watch_id=wid, created=True
@@ -497,10 +602,32 @@ class WatchCacheService:
                             )
                         )
                 elif which == "progress_request":
-                    await out.put(
-                        rpc_pb2.WatchResponse(header=self._header(), watch_id=-1)
+                    rev = cache.last_revision
+                    t = asyncio.create_task(
+                        progress_barrier(rev, list(watchers))
                     )
+                    barriers.add(t)
+                    t.add_done_callback(barriers.discard)
             await out.put(None)
+
+        async def progress_barrier(rev: int, wids: list[int]) -> None:
+            while True:
+                pending = [
+                    wid for wid in wids
+                    if wid in watchers and cleared.get(wid, 0) < rev
+                ]
+                if not pending:
+                    break
+                # Idle pumps sleep on wakeup; nudge them so an event-less
+                # watch still advances its delivered-through point.
+                for wid in pending:
+                    watchers[wid].wakeup.set()
+                await asyncio.sleep(0.002)
+            await out.put(
+                rpc_pb2.WatchResponse(
+                    header=self._header_at(rev), watch_id=-1
+                )
+            )
 
         rtask = asyncio.create_task(reader())
         try:
@@ -512,6 +639,8 @@ class WatchCacheService:
         finally:
             rtask.cancel()
             for task in pumps.values():
+                task.cancel()
+            for task in list(barriers):
                 task.cancel()
             for w in watchers.values():
                 cache.unregister(w)
@@ -599,7 +728,8 @@ async def serve_watch_cache(
     ``port``."""
     cache = WatchCache(index=index, window=window)
     upstream = EtcdClient(upstream_target)
-    svc = WatchCacheService(cache, upstream)
+    handles = [UpstreamHandle() for _ in prefixes]
+    svc = WatchCacheService(cache, upstream, handles)
 
     def _unary(fn, req_cls, resp_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -668,15 +798,27 @@ async def serve_watch_cache(
     # existing state).  Port readiness == cache readiness.
     primed_events = [asyncio.Event() for _ in prefixes]
     tasks = [
-        asyncio.create_task(run_upstream(cache, upstream, p, primed=e))
-        for p, e in zip(prefixes, primed_events)
+        asyncio.create_task(run_upstream(cache, upstream, p, primed=e, handle=h))
+        for p, e, h in zip(prefixes, primed_events, handles)
     ]
-    for e in primed_events:
-        await e.wait()
-    bound = server.add_insecure_port(f"{host}:{port}")
-    if bound == 0:
-        raise OSError(f"failed to bind {host}:{port}")
-    await server.start()
+    try:
+        for e in primed_events:
+            await e.wait()
+        bound = server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise OSError(f"failed to bind {host}:{port}")
+        await server.start()
+    except BaseException:
+        # Don't orphan the live upstream watch streams on a failed bind.
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await upstream.close()
+        raise
     return WatchCacheTier(server, bound, cache, tasks, upstream)
 
 

@@ -226,6 +226,34 @@ def test_watch_progress_request(env):
     loop.run_until_complete(go())
 
 
+def test_watch_progress_is_a_barrier(env):
+    """A progress response must be ordered AFTER every event at or below
+    its revision on the same stream (etcd semantics; what consistent
+    reads from a watch cache are built on).  Burst writes, then request
+    progress immediately: all burst events must arrive first."""
+    loop, client, _ = env
+
+    async def go():
+        async with client.watch(b"/registry/pods/",
+                                prefix_end(b"/registry/pods/")) as w:
+            last = 0
+            for i in range(100):
+                last = await client.put(b"/registry/pods/ns/p%03d" % i, b"x")
+            await w.request_progress()
+            seen = 0
+            while True:
+                batch = await w.next(timeout=5)
+                if not batch.events:
+                    # The progress response: everything <= its revision
+                    # must already have been delivered.
+                    assert batch.revision >= last
+                    assert seen == 100, (seen, batch.revision)
+                    break
+                seen += len(batch.events)
+
+    loop.run_until_complete(go())
+
+
 def test_lease_fake_semantics(env):
     loop, client, _ = env
 

@@ -292,15 +292,31 @@ def test_tier_serves_full_wire_with_write_passthrough(env):
             lid = await cclient.lease_grant(30)
             assert lid > 0
             assert await cclient.delete(PFX + b"wp") == 1
-        # The store saw the writes (truth), the tier serves the list.
-        for _ in range(100):
-            if cache.last_revision >= store.current_revision:
-                break
-            await asyncio.sleep(0.01)
+        # Read-your-writes with NO catch-up polling: rev=0 Range through
+        # the tier is gated on watch progress (the consistent-cache-read
+        # protocol), so the list issued immediately after the writes must
+        # already reflect them.
         resp = await cclient.prefix(PFX)
         keys = {kv.key for kv in resp.kvs}
         assert PFX + b"bk0" in keys and PFX + b"wp" not in keys
         # Store-side watch count: the tier's one, not the client's.
         assert store.stats()["watchers"] == 1
+
+    loop.run_until_complete(go())
+
+
+def test_tier_read_your_writes_immediately(env):
+    """put through the tier, then list through the tier with zero delay —
+    the progress gate must make the write visible (linearizable rev=0
+    Range, like real etcd)."""
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        for i in range(20):
+            rev = await cclient.put(PFX + b"ryw%d" % i, b"v")
+            resp = await cclient.prefix(PFX + b"ryw")
+            keys = {kv.key for kv in resp.kvs}
+            assert PFX + b"ryw%d" % i in keys, i
+            assert resp.header.revision >= rev
 
     loop.run_until_complete(go())

@@ -42,6 +42,40 @@ from k8s1m_tpu.snapshot import NodeTableHost, PodBatchHost
 BASELINE_BINDS_PER_SEC = 14_000.0
 
 
+def _require_device(timeout_s: float = 240.0):
+    """Fail fast (rc=3) if backend init hangs or errors.
+
+    The axon TPU pool can be unavailable (rolling libtpu upgrades, lost
+    grants after a killed client); its client then retries inside
+    jax.devices() for tens of minutes.  A bench that hangs is worse than
+    a bench that fails: the caller should get a quick non-zero exit, not
+    a consumed time budget.
+    """
+    import os
+    import sys
+    import threading
+
+    def die():
+        print(
+            f"bench: no usable jax device within {timeout_s:.0f}s "
+            "(TPU pool unavailable?)",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(timeout_s, die)
+    t.daemon = True
+    t.start()
+    try:
+        devs = jax.devices()
+    except Exception as e:
+        t.cancel()
+        print(f"bench: jax backend init failed: {e}", file=sys.stderr)
+        raise SystemExit(3)
+    t.cancel()
+    return devs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=1 << 20)
@@ -95,6 +129,7 @@ def main():
         args.score_pct = 5
     if not 1 <= args.score_pct <= 100:
         ap.error("--score-pct must be in [1, 100]")
+    _require_device()
     # Rotating sample window, the coordinator's exact rule (engine helpers).
     sample_rows = sample_rows_for(args.nodes, args.score_pct, args.chunk)
 

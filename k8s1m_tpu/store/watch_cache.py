@@ -339,6 +339,7 @@ async def run_upstream(
                     continue    # relist: our revision already compacted
                 if handle is not None:
                     handle.session = session
+                    handle.reset_after_reprime()
                 try:
                     while True:
                         batch = await session.next()
@@ -369,36 +370,71 @@ class UpstreamHandle:
     """Live view of one prefix's upstream watch stream, for the
     consistent-read progress gate."""
 
-    def __init__(self) -> None:
+    def __init__(self, prefix: bytes = b"") -> None:
+        self.prefix = prefix
         self.session = None          # live WatchSession or None
-        self.progress_count = 0
+        self.progress_count = 0      # progress responses received
+        self.requests_sent = 0       # progress requests issued
         self._waiters: list[tuple[int, asyncio.Event]] = []
+
+    def covers(self, key: bytes, end: bytes) -> bool:
+        """True if this stream's prefix contains [key, end) (single key
+        when end is empty)."""
+        p = self.prefix
+        if not key.startswith(p):
+            return False
+        if not end or end == key:
+            return True
+        if end == b"\x00":
+            return False
+        return end <= prefix_end(p)
 
     def note_progress(self) -> None:
         self.progress_count += 1
         still = []
         for c, e in self._waiters:
-            if self.progress_count > c:
+            if self.progress_count >= c:
                 e.set()
             else:
                 still.append((c, e))
         self._waiters = still
 
+    def reset_after_reprime(self) -> None:
+        """Stream replaced: requests in flight on the old stream will
+        never be answered.  The cache was just re-primed from a fresh
+        list, whose revision is at least that of any write committed
+        before now — so every pending confirm's guarantee already holds;
+        complete them and realign the counters."""
+        self.progress_count = self.requests_sent
+        for _c, e in self._waiters:
+            e.set()
+        self._waiters = []
+
     async def confirm(self, timeout: float) -> bool:
-        """Request progress on the live stream and wait for a response
-        issued after now; False if the stream is down or slow."""
+        """Request progress and wait for a response to a request issued
+        at-or-after this call began; False if the stream is down/slow.
+
+        Responses are FIFO with requests on the stream, and the store
+        computes a response's barrier revision when it READS the request
+        — so any response beyond the requests already issued when we
+        started proves delivery through everything committed before this
+        call.  Counting (not bare "a response arrived") is what stops a
+        response to an EARLIER caller's request — whose barrier may
+        predate our caller's write — from satisfying us.
+        """
         s = self.session
         if s is None:
             return False
-        c0 = self.progress_count
+        target = self.requests_sent + 1
+        self.requests_sent = target
         try:
             await s.request_progress()
         except Exception:
             return False
-        if self.progress_count > c0:
+        if self.progress_count >= target:
             return True
         e = asyncio.Event()
-        self._waiters.append((c0, e))
+        self._waiters.append((target, e))
         try:
             await asyncio.wait_for(e.wait(), timeout)
             return True
@@ -417,13 +453,19 @@ class WatchCacheService:
         self.upstream = upstream
         self.handles = handles or []
 
-    async def _confirm_progress(self, timeout: float = 5.0) -> bool:
-        if not self.handles:
-            return False
-        oks = await asyncio.gather(
-            *(h.confirm(timeout) for h in self.handles)
-        )
-        return all(oks)
+    async def _confirm_progress(
+        self, key: bytes, end: bytes, timeout: float = 5.0
+    ) -> bool:
+        """Confirm freshness for the ONE stream whose prefix covers the
+        requested range (an unrelated prefix's reconnect must not force
+        every read to the store); False -> serve from upstream.
+        Kubernetes additionally coalesces concurrent confirms per
+        resource; at this tier's read rates a per-read request is fine.
+        """
+        for h in self.handles:
+            if h.covers(key, end):
+                return await h.confirm(timeout)
+        return False    # range not covered by any watched prefix
 
     def _header(self) -> rpc_pb2.ResponseHeader:
         return self._header_at(self.cache.last_revision)
@@ -456,7 +498,7 @@ class WatchCacheService:
         # global-revision comparison (which a prefix-scoped cache could
         # never satisfy).  Falls through to the store if a stream is
         # reconnecting or too far behind.
-        if not await self._confirm_progress():
+        if not await self._confirm_progress(req.key, req.range_end):
             return await self.upstream._range(req)
         kvs, more, count = self.cache.range(req.key, req.range_end, req.limit)
         return rpc_pb2.RangeResponse(
@@ -728,7 +770,7 @@ async def serve_watch_cache(
     ``port``."""
     cache = WatchCache(index=index, window=window)
     upstream = EtcdClient(upstream_target)
-    handles = [UpstreamHandle() for _ in prefixes]
+    handles = [UpstreamHandle(p) for p in prefixes]
     svc = WatchCacheService(cache, upstream, handles)
 
     def _unary(fn, req_cls, resp_cls):

@@ -320,3 +320,20 @@ def test_tier_read_your_writes_immediately(env):
             assert resp.header.revision >= rev
 
     loop.run_until_complete(go())
+
+
+def test_range_outside_watched_prefixes_goes_upstream(env):
+    """The tier watches PFX only; a rev=0 Range elsewhere must come from
+    the store (a prefix-scoped cache knows nothing about other keys and
+    must not serve an empty-but-confident list)."""
+    loop, store, sclient, cache, cclient = env
+
+    async def go():
+        other = b"/registry/configmaps/ns/cm1"
+        await sclient.put(other, b"data")
+        kv = await cclient.get(other)
+        assert kv is not None and kv.value == b"data"
+        resp = await cclient.prefix(b"/registry/configmaps/")
+        assert len(resp.kvs) == 1
+
+    loop.run_until_complete(go())

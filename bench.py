@@ -97,13 +97,15 @@ def main():
         "--backend", choices=("xla", "pallas"), default=None,
         help="filter+score+top-k backend; pallas is the fused kernel "
         "(ops/pallas_topk.py), xla the scan path (engine/cycle.py). "
-        "Default: pallas, or xla when --constraints is set.",
+        "Default: pallas, or xla when --constraints is set (pass "
+        "--backend pallas with --constraints for the fused constraint "
+        "stage).",
     )
     ap.add_argument(
         "--constraints", action="store_true",
         help="BASELINE configs 3-4: pods carry topologySpread + inter-pod "
         "(anti)affinity constraints, scheduled under the full default "
-        "profile with live ConstraintState (XLA backend)",
+        "profile with live ConstraintState",
     )
     ap.add_argument(
         "--affinity", action="store_true",
@@ -114,9 +116,6 @@ def main():
     )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
-    if args.constraints and args.backend == "pallas":
-        ap.error("--constraints requires the XLA backend "
-                 "(constraint plugins live on the XLA path)")
     if args.constraints and args.affinity:
         ap.error("--constraints and --affinity are separate configs")
     if args.backend is None:
@@ -133,7 +132,13 @@ def main():
     # Rotating sample window, the coordinator's exact rule (engine helpers).
     sample_rows = sample_rows_for(args.nodes, args.score_pct, args.chunk)
 
-    spec = TableSpec(max_nodes=args.nodes)
+    # Constraint runs size the domain dims to the workload (64 zones /
+    # 8 regions from populate_kwok_nodes): the fused constraint stage
+    # materializes [max_zones, chunk] one-hot planes in VMEM.
+    spec = (
+        TableSpec(max_nodes=args.nodes, max_zones=128, max_regions=16)
+        if args.constraints else TableSpec(max_nodes=args.nodes)
+    )
     host = NodeTableHost(spec)
     t0 = time.perf_counter()
     populate_kwok_nodes(host, args.nodes)
@@ -161,6 +166,13 @@ def main():
             )
         )
         constraints = empty_constraints(spec)
+        # Slot/ref dims fitted to the workload (one spread ref or one
+        # anti-affinity term per pod): the fused constraint stage
+        # unrolls per ref slot, same sizing rule as the affinity kernel.
+        pod_spec = PodSpec(
+            batch=args.batch, spread_refs=1, affinity_refs=1,
+            spread_incs=1, ipa_incs=1,
+        )
     elif args.affinity:
         from k8s1m_tpu.cluster.workload import node_affinity_pods
 

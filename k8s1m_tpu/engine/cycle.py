@@ -323,17 +323,29 @@ def _schedule_batch_impl(
     # binds always commit into ``table`` — the split that makes ownership
     # masks (mask_rows) work without touching commit state.
     src = table if src is None else src
+    stats = None
+    if constraints is not None:
+        # Domain statistics are GLOBAL by semantics (a spread
+        # constraint's min/max is over the whole cluster): build them
+        # from the commit table, not the candidate view — an ownership
+        # mask (mask_rows) must narrow candidate selection, never the
+        # skew baseline, or shards would disagree on feasibility.  The
+        # sampling path below applies the same rule.
+        from k8s1m_tpu.plugins import topology
+
+        stats = topology.prologue(table, constraints)
     if backend == "pallas":
         from k8s1m_tpu.ops.pallas_topk import pallas_candidates
 
         cand = pallas_candidates(
             src, batch, key, profile, chunk=chunk, k=k,
             with_affinity=with_affinity,
+            constraints=constraints, stats=stats,
         )
     else:
         cand = filter_score_topk(
             src, batch, key, profile,
-            chunk=chunk, k=k, constraints=constraints,
+            chunk=chunk, k=k, constraints=constraints, stats=stats,
         )
     return finalize_batch(table, constraints, cand, commit_fields_of(batch))
 
@@ -380,19 +392,20 @@ def schedule_batch(
     (the assume step), so back-to-back batches see each other's placements.
 
     ``backend="pallas"`` routes filter+score+top-k through the fused
-    Pallas kernel (ops/pallas_topk.py) — stateless profiles only (no
-    topology spread / inter-pod affinity).  ``with_affinity=False``
-    compiles the cheaper selector-free kernel; pass it only when the
-    caller knows no pod in the batch carries nodeSelector/affinity terms
-    (the packed path derives this per wave from the field groups).
+    Pallas kernel (ops/pallas_topk.py), including the constraint stage
+    when ``constraints`` is passed (BASELINE configs 3-4 fused).
+    ``with_affinity=False`` compiles the cheaper selector-free kernel;
+    pass it only when the caller knows no pod in the batch carries
+    nodeSelector/affinity terms (the packed path derives this per wave
+    from the field groups).
     """
-    if backend == "pallas":
+    if backend == "pallas" and constraints is None:
         from k8s1m_tpu.ops import pallas_topk
 
-        if constraints is not None or not pallas_topk.supports(profile):
+        if not pallas_topk.supports(profile):
             raise ValueError(
-                "backend='pallas' requires a stateless profile and no "
-                "constraint state (see ops/pallas_topk.py)"
+                "profile enables constraint plugins but no constraint "
+                "state was passed (see ops/pallas_topk.py)"
             )
     step = _jitted_schedule(
         profile, chunk, k, constraints is not None, backend, with_affinity
@@ -470,9 +483,24 @@ def _jitted_schedule_packed(
             if backend == "pallas":
                 from k8s1m_tpu.ops.pallas_topk import pallas_candidates
 
+                p_stats = None
+                view_cons = None
+                if constraints is not None:
+                    # Same composition rule as the XLA branch below:
+                    # global domain statistics, window-local node cols.
+                    from k8s1m_tpu.plugins import topology
+                    from k8s1m_tpu.snapshot.constraints import (
+                        slice_constraints,
+                    )
+
+                    p_stats = topology.prologue(table, constraints)
+                    view_cons = slice_constraints(
+                        constraints, offset, sample_rows
+                    )
                 cand = pallas_candidates(
                     view, batch, key, profile, chunk=chunk, k=k,
                     with_affinity=aff,
+                    constraints=view_cons, stats=p_stats,
                 )
             else:
                 stats = None
@@ -561,13 +589,13 @@ def schedule_batch_packed(
 
     Returns (new_table, new_constraints, Assignment, rows).
     """
-    if backend == "pallas":
+    if backend == "pallas" and constraints is None:
         from k8s1m_tpu.ops import pallas_topk
 
-        if constraints is not None or not pallas_topk.supports(profile):
+        if not pallas_topk.supports(profile):
             raise ValueError(
-                "backend='pallas' requires a stateless profile and no "
-                "constraint state (see ops/pallas_topk.py)"
+                "profile enables constraint plugins but no constraint "
+                "state was passed (see ops/pallas_topk.py)"
             )
     step = _jitted_schedule_packed(
         profile, chunk, k, constraints is not None, backend,

@@ -70,6 +70,9 @@ from k8s1m_tpu.config import (
     SEL_OP_IN,
     SEL_OP_LT,
     SEL_OP_NOT_IN,
+    SPREAD_DO_NOT_SCHEDULE,
+    TOPO_HOSTNAME,
+    TOPO_ZONE,
 )
 from k8s1m_tpu.ops.priority import JITTER_BITS, MAX_SCORE
 from k8s1m_tpu.plugins.registry import Profile
@@ -147,7 +150,10 @@ def _kernel(
     w_ba: int,
     w_tt: int,
     w_na: int,
+    w_ts: int,
+    w_ipa: int,
     with_aff: bool,
+    with_cons: bool,
 ):
     """Base refs (always):
         seed_ref   i32[1, 1] SMEM
@@ -166,22 +172,36 @@ def _kernel(
         pref_tv, pref_w                        i32[TB, P]
         pref_ev, pref_qidx, pref_op, pref_num  i32[TB, P*E]
         pref_vals  i32[TB, P*E*V]
+    Constraint refs (with_cons only; see _cons_kernel_stage):
+        zone_c, region_c                       i32[1, C]
+        sn (spread_node), tn (tgt_node),
+        on_ (own_node)                         i32[SS|AS, C] chunked cols
+        sz, sr, tz, tr, oz, orr                i32[SS|AS, Z|R] whole tables
+        sp_* [TB, S], ia_* [TB, A], ii_* [TB, AI], cs_* [TB, 1]
     Outputs/scratch:
         out_idx, out_prio  i32[TB, K] accumulator outputs
         run_prio, run_idx  i32[TB, 128] VMEM scratch (lane-aligned top-k)
     """
+    it = iter(refs)
+    nxt = lambda: next(it)
     (seed_ref, cpu_alloc, mem_alloc, pods_alloc, cpu_req, mem_req,
-     pods_req, name_id, taint_id, taint_eff) = refs[:10]
+     pods_req, name_id, taint_id, taint_eff) = (nxt() for _ in range(10))
     if with_aff:
-        (lkey, lval, lnum, qkey) = refs[10:14]
-        (p_cpu, p_mem, p_valid, p_nnid, untol) = refs[14:19]
+        lkey, lval, lnum, qkey = (nxt() for _ in range(4))
+    if with_cons:
+        (zone_c, region_c, sn, tn, on_,
+         sz, sr, tz, tr, oz, orr) = (nxt() for _ in range(11))
+    p_cpu, p_mem, p_valid, p_nnid, untol = (nxt() for _ in range(5))
+    if with_aff:
         (sel_valid, sel_qidx, sel_val, req_tv, req_ev, req_qidx, req_op,
          req_num, req_vals, pref_tv, pref_w, pref_ev, pref_qidx, pref_op,
-         pref_num, pref_vals) = refs[19:35]
-        out_idx, out_prio, run_prio, run_idx = refs[35:]
-    else:
-        (p_cpu, p_mem, p_valid, p_nnid, untol) = refs[10:15]
-        out_idx, out_prio, run_prio, run_idx = refs[15:]
+         pref_num, pref_vals) = (nxt() for _ in range(16))
+    if with_cons:
+        (sp_cid, sp_topo, sp_skew, sp_hard, sp_live, sp_self, sp_min,
+         sp_max, ia_tid, ia_topo, ia_reqaff, ia_reqanti, ia_boot,
+         ia_prefsign, ii_tid, ii_topo, ii_valid,
+         cs_bound, cs_haspref, cs_nrefs) = (nxt() for _ in range(20))
+    out_idx, out_prio, run_prio, run_idx = (nxt() for _ in range(4))
     b_i = pl.program_id(0)
     c_i = pl.program_id(1)
 
@@ -388,6 +408,125 @@ def _kernel(
             wtot = wtot + w
         na_score = 100.0 * na_acc / jnp.maximum(wtot, 1.0)
 
+    # ---- constraint plugins (with_cons): PodTopologySpread +
+    # InterPodAffinity count-table lookups as one-hot matmuls.  The
+    # domain-count gathers of the XLA path (plugins/topology.py
+    # _counts_for) become: per chunk, project the [SLOTS, Z] zone/region
+    # tables onto the chunk's nodes with a domain one-hot ([SLOTS, Z] x
+    # [Z, C] on the MXU), then select each pod ref's slot with a one-hot
+    # [TB, SLOTS] dot.  Counts are integers < 2^24, f32-exact through
+    # the dots.  Batch-global statistics (min/max per domain, target
+    # totals, preferred-score bounds) are [TB, *] inputs precomputed by
+    # the caller from topology.prologue — global reductions don't belong
+    # in a chunk-local kernel.
+    if with_cons:
+        zdim = sz.shape[1]
+        rdim = sr.shape[1]
+        zc_ids = zone_c[:]                                    # [1, C]
+        rc_ids = region_c[:]
+        onehot_z = (
+            lax.broadcasted_iota(jnp.int32, (zdim, c), 0) == zc_ids
+        ).astype(jnp.float32)                                 # [Z, C]
+        onehot_r = (
+            lax.broadcasted_iota(jnp.int32, (rdim, c), 0) == rc_ids
+        ).astype(jnp.float32)
+        dom_z = (zc_ids != 0).astype(jnp.int32)               # [1, C]
+        dom_r = (rc_ids != 0).astype(jnp.int32)
+
+        def chunk_tables(node_cols, ztab, rtab):
+            return (
+                node_cols[:].astype(jnp.float32),
+                jnp.dot(ztab[:].astype(jnp.float32), onehot_z,
+                        preferred_element_type=jnp.float32),
+                jnp.dot(rtab[:].astype(jnp.float32), onehot_r,
+                        preferred_element_type=jnp.float32),
+            )
+
+        def ref_counts(tables, slot_col, topo_col):
+            """One [TB, 1] (slot, topo) ref -> (cnt i32[TB, C],
+            domain_ok i32[TB, C])."""
+            nf, zf, rf = tables
+            slots = nf.shape[0]
+            sel = (
+                lax.broadcasted_iota(jnp.int32, (tb, slots), 1) == slot_col
+            ).astype(jnp.float32)                             # [TB, SLOTS]
+            cn = jnp.dot(sel, nf, preferred_element_type=jnp.float32)
+            cz = jnp.dot(sel, zf, preferred_element_type=jnp.float32)
+            cr = jnp.dot(sel, rf, preferred_element_type=jnp.float32)
+            is_h = topo_col == TOPO_HOSTNAME
+            is_z = topo_col == TOPO_ZONE
+            cnt = jnp.where(is_h, cn, jnp.where(is_z, cz, cr))
+            dok = jnp.where(
+                is_h, jnp.ones((tb, c), jnp.int32),
+                jnp.where(is_z, dom_z, dom_r),
+            )
+            return cnt.astype(jnp.int32), dok
+
+        s_tabs = chunk_tables(sn, sz, sr)
+        t_tabs = chunk_tables(tn, tz, tr)
+        o_tabs = chunk_tables(on_, oz, orr)
+
+        cons_ok = jnp.ones((tb, c), jnp.int32)
+        spread_acc = jnp.zeros((tb, c), jnp.float32)
+        for j in range(sp_cid.shape[1]):
+            cnt, dok = ref_counts(
+                s_tabs, sp_cid[:, j : j + 1], sp_topo[:, j : j + 1]
+            )
+            minc = sp_min[:, j : j + 1]
+            maxc = sp_max[:, j : j + 1]
+            skew_ok = (
+                (cnt + sp_self[:, j : j + 1] - minc)
+                <= sp_skew[:, j : j + 1]
+            ).astype(jnp.int32)
+            hard = sp_hard[:, j : j + 1]
+            cons_ok = cons_ok * jnp.maximum(dok * skew_ok, 1 - hard)
+            denom = jnp.maximum(maxc - minc, 1).astype(jnp.float32)
+            s_ref = 100.0 * (maxc - cnt).astype(jnp.float32) / denom
+            s_ref = jnp.clip(s_ref, 0.0, 100.0) * dok.astype(jnp.float32)
+            spread_acc = spread_acc + s_ref * sp_live[:, j : j + 1].astype(
+                jnp.float32
+            )
+        spread_score = spread_acc / cs_nrefs[:].astype(jnp.float32)
+
+        raw_pref = jnp.zeros((tb, c), jnp.float32)
+        for j in range(ia_tid.shape[1]):
+            tcnt, tdok = ref_counts(
+                t_tabs, ia_tid[:, j : j + 1], ia_topo[:, j : j + 1]
+            )
+            aff_ok = jnp.maximum(
+                tdok
+                * jnp.maximum(
+                    (tcnt > 0).astype(jnp.int32), ia_boot[:, j : j + 1]
+                ),
+                1 - ia_reqaff[:, j : j + 1],
+            )
+            anti_ok = jnp.maximum(
+                jnp.maximum(1 - tdok, (tcnt == 0).astype(jnp.int32)),
+                1 - ia_reqanti[:, j : j + 1],
+            )
+            cons_ok = cons_ok * aff_ok * anti_ok
+            raw_pref = raw_pref + (
+                (tcnt * tdok).astype(jnp.float32)
+                * ia_prefsign[:, j : j + 1].astype(jnp.float32)
+            )
+        for j in range(ii_tid.shape[1]):
+            ocnt, odok = ref_counts(
+                o_tabs, ii_tid[:, j : j + 1], ii_topo[:, j : j + 1]
+            )
+            sym_ok = jnp.maximum(
+                jnp.maximum(1 - odok, (ocnt == 0).astype(jnp.int32)),
+                1 - ii_valid[:, j : j + 1],
+            )
+            cons_ok = cons_ok * sym_ok
+        ipa_score = jnp.where(
+            cs_haspref[:] != 0,
+            jnp.clip(
+                50.0 + 50.0 * raw_pref / cs_bound[:].astype(jnp.float32),
+                0.0, 100.0,
+            ),
+            0.0,
+        )
+
     score = jnp.zeros((tb, c), jnp.int32)
     if w_la:
         score += jnp.floor(la).astype(jnp.int32) * w_la
@@ -397,6 +536,11 @@ def _kernel(
         score += jnp.floor(tt_score).astype(jnp.int32) * w_tt
     if with_aff and w_na:
         score += jnp.floor(na_score).astype(jnp.int32) * w_na
+    if with_cons:
+        if w_ts:
+            score += jnp.floor(spread_score).astype(jnp.int32) * w_ts
+        if w_ipa:
+            score += jnp.floor(ipa_score).astype(jnp.int32) * w_ipa
 
     # ---- pack priority (ops/priority.py semantics, hash jitter).
     cols = lax.broadcasted_iota(jnp.int32, (tb, c), 1) + c_i * chunk
@@ -406,6 +550,8 @@ def _kernel(
     mask = fits & nn_ok & taint_ok & (p_valid[:] != 0)
     if with_aff:
         mask = mask & (sel_pass > 0) & (aff_pass > 0)
+    if with_cons:
+        mask = mask & (cons_ok > 0)
     prio = jnp.where(
         mask,
         (jnp.clip(score, 0, MAX_SCORE) << JITTER_BITS) | jitter,
@@ -450,7 +596,8 @@ def _kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "chunk", "k", "w_la", "w_ba", "w_tt", "w_na", "with_aff", "interpret",
+        "chunk", "k", "w_la", "w_ba", "w_tt", "w_na", "w_ts", "w_ipa",
+        "with_aff", "with_cons", "interpret",
     ),
 )
 def _call(
@@ -459,6 +606,7 @@ def _call(
     taint_id_t, taint_eff_t,
     p_cpu, p_mem, p_valid, p_nnid, untol,
     aff_args,       # () or the 20-tuple of affinity arrays (see below)
+    cons_args,      # () or the constraint tuple (see fused_topk)
     *,
     chunk: int,
     k: int,
@@ -466,7 +614,10 @@ def _call(
     w_ba: int,
     w_tt: int,
     w_na: int,
+    w_ts: int,
+    w_ipa: int,
     with_aff: bool,
+    with_cons: bool,
     interpret: bool,
 ):
     n = cpu_alloc.shape[0]
@@ -489,6 +640,16 @@ def _call(
     def podw(w):    # [TB, W] pod-row block of width w
         return pl.BlockSpec(
             (tb, w), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM
+        )
+
+    def cols(rows):  # [rows, C] chunked slot-table columns
+        return pl.BlockSpec(
+            (rows, chunk), lambda bi, ci: (0, ci), memory_space=pltpu.VMEM
+        )
+
+    def whole(a):    # small replicated table, full block
+        return pl.BlockSpec(
+            a.shape, lambda bi, ci: (0, 0), memory_space=pltpu.VMEM
         )
 
     out = pl.BlockSpec((tb, k), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM)
@@ -522,6 +683,19 @@ def _call(
             pl.BlockSpec((qn, 1), lambda bi, ci: (0, 0), memory_space=pltpu.VMEM),
         ]
         args += [lkey_t, lval_t, lnum_t, qkey.reshape(qn, 1)]
+    if with_cons:
+        (zone, region, sn, tn, on_, sz, sr, tz, tr, oz, orr,
+         cons_pod) = cons_args
+        in_specs += [
+            col, col, cols(sn.shape[0]), cols(tn.shape[0]),
+            cols(on_.shape[0]),
+            whole(sz), whole(sr), whole(tz), whole(tr), whole(oz),
+            whole(orr),
+        ]
+        args += [
+            zone.reshape(1, n), region.reshape(1, n), sn, tn, on_,
+            sz, sr, tz, tr, oz, orr,
+        ]
     in_specs += [pod, pod, pod, pod, podw(m)]
     args += [
         p_cpu.reshape(b, 1), p_mem.reshape(b, 1),
@@ -538,10 +712,15 @@ def _call(
         aff_pod = [a.astype(jnp.int32) for a in aff_pod]
         in_specs += [podw(a.shape[1]) for a in aff_pod]
         args += aff_pod
+    if with_cons:
+        cons_pod = [a.astype(jnp.int32) for a in cons_pod]
+        in_specs += [podw(a.shape[1]) for a in cons_pod]
+        args += cons_pod
 
     kernel = functools.partial(
         _kernel, chunk=chunk, k=k,
-        w_la=w_la, w_ba=w_ba, w_tt=w_tt, w_na=w_na, with_aff=with_aff,
+        w_la=w_la, w_ba=w_ba, w_tt=w_tt, w_na=w_na, w_ts=w_ts, w_ipa=w_ipa,
+        with_aff=with_aff, with_cons=with_cons,
     )
     idx, prio = pl.pallas_call(
         kernel,
@@ -573,6 +752,8 @@ def fused_topk(
     chunk: int,
     k: int,
     with_affinity: bool = True,
+    constraints=None,
+    stats=None,
     interpret: bool | None = None,
 ):
     """(idx i32[B,K], prio i32[B,K]) — global-row candidates, -1 = none.
@@ -581,14 +762,25 @@ def fused_topk(
     ``with_affinity=False`` compiles the cheaper base kernel for waves
     whose pods carry no selectors (the coordinator knows from the packed
     field groups); it changes cost, never semantics, for such waves.
+    ``constraints``+``stats`` (a ConstraintState and its
+    topology.prologue) enable the fused constraint stage — BASELINE
+    configs 3-4 on the pallas path.  Size TableSpec.max_zones/max_regions
+    and the slot/ref dims to the workload: the constraint stage
+    materializes [max_zones, chunk] one-hot planes in VMEM and unrolls
+    one evaluation per ref slot, so worst-case schema dims cost real
+    VMEM and compile time (same rule as the affinity slots).
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same
     tests run on the CPU mesh.
     """
-    if not supports(profile):
+    with_cons = constraints is not None
+    if with_cons and stats is None:
         raise ValueError(
-            "pallas backend supports only stateless profiles "
-            "(topology_spread/interpod_affinity weights 0); "
-            f"got {profile}"
+            "constraints require stats=topology.prologue(table, constraints)"
+        )
+    if not with_cons and not supports(profile):
+        raise ValueError(
+            "profile has constraint plugins enabled; pass constraints= "
+            f"and stats= to run them fused (got {profile})"
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -619,6 +811,53 @@ def fused_topk(
         )
     else:
         aff_args = ()
+    if with_cons:
+        from k8s1m_tpu.plugins import topology as topo
+
+        i32 = jnp.int32
+        b = batch.batch
+        sp_min = topo._stat_for(
+            stats.spread_min, batch.spread_cid, batch.spread_topo
+        )
+        sp_max = topo._stat_for(
+            stats.spread_max, batch.spread_cid, batch.spread_topo
+        )
+        sp_hard = (
+            batch.spread_valid & (batch.spread_mode == SPREAD_DO_NOT_SCHEDULE)
+        )
+        total = jnp.take(stats.tgt_total, batch.ipa_tid)
+        boot = (total == 0) & batch.ipa_self
+        reqaff = batch.ipa_valid & batch.ipa_required & ~batch.ipa_anti
+        reqanti = batch.ipa_valid & batch.ipa_required & batch.ipa_anti
+        pref = batch.ipa_valid & ~batch.ipa_required
+        prefsign = jnp.where(
+            pref, jnp.where(batch.ipa_anti, -1, 1) * batch.ipa_weight, 0
+        )
+        bound = (
+            jnp.abs(batch.ipa_weight)
+            * jnp.take(stats.tgt_max, batch.ipa_tid)
+            * pref
+        ).sum(axis=1)
+        cons_pod = [
+            batch.spread_cid, batch.spread_topo, batch.spread_max_skew,
+            sp_hard, batch.spread_valid, batch.spread_self, sp_min, sp_max,
+            batch.ipa_tid, batch.ipa_topo, reqaff, reqanti, boot, prefsign,
+            batch.iinc_tid, batch.iinc_topo, batch.iinc_valid,
+            jnp.maximum(bound, 1).reshape(b, 1),
+            pref.any(axis=1).reshape(b, 1),
+            jnp.maximum(batch.spread_valid.sum(axis=1), 1).reshape(b, 1),
+        ]
+        c = constraints
+        cons_args = (
+            table.zone, table.region,
+            c.spread_node.astype(i32), c.tgt_node.astype(i32),
+            c.own_node.astype(i32),
+            c.spread_zone, c.spread_region, c.tgt_zone, c.tgt_region,
+            c.own_zone, c.own_region,
+            cons_pod,
+        )
+    else:
+        cons_args = ()
     return _call(
         jnp.asarray(seed, jnp.int32),
         table.cpu_alloc, table.mem_alloc, table.pods_alloc,
@@ -627,12 +866,16 @@ def fused_topk(
         batch.cpu, batch.mem, batch.valid, batch.node_name_id,
         1.0 - batch.tolerated.astype(jnp.float32),
         aff_args,
+        cons_args,
         chunk=chunk, k=k,
         w_la=profile.least_allocated,
         w_ba=profile.balanced_allocation,
         w_tt=profile.taint_toleration,
         w_na=profile.node_affinity,
+        w_ts=profile.topology_spread if with_cons else 0,
+        w_ipa=profile.interpod_affinity if with_cons else 0,
         with_aff=with_affinity,
+        with_cons=with_cons,
         interpret=interpret,
     )
 
@@ -652,18 +895,22 @@ def pallas_candidates(
     k: int,
     row_offset=0,
     with_affinity: bool = True,
+    constraints=None,
+    stats=None,
     interpret: bool | None = None,
 ):
-    """Drop-in for engine.filter_score_topk on stateless profiles.
+    """Drop-in for engine.filter_score_topk.
 
     Returns engine.cycle.Candidates with the same payload columns (free
     capacity + topology domains gathered at the candidate rows).
+    ``constraints``/``stats`` run the stateful plugins fused (fused_topk).
     """
     from k8s1m_tpu.engine.cycle import Candidates
 
     idx, prio = fused_topk(
         table, batch, seed_of(key), profile,
-        chunk=chunk, k=k, with_affinity=with_affinity, interpret=interpret,
+        chunk=chunk, k=k, with_affinity=with_affinity,
+        constraints=constraints, stats=stats, interpret=interpret,
     )
     safe = jnp.clip(idx, 0)
     free_cpu, free_mem, free_pods = table.free()

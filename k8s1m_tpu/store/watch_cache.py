@@ -430,6 +430,12 @@ class UpstreamHandle:
         try:
             await s.request_progress()
         except Exception:
+            # The request never reached the store; leaving the counter
+            # bumped would make every later confirm wait for a response
+            # that can't come (until the next reprime realigns).
+            # Decrement (not restore-to-target-1): a concurrent confirm
+            # may have advanced the counter past ours meanwhile.
+            self.requests_sent -= 1
             return False
         if self.progress_count >= target:
             return True

@@ -346,3 +346,120 @@ def test_affinity_backend_parity_end_to_end(rng):
     )
     np.testing.assert_array_equal(np.asarray(asg_x.bound), np.asarray(asg_p.bound))
     np.testing.assert_array_equal(np.asarray(asg_x.score), np.asarray(asg_p.score))
+
+
+# ---- fused constraint stage (PodTopologySpread + InterPodAffinity) -------
+
+
+def build_cons(rng, num_nodes=N):
+    """Nodes over zones/regions with adversarial missing-label rows, a
+    populated ConstraintState (spread + affinity + anti owners), and a
+    mixed constrained pod batch."""
+    from k8s1m_tpu.cluster.workload import (
+        affinity_deployment,
+        spread_deployment,
+    )
+    from k8s1m_tpu.config import TOPO_REGION, TOPO_ZONE
+    from k8s1m_tpu.snapshot.constraints import (
+        ConstraintTracker,
+        empty_constraints,
+    )
+    from k8s1m_tpu.snapshot.node_table import REGION_LABEL, ZONE_LABEL
+
+    spec = TableSpec(
+        max_nodes=num_nodes, max_zones=8, max_regions=4,
+        spread_slots=8, affinity_slots=8,
+    )
+    host = NodeTableHost(spec)
+    for i in range(num_nodes):
+        labels = {}
+        if i % 11 != 7:
+            labels[ZONE_LABEL] = f"z{i % 5}"
+        if i % 13 != 5:
+            labels[REGION_LABEL] = f"r{i % 3}"
+        host.upsert(NodeInfo(
+            name=f"n{i}", cpu_milli=64_000, mem_kib=1 << 26, pods=64,
+            labels=labels,
+        ))
+    tracker = ConstraintTracker(spec)
+    pods = (
+        spread_deployment(tracker, "sp-z", 6, topo=TOPO_ZONE)
+        + spread_deployment(tracker, "sp-r", 4, topo=TOPO_REGION, max_skew=2)
+        + affinity_deployment(tracker, "aff", 4, anti=False, required=True)
+        + affinity_deployment(tracker, "anti", 6, anti=True, required=True)
+        + affinity_deployment(tracker, "pref", 4, required=False)
+    )
+    rng.shuffle(pods)
+    pspec = PodSpec(batch=32)
+    enc = PodBatchHost(pspec, spec, host.vocab)
+    cons = empty_constraints(spec)
+    return spec, host, enc, pods, cons
+
+
+def _populate_counts(host, enc, pods, cons):
+    """Schedule a first constrained wave on the XLA path so the count
+    tables are non-trivial for the comparison batch."""
+    table = host.to_device()
+    batch = enc.encode(pods[:12])
+    table, cons, _ = schedule_batch(
+        table, batch, jax.random.key(11), profile=Profile(),
+        constraints=cons, chunk=CHUNK, k=4, backend="xla",
+    )
+    return table, cons
+
+
+def test_constraints_match_xla_feasibility_and_scores(rng):
+    """The fused constraint stage computes the same feasible set and the
+    same integer scores as plugins/topology.py on populated count
+    tables (the configs 3-4 exactness check)."""
+    from k8s1m_tpu.plugins import topology
+
+    spec, host, enc, pods, cons = build_cons(rng)
+    table, cons = _populate_counts(host, enc, pods, cons)
+    batch = enc.encode(pods[12:])
+    prof = Profile()
+    stats = topology.prologue(table, cons)
+
+    idx, prio = fused_topk(
+        table, batch, jnp.int32(77), prof, chunk=CHUNK, k=4,
+        constraints=cons, stats=stats,
+    )
+    mask, score = score_and_filter(table, batch, prof, cons, stats)
+    mask = np.asarray(mask & batch.valid[:, None] & table.valid[None, :])
+    score = np.asarray(jnp.where(mask, score, -1))
+
+    idx, prio = np.asarray(idx), np.asarray(prio)
+    for b in range(batch.batch):
+        feasible = mask[b].sum()
+        expect_k = min(4, int(feasible))
+        assert (prio[b] >= 0).sum() == expect_k, b
+        order = np.sort(score[b][mask[b]])[::-1]
+        for j in range(expect_k):
+            assert mask[b, idx[b, j]], (b, j)
+            assert score[b, idx[b, j]] == (prio[b, j] >> 20), (b, j)
+        np.testing.assert_array_equal(
+            np.sort(prio[b, :expect_k] >> 20)[::-1], order[:expect_k]
+        )
+
+
+def test_constrained_schedule_batch_parity(rng):
+    """End-to-end constrained cycle agrees across backends on bound set
+    and scores (jitter differs, so tie choices may differ)."""
+    spec, host, enc, pods, cons = build_cons(rng)
+    table, cons = _populate_counts(host, enc, pods, cons)
+    batch = enc.encode(pods[12:])
+    key = jax.random.key(5)
+    _, _, asg_x = schedule_batch(
+        table, batch, key, profile=Profile(), constraints=cons,
+        chunk=CHUNK, k=4, backend="xla",
+    )
+    _, _, asg_p = schedule_batch(
+        table, batch, key, profile=Profile(), constraints=cons,
+        chunk=CHUNK, k=4, backend="pallas",
+    )
+    np.testing.assert_array_equal(
+        np.asarray(asg_x.bound), np.asarray(asg_p.bound)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(asg_x.score), np.asarray(asg_p.score)
+    )

@@ -85,10 +85,21 @@ def parse_args(argv=None):
         "ago while new waves arrive — sustained create+delete churn "
         "instead of a fill-up",
     )
-    args = ap.parse_args(argv)
-    if args.rate and args.churn:
-        ap.error("--churn is not implemented for the paced --rate mode")
-    return args
+    return ap.parse_args(argv)
+
+
+def write_wave(store, items) -> None:
+    """Apply (key, value|None-for-delete) pairs via the store's batched
+    path when it has one, else per key."""
+    put_batch = getattr(store, "put_batch", None)
+    if put_batch is not None:
+        put_batch(items)
+        return
+    for k, v in items:
+        if v is None:
+            store.delete(k)
+        else:
+            store.put(k, v)
 
 
 def main(argv=None):
@@ -103,21 +114,15 @@ def main(argv=None):
     else:
         store = MemStore()
 
-    put_batch = getattr(store, "put_batch", None)
-
     t0 = time.perf_counter()
-    if put_batch is not None:
-        items = []
-        for i in range(args.nodes):
-            items.append((node_key(f"kwok-node-{i}"), encode_node(build_node(i))))
-            if len(items) == 8192:
-                put_batch(items)
-                items.clear()
-        if items:
-            put_batch(items)
-    else:
-        for i in range(args.nodes):
-            store.put(node_key(f"kwok-node-{i}"), encode_node(build_node(i)))
+    items = []
+    for i in range(args.nodes):
+        items.append((node_key(f"kwok-node-{i}"), encode_node(build_node(i))))
+        if len(items) == 8192:
+            write_wave(store, items)
+            items.clear()
+    if items:
+        write_wave(store, items)
     nodes_s = time.perf_counter() - t0
 
     cap = 1 << max(10, (args.nodes - 1).bit_length())
@@ -146,12 +151,13 @@ def main(argv=None):
     if args.churn:
         # Churn also exercises the dirty-row scatter (delete -> row
         # re-upload) at full wave-sized buckets; compile those now too.
-        for i in range(4096):
-            store.put(pod_key("warm", f"w-{i}"),
-                      encode_pod(PodInfo(f"w-{i}", cpu_milli=1, mem_kib=1)))
+        wk = [pod_key("warm", f"w-{i}") for i in range(4096)]
+        write_wave(store, [
+            (k, encode_pod(PodInfo(f"w-{i}", cpu_milli=1, mem_kib=1)))
+            for i, k in enumerate(wk)
+        ])
         coord.run_until_idle()
-        for i in range(4096):
-            store.delete(pod_key("warm", f"w-{i}"))
+        write_wave(store, [(k, None) for k in wk])
         coord.run_until_idle()
 
     # Producer interleaved with scheduling, like make_pods running against
@@ -178,11 +184,7 @@ def main(argv=None):
             vs = [encode_pod(PodInfo(f"r-{woff+i}", cpu_milli=1, mem_kib=1))
                   for i in range(b)]
             woff += b
-            if put_batch is not None:
-                put_batch(list(zip(ks, vs)))
-            else:
-                for kk, vv in zip(ks, vs):
-                    store.put(kk, vv)
+            write_wave(store, list(zip(ks, vs)))
             coord.run_until_idle()
         REGISTRY.get("coordinator_schedule_to_bind_seconds").reset()
         if args.stats:
@@ -191,18 +193,29 @@ def main(argv=None):
 
         # Paced producer: emit pods on the offered-load schedule, step
         # the coordinator continuously, measure intake-to-bind latency.
+        # --churn deletes pods a fixed lag behind the emission point
+        # (config 5's sustained create+delete shape at a steady rate).
+        lag = 3 * coord.pod_spec.batch
         t0 = time.perf_counter()
         bound = 0
         emitted = 1
+        deleted = 1
         while emitted < args.pods or coord.queue or coord._inflights:
             due = min(args.pods, 1 + int(args.rate * (time.perf_counter() - t0)))
             if due > emitted:
-                if put_batch is not None:
-                    put_batch(list(zip(keys[emitted:due], values[emitted:due])))
-                else:
-                    for k, v in zip(keys[emitted:due], values[emitted:due]):
-                        store.put(k, v)
+                write_wave(
+                    store, list(zip(keys[emitted:due], values[emitted:due]))
+                )
                 emitted = due
+                # Frontier capped by bind progress: under overload the
+                # queue outgrows the lag, and deleting still-pending
+                # pods would silently subset the latency metrics.
+                frontier = min(emitted - lag, 1 + bound)
+                if args.churn and frontier > deleted:
+                    write_wave(
+                        store, [(k, None) for k in keys[deleted:frontier]]
+                    )
+                    deleted = frontier
             bound += coord.step()
             if emitted >= args.pods and not coord.queue and not coord._inflights:
                 bound += coord.run_until_idle()
@@ -222,6 +235,8 @@ def main(argv=None):
                 "score_pct": args.score_pct,
                 "binds_per_sec": round(e2e, 1),
                 "bound": bound,
+                "unbound": args.pods - 1 - bound,
+                "deleted": deleted - 1 if args.churn else 0,
                 "p50_ms": round(lat.quantile(0.5) * 1e3, 2),
                 "p95_ms": round(lat.quantile(0.95) * 1e3, 2),
                 "p99_ms": round(lat.quantile(0.99) * 1e3, 2),
@@ -238,21 +253,15 @@ def main(argv=None):
     off = 1
     deleted = 0
     while off < args.pods:
-        if put_batch is not None:
-            put_batch(list(zip(keys[off:off + wave], values[off:off + wave])))
-        else:
-            for k, v in zip(keys[off:off + wave], values[off:off + wave]):
-                store.put(k, v)
+        write_wave(
+            store, list(zip(keys[off:off + wave], values[off:off + wave]))
+        )
         if args.churn and off > 2 * wave:
             # Delete the wave bound two waves ago — the scheduler keeps
             # binding into capacity that deletions keep freeing.
             lo = off - 3 * wave
             dels = keys[max(1, lo):lo + wave]
-            if put_batch is not None:
-                put_batch([(k, None) for k in dels])
-            else:
-                for k in dels:
-                    store.delete(k)
+            write_wave(store, [(k, None) for k in dels])
             deleted += len(dels)
         off += wave
         bound += coord.step()

@@ -150,6 +150,7 @@ def main() -> None:
     import k8s1m_tpu.control.leader  # noqa: F401
     import k8s1m_tpu.control.webhook  # noqa: F401
     import k8s1m_tpu.store.etcd_server  # noqa: F401
+    import k8s1m_tpu.store.watch_cache  # noqa: F401
 
     print(json.dumps(build_dashboard(), indent=1))
 

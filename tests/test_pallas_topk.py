@@ -463,3 +463,20 @@ def test_constrained_schedule_batch_parity(rng):
     np.testing.assert_array_equal(
         np.asarray(asg_x.score), np.asarray(asg_p.score)
     )
+
+
+def test_scaled_oracle_chunk_and_tile_boundaries(rng):
+    """Bit-exact oracle parity at a scale that crosses both grid axes:
+    4096 nodes / chunk 512 (8 node chunks) and a 512-pod batch (2 pod
+    tiles of 256) — the boundary classes a 256-node test cannot reach
+    (running top-k carry across chunks, per-tile row offsets in the
+    jitter hash, padding rows in the last chunk)."""
+    spec, host = build(rng, num_nodes=4096)
+    batch = pods(host, spec, batch=512, tolerate=True)
+    table = host.to_device()
+    idx, prio = fused_topk(
+        table, batch, jnp.int32(99991), BASE, chunk=512, k=4
+    )
+    ref_i, ref_p = np_reference_topk(table, batch, 99991, BASE, k=4)
+    np.testing.assert_array_equal(np.asarray(prio), ref_p)
+    np.testing.assert_array_equal(np.asarray(idx), ref_i)

@@ -482,9 +482,12 @@ class Coordinator:
         heavy churn; the per-call bound keeps the cycle live against a
         producer that outruns the decode pass.
 
-        The native store's poll_pods drains AND parses canonical pods in
-        C (columnar arrays, no per-event Python objects); watchers
-        without it (RemoteWatcher) take the per-event decode path."""
+        Both watcher types expose poll_pods — the native store drains
+        AND parses in one C call; RemoteWatcher runs its buffered wire
+        events through the same parser (ms_parse_pod_events) — so the
+        columnar fast lane serves in-process and deployed topologies
+        alike.  The per-event fallback below remains for third-party
+        watcher implementations without poll_pods."""
         if getattr(self._pods_watch, "poll_pods", None) is not None:
             n = 0
             batch = min(max_events, 10000)

@@ -242,6 +242,11 @@ def _load_lib():
         c.c_void_p, c.c_int64, c.c_int, c.c_char_p, c.c_size_t,
         c.POINTER(P8), c.POINTER(c.c_size_t),
     ]
+    lib.ms_parse_pod_events.restype = c.c_int
+    lib.ms_parse_pod_events.argtypes = [
+        c.c_char_p, c.c_size_t, c.c_int, c.c_char_p, c.c_size_t,
+        c.POINTER(P8), c.POINTER(c.c_size_t),
+    ]
     lib.ms_wal_sync.restype = c.c_int
     lib.ms_wal_sync.argtypes = [c.c_void_p]
     return lib
@@ -378,6 +383,39 @@ class Watcher:
         if not self.canceled:
             _lib().ms_watch_cancel(self._store._h, self.id)
             self.canceled = True
+
+
+_POD_EV_REC = struct.Struct("<bqII")
+
+
+def parse_pod_events(
+    events, scheduler_name: bytes = b""
+) -> PodEventBatch:
+    """Run the native canonical-pod parser over already-received events
+    (``(etype, key, value, mod_revision)`` tuples, e.g. a RemoteWatcher's
+    buffered wire events) — the store-independent half of poll_pods, so
+    the wire topology gets the same columnar fast lane as the in-process
+    store."""
+    lib = _lib()
+    parts = []
+    pack = _POD_EV_REC.pack
+    n = 0
+    for etype, key, value, mrev in events:
+        v = value or b""
+        parts.append(pack(etype, mrev, len(key), len(v)))
+        parts.append(key)
+        parts.append(v)
+        n += 1
+    frame = b"".join(parts)
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_size_t()
+    rc = lib.ms_parse_pod_events(
+        frame, len(frame), n, scheduler_name, len(scheduler_name),
+        ctypes.byref(out), ctypes.byref(out_len),
+    )
+    if rc < 0:
+        raise ValueError(f"ms_parse_pod_events rc={rc}")
+    return PodEventBatch.parse(_take_buf(lib, out, out_len))
 
 
 def drain_events(watcher, batch: int = 10000, limit: int = 200_000):

@@ -123,15 +123,14 @@ class RemoteWatcher:
                         if len(self._events) >= self._queue_cap:
                             self._dropped += 1
                             continue
-                        kind = (
-                            "DELETE"
-                            if ev.type == mvcc_pb2.Event.DELETE
-                            else "PUT"
-                        )
-                        prev = (
-                            _kv(ev.prev_kv) if ev.HasField("prev_kv") else None
-                        )
-                        self._events.append(WatchEvent(kind, _kv(ev.kv), prev))
+                        # Raw protobuf refs only; WatchEvent/KeyValue
+                        # wrappers are built lazily in poll() so the
+                        # columnar poll_pods path never pays for them.
+                        self._events.append((
+                            1 if ev.type == mvcc_pb2.Event.DELETE else 0,
+                            ev.kv,
+                            ev.prev_kv if ev.HasField("prev_kv") else None,
+                        ))
         except grpc.RpcError as e:
             ended_clean = True  # error path already counted below
             if not self.canceled:
@@ -154,12 +153,45 @@ class RemoteWatcher:
             # once self.canceled is set).
             self._requests.put(None)
 
-    def poll(self, max_events: int = 1000, timeout_ms: int = 0) -> list[WatchEvent]:
+    def _drain_raw(self, max_events: int) -> list:
         out = []
         with self._lock:
             while self._events and len(out) < max_events:
                 out.append(self._events.popleft())
         return out
+
+    def poll(self, max_events: int = 1000, timeout_ms: int = 0) -> list[WatchEvent]:
+        return [
+            WatchEvent(
+                "DELETE" if etype else "PUT",
+                _kv(kv),
+                _kv(prev) if prev is not None else None,
+            )
+            for etype, kv, prev in self._drain_raw(max_events)
+        ]
+
+    def poll_pods(
+        self, max_events: int = 10000, scheduler_name: bytes = b""
+    ) -> "PodEventBatch":
+        """Drain buffered wire events through the native canonical-pod
+        parser (ms_parse_pod_events) — the deployed topology's version of
+        the in-process watcher's poll_pods: one columnar frame instead of
+        per-event Python decode (the reader buffers raw protobuf refs, so
+        this path builds no per-event wrapper objects at all)."""
+        from k8s1m_tpu.store.native import parse_pod_events
+
+        return parse_pod_events(
+            (
+                (etype, kv.key, kv.value, kv.mod_revision)
+                for etype, kv, _prev in self._drain_raw(max_events)
+            ),
+            scheduler_name,
+        )
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._events)
 
     @property
     def dropped(self) -> int:

@@ -36,6 +36,7 @@
 #include <queue>
 #include <shared_mutex>
 #include <string>
+#include <string_view>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -1193,7 +1194,7 @@ struct PodParse {
   size_t node_len = 0;
 };
 
-inline bool lit_at(const std::string& v, size_t pos, const char* lit,
+inline bool lit_at(std::string_view v, size_t pos, const char* lit,
                    size_t lit_len) {
   return pos + lit_len <= v.size() && memcmp(v.data() + pos, lit, lit_len) == 0;
 }
@@ -1214,7 +1215,7 @@ bool parse_qty(const char* p, size_t n, const char* suffix, size_t suffix_len,
   return true;
 }
 
-bool parse_pod(const std::string& v, const uint8_t* sched, size_t sched_len,
+bool parse_pod(std::string_view v, const uint8_t* sched, size_t sched_len,
                PodParse* out) {
 #define LIT(name) name, sizeof(name) - 1
   if (!lit_at(v, 0, LIT(kPodHead))) return false;
@@ -1267,6 +1268,73 @@ bool parse_pod(const std::string& v, const uint8_t* sched, size_t sched_len,
 #undef LIT
 }
 
+// One event's raw view for the columnar pod-frame emitter (val == null
+// or vlen == 0 with etype DELETE means no value).
+struct PodEventView {
+  uint8_t etype = 0;
+  int64_t mrev = 0;
+  const char* key = nullptr;
+  size_t klen = 0;
+  const char* val = nullptr;
+  size_t vlen = 0;
+};
+
+// Shared by ms_watch_poll_pods (store-side drain) and
+// ms_parse_pod_events (wire-side parse): emit the columnar frame
+// documented in memstore.h.
+template <typename GetView>
+uint8_t* emit_pod_frame(size_t n, bool canceled, const uint8_t* sched,
+                        size_t sched_len, GetView get, size_t* out_len) {
+  std::vector<uint8_t> etype(n), flags(n);
+  std::vector<int64_t> mrev(n);
+  std::vector<int32_t> cpu(n, 0), mem(n, 0);
+  std::vector<uint32_t> koff(n + 1, 0), aoff(n + 1, 0);
+  std::string keys, aux;
+  for (size_t i = 0; i < n; i++) {
+    PodEventView ev = get(i);
+    etype[i] = ev.etype;
+    mrev[i] = ev.mrev;
+    keys.append(ev.key, ev.klen);
+    koff[i + 1] = static_cast<uint32_t>(keys.size());
+    uint8_t f = 0;
+    if (ev.etype == 0 && ev.val != nullptr) {
+      std::string_view value(ev.val, ev.vlen);
+      PodParse p;
+      if (parse_pod(value, sched, sched_len, &p)) {
+        f |= MS_POD_CANONICAL;
+        if (p.sched_match) f |= MS_POD_SCHED_MATCH;
+        if (p.has_node) {
+          f |= MS_POD_HAS_NODE;
+          aux.append(p.node, p.node_len);
+        }
+        cpu[i] = p.cpu;
+        mem[i] = p.mem;
+      } else {
+        aux.append(value);
+      }
+    }
+    flags[i] = f;
+    aoff[i + 1] = static_cast<uint32_t>(aux.size());
+  }
+
+  std::string b;
+  b.reserve(8 + 2 * n + 8 + 16 * n + 8 * (n + 1) + keys.size() + aux.size());
+  put_u32(b, static_cast<uint32_t>(n));
+  put_u8(b, canceled ? 1 : 0);
+  b.append(3, '\0');
+  b.append(reinterpret_cast<const char*>(etype.data()), n);
+  b.append(reinterpret_cast<const char*>(flags.data()), n);
+  b.append((8 - (b.size() % 8)) % 8, '\0');
+  b.append(reinterpret_cast<const char*>(mrev.data()), 8 * n);
+  b.append(reinterpret_cast<const char*>(cpu.data()), 4 * n);
+  b.append(reinterpret_cast<const char*>(mem.data()), 4 * n);
+  b.append(reinterpret_cast<const char*>(koff.data()), 4 * (n + 1));
+  b.append(reinterpret_cast<const char*>(aoff.data()), 4 * (n + 1));
+  b.append(keys);
+  b.append(aux);
+  return to_malloc(b, out_len);
+}
+
 }  // namespace
 
 int ms_watch_poll_pods(ms_store* s, int64_t watcher_id, int max_events,
@@ -1291,55 +1359,51 @@ int ms_watch_poll_pods(ms_store* s, int64_t watcher_id, int max_events,
     }
   }
 
-  const size_t n = events.size();
-  std::vector<uint8_t> etype(n), flags(n);
-  std::vector<int64_t> mrev(n);
-  std::vector<int32_t> cpu(n, 0), mem(n, 0);
-  std::vector<uint32_t> koff(n + 1, 0), aoff(n + 1, 0);
-  std::string keys, aux;
-  for (size_t i = 0; i < n; i++) {
-    const Event& ev = events[i];
-    etype[i] = ev.type;
-    mrev[i] = ev.kv.mod_rev;
-    keys.append(ev.key);
-    koff[i + 1] = static_cast<uint32_t>(keys.size());
-    uint8_t f = 0;
-    if (ev.type == 0 && ev.kv.val) {
-      PodParse p;
-      if (parse_pod(*ev.kv.val, sched, sched_len, &p)) {
-        f |= MS_POD_CANONICAL;
-        if (p.sched_match) f |= MS_POD_SCHED_MATCH;
-        if (p.has_node) {
-          f |= MS_POD_HAS_NODE;
-          aux.append(p.node, p.node_len);
-        }
-        cpu[i] = p.cpu;
-        mem[i] = p.mem;
-      } else {
-        aux.append(*ev.kv.val);
-      }
-    }
-    flags[i] = f;
-    aoff[i + 1] = static_cast<uint32_t>(aux.size());
-  }
+  *out = emit_pod_frame(
+      events.size(), canceled, sched, sched_len,
+      [&](size_t i) -> PodEventView {
+        const Event& ev = events[i];
+        return PodEventView{
+            ev.type, ev.kv.mod_rev, ev.key.data(), ev.key.size(),
+            ev.kv.val ? ev.kv.val->data() : nullptr,
+            ev.kv.val ? ev.kv.val->size() : 0};
+      },
+      out_len);
+  return static_cast<int>(events.size());
+}
 
-  std::string b;
-  b.reserve(8 + 2 * n + 8 + 16 * n + 8 * (n + 1) + keys.size() + aux.size());
-  put_u32(b, static_cast<uint32_t>(n));
-  put_u8(b, canceled ? 1 : 0);
-  b.append(3, '\0');
-  b.append(reinterpret_cast<const char*>(etype.data()), n);
-  b.append(reinterpret_cast<const char*>(flags.data()), n);
-  b.append((8 - (b.size() % 8)) % 8, '\0');
-  b.append(reinterpret_cast<const char*>(mrev.data()), 8 * n);
-  b.append(reinterpret_cast<const char*>(cpu.data()), 4 * n);
-  b.append(reinterpret_cast<const char*>(mem.data()), 4 * n);
-  b.append(reinterpret_cast<const char*>(koff.data()), 4 * (n + 1));
-  b.append(reinterpret_cast<const char*>(aoff.data()), 4 * (n + 1));
-  b.append(keys);
-  b.append(aux);
-  *out = to_malloc(b, out_len);
-  return static_cast<int>(n);
+int ms_parse_pod_events(const uint8_t* buf, size_t len, int n,
+                        const uint8_t* sched, size_t sched_len, uint8_t** out,
+                        size_t* out_len) {
+  if (n < 0) return MS_ERR_INVALID;
+  // Validate and index the whole frame first (records:
+  // u8 etype | i64 mrev | u32 klen | u32 vlen | key | value).
+  std::vector<PodEventView> views;
+  views.reserve(n);
+  size_t off = 0;
+  for (int i = 0; i < n; i++) {
+    if (off + 17 > len) return MS_ERR_INVALID;
+    PodEventView v{};
+    v.etype = buf[off];
+    memcpy(&v.mrev, buf + off + 1, 8);
+    uint32_t klen, vlen;
+    memcpy(&klen, buf + off + 9, 4);
+    memcpy(&vlen, buf + off + 13, 4);
+    off += 17;
+    if (off + klen + vlen > len) return MS_ERR_INVALID;
+    v.key = reinterpret_cast<const char*>(buf + off);
+    v.klen = klen;
+    off += klen;
+    v.val = reinterpret_cast<const char*>(buf + off);
+    v.vlen = vlen;
+    off += vlen;
+    views.push_back(v);
+  }
+  if (off != len) return MS_ERR_INVALID;  // trailing bytes = caller bug
+  *out = emit_pod_frame(
+      static_cast<size_t>(n), false, sched, sched_len,
+      [&](size_t i) { return views[i]; }, out_len);
+  return n;
 }
 
 int64_t ms_watch_dropped(ms_store* s, int64_t watcher_id) {

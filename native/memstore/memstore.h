@@ -221,6 +221,17 @@ enum {
   MS_POD_SCHED_MATCH = 4 /* spec.schedulerName == sched argument */
 };
 
+/* Store-independent variant of the pod-event parse, for events that
+ * arrived over the wire (a remote watcher's buffered protobuf events):
+ * n input records packed as
+ *   u8 etype | i64 mod_revision | u32 klen | u32 vlen | key | value
+ * are parsed into the same columnar frame ms_watch_poll_pods emits
+ * (canceled always 0).  Returns n or MS_ERR_INVALID on a malformed
+ * buffer. */
+int ms_parse_pod_events(const uint8_t* buf, size_t len, int n,
+                        const uint8_t* sched, size_t sched_len, uint8_t** out,
+                        size_t* out_len);
+
 /* Events dropped on this watcher because its queue (10,000 deep, like
  * reference store.rs:27) overflowed; the server should cancel such
  * watchers. */

@@ -639,3 +639,35 @@ def test_bind_batch_echo_suppression(store):
     rev2 = store.put(k2, encode_pod(PodInfo("q")))
     store.bind_batch([(k2, rev2, b"n-2")])
     assert len(mine.poll_light()) == 2
+
+
+def test_parse_pod_events_matches_poll_pods(store):
+    """The store-independent parser (wire-side fast lane) emits the same
+    columnar frame as the store-side drain for the same events."""
+    from k8s1m_tpu.control.objects import encode_pod, pod_key
+    from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+    from k8s1m_tpu.store.native import parse_pod_events
+
+    w1 = _pods_watch(store)
+    w2 = _pods_watch(store)
+    store.put(pod_key("a", "p1"), encode_pod(PodInfo("p1", cpu_milli=7)))
+    store.put(pod_key("a", "p2"), encode_pod(PodInfo("p2", labels={"x": "y"})))
+    store.put(pod_key("a", "p3"),
+              encode_pod(PodInfo("p3", scheduler_name="other")))
+    store.delete(pod_key("a", "p3"))
+
+    native = w1.poll_pods(100, b"dist-scheduler")
+    wire = parse_pod_events(
+        ((0 if e.type == "PUT" else 1, e.kv.key, e.kv.value,
+          e.kv.mod_revision) for e in w2.poll(100)),
+        b"dist-scheduler",
+    )
+    assert wire.n == native.n == 4
+    for f in ("etype", "flags", "mrev", "cpu", "mem", "koff", "aoff"):
+        import numpy as np
+
+        np.testing.assert_array_equal(
+            getattr(wire, f), getattr(native, f), f
+        )
+    assert wire.key_blob == native.key_blob
+    assert wire.aux_blob == native.aux_blob

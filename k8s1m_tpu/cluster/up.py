@@ -24,6 +24,14 @@ def parse_args(argv=None):
     ap.add_argument("--pods", type=int, default=1000)
     ap.add_argument("--kwok-groups", type=int, default=2)
     ap.add_argument("--coordinators", type=int, default=2)
+    ap.add_argument("--shards", type=int, default=1,
+                    help=">1 deploys the scheduler shard set (pod-hash "
+                    "intake split + node ownership masks + rebalancer)")
+    ap.add_argument("--watch-cache", action="store_true",
+                    help="deploy the apiserver tier; KWOK controllers "
+                    "connect through it")
+    ap.add_argument("--watch-cache-index", choices=("hash", "btree"),
+                    default="hash")
     ap.add_argument("--pod-batch", type=int, default=256)
     ap.add_argument("--chunk", type=int, default=1 << 10)
     ap.add_argument("--backend", choices=("xla", "pallas"), default="xla")
@@ -43,6 +51,9 @@ def main(argv=None):
         nodes=args.nodes,
         kwok_groups=args.kwok_groups,
         coordinators=args.coordinators,
+        shards=args.shards,
+        watch_cache=args.watch_cache,
+        watch_cache_index=args.watch_cache_index,
         pod_batch=args.pod_batch,
         chunk=args.chunk,
         backend=args.backend,

@@ -85,6 +85,18 @@ def parse_args(argv=None):
         "ago while new waves arrive — sustained create+delete churn "
         "instead of a fill-up",
     )
+    ap.add_argument(
+        "--stress-watchers", type=int, default=0,
+        help="run the apiserver-stress equivalent (tools/watch_stress) "
+        "as a subprocess against the same --target for the whole "
+        "measured window — config 5's full shape is churn UNDER watch "
+        "stress.  Requires --target.",
+    )
+    ap.add_argument(
+        "--stress-write-concurrency", type=int, default=1,
+        help="stressor's concurrent writers (keep low on a single-core "
+        "host or the stressor starves the scheduler it is stressing)",
+    )
     return ap.parse_args(argv)
 
 
@@ -102,10 +114,68 @@ def write_wave(store, items) -> None:
             store.put(k, v)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _bench_window(args, coord, store):
+    """Measured-window lifecycle: optional watch stressor for the whole
+    window, and guaranteed teardown (stressor, coordinator watches,
+    store channel) even when the window raises mid-run."""
+    stress = (
+        _start_watch_stress(
+            args.target, args.stress_watchers, args.stress_write_concurrency
+        )
+        if args.stress_watchers else None
+    )
+    try:
+        yield
+    finally:
+        if stress is not None:
+            stress.terminate()
+            try:
+                stress.wait(timeout=10)
+            except Exception:
+                stress.kill()
+        coord.close()
+        if hasattr(store, "close"):
+            store.close()
+
+
+def _bound_keys(coord, key_strs, lo, hi):
+    """Keys in [lo, hi) whose pods the coordinator has actually bound —
+    churn must only delete bound pods (bind order diverges from key
+    order whenever pods retry, so a bound-count prefix is not enough)."""
+    bound = coord._bound
+    return [i for i in range(lo, hi) if key_strs[i] in bound]
+
+
+def _start_watch_stress(target: str, watchers: int, write_concurrency: int):
+    """Spawn the apiserver-stress equivalent against ``target`` for the
+    duration of the bench window (terminated by the caller)."""
+    import atexit
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s1m_tpu.tools.watch_stress",
+            "--target", target, "--watchers", str(watchers),
+            "--write-concurrency", str(write_concurrency),
+            "--writes", str(1 << 30), "--quiet",
+        ],
+        stdout=subprocess.DEVNULL,
+    )
+    atexit.register(lambda: proc.poll() is None and proc.kill())
+    return proc
+
+
 def main(argv=None):
     args = parse_args(argv)
     if args.chunk is None:
         args.chunk = (1 << 12) if args.backend == "pallas" else (1 << 14)
+    if args.stress_watchers and not args.target:
+        raise SystemExit("--stress-watchers requires --target (wire store)")
 
     if args.target:
         from k8s1m_tpu.store.remote import RemoteStore
@@ -143,6 +213,7 @@ def main(argv=None):
         for i in range(args.pods)
     ]
     keys = [pod_key("default", f"bench-{i}") for i in range(args.pods)]
+    key_strs = [f"default/bench-{i}" for i in range(args.pods)]
 
     # Warm the compile cache outside the measured window.
     store.put(keys[0], values[0])
@@ -193,35 +264,42 @@ def main(argv=None):
 
         # Paced producer: emit pods on the offered-load schedule, step
         # the coordinator continuously, measure intake-to-bind latency.
-        # --churn deletes pods a fixed lag behind the emission point
-        # (config 5's sustained create+delete shape at a steady rate).
+        # --churn deletes BOUND pods a fixed lag behind the emission
+        # point (config 5's sustained create+delete shape at a rate).
         lag = 3 * coord.pod_spec.batch
         t0 = time.perf_counter()
         bound = 0
         emitted = 1
-        deleted = 1
-        while emitted < args.pods or coord.queue or coord._inflights:
-            due = min(args.pods, 1 + int(args.rate * (time.perf_counter() - t0)))
-            if due > emitted:
-                write_wave(
-                    store, list(zip(keys[emitted:due], values[emitted:due]))
+        frontier_at = 1
+        deleted = 0
+        with _bench_window(args, coord, store):
+            while emitted < args.pods or coord.queue or coord._inflights:
+                due = min(
+                    args.pods, 1 + int(args.rate * (time.perf_counter() - t0))
                 )
-                emitted = due
-                # Frontier capped by bind progress: under overload the
-                # queue outgrows the lag, and deleting still-pending
-                # pods would silently subset the latency metrics.
-                frontier = min(emitted - lag, 1 + bound)
-                if args.churn and frontier > deleted:
+                if due > emitted:
                     write_wave(
-                        store, [(k, None) for k in keys[deleted:frontier]]
+                        store, list(zip(keys[emitted:due], values[emitted:due]))
                     )
-                    deleted = frontier
-            bound += coord.step()
-            if emitted >= args.pods and not coord.queue and not coord._inflights:
-                bound += coord.run_until_idle()
-                break
-        sched_s = time.perf_counter() - t0
-        lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
+                    emitted = due
+                    frontier = emitted - lag
+                    if args.churn and frontier > frontier_at:
+                        dels = _bound_keys(
+                            coord, key_strs, frontier_at, frontier
+                        )
+                        write_wave(store, [(keys[i], None) for i in dels])
+                        deleted += len(dels)
+                        frontier_at = frontier
+                bound += coord.step()
+                if (
+                    emitted >= args.pods
+                    and not coord.queue
+                    and not coord._inflights
+                ):
+                    bound += coord.run_until_idle()
+                    break
+            sched_s = time.perf_counter() - t0
+            lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
         e2e = bound / sched_s if sched_s else 0.0
         if args.stats:
             _print_stage_stats(sched_s)
@@ -236,12 +314,13 @@ def main(argv=None):
                 "binds_per_sec": round(e2e, 1),
                 "bound": bound,
                 "unbound": args.pods - 1 - bound,
-                "deleted": deleted - 1 if args.churn else 0,
+                "deleted": deleted,
+                "stress_watchers": args.stress_watchers,
                 "p50_ms": round(lat.quantile(0.5) * 1e3, 2),
                 "p95_ms": round(lat.quantile(0.95) * 1e3, 2),
                 "p99_ms": round(lat.quantile(0.99) * 1e3, 2),
             },
-        }))
+        }), flush=True)
         return
 
     wave = args.batch
@@ -252,21 +331,27 @@ def main(argv=None):
     bound = 0
     off = 1
     deleted = 0
-    while off < args.pods:
-        write_wave(
-            store, list(zip(keys[off:off + wave], values[off:off + wave]))
-        )
-        if args.churn and off > 2 * wave:
-            # Delete the wave bound two waves ago — the scheduler keeps
-            # binding into capacity that deletions keep freeing.
-            lo = off - 3 * wave
-            dels = keys[max(1, lo):lo + wave]
-            write_wave(store, [(k, None) for k in dels])
-            deleted += len(dels)
-        off += wave
-        bound += coord.step()
-    bound += coord.run_until_idle()
-    sched_s = time.perf_counter() - t0
+    frontier_at = 1
+    with _bench_window(args, coord, store):
+        while off < args.pods:
+            write_wave(
+                store, list(zip(keys[off:off + wave], values[off:off + wave]))
+            )
+            if args.churn:
+                # Delete BOUND pods behind the emission lag — the
+                # scheduler keeps binding into capacity that deletions
+                # keep freeing; pods still pending (retries, a backed-up
+                # run under --stress-watchers) are skipped, not deleted.
+                frontier = off - 2 * wave
+                if frontier > frontier_at:
+                    dels = _bound_keys(coord, key_strs, frontier_at, frontier)
+                    write_wave(store, [(keys[i], None) for i in dels])
+                    deleted += len(dels)
+                    frontier_at = frontier
+            off += wave
+            bound += coord.step()
+        bound += coord.run_until_idle()
+        sched_s = time.perf_counter() - t0
     create_s = sched_s  # creation is inside the measured window
     e2e = bound / sched_s if sched_s else 0.0
 
@@ -291,9 +376,10 @@ def main(argv=None):
             "bootstrap_s": round(bootstrap_s, 2),
             "pod_create_per_sec": round(args.pods / create_s, 1),
             "schedule_s": round(sched_s, 2),
+            "stress_watchers": args.stress_watchers,
             "p50_bind_ms": p50_ms,
         },
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

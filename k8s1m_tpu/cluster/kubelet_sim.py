@@ -31,7 +31,12 @@ import zlib
 
 from k8s1m_tpu.control.objects import lease_key, node_key, pod_key
 from k8s1m_tpu.obs.metrics import Counter
-from k8s1m_tpu.store.native import MemStore, drain_events, prefix_end
+from k8s1m_tpu.store.native import (
+    MemStore,
+    drain_events,
+    list_prefix,
+    prefix_end,
+)
 
 NODES_PREFIX = b"/registry/minions/"
 PODS_PREFIX = b"/registry/pods/"
@@ -81,20 +86,20 @@ class KubeletPool:
         self._node_mod: dict[str, int] = {}
 
     def bootstrap(self, now: float = 0.0) -> None:
-        res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
-        for kv in res.kvs:
+        kvs, rev = list_prefix(self.store, NODES_PREFIX)
+        for kv in kvs:
             name = kv.key[len(NODES_PREFIX):].decode()
             self.adopt(name, kv.value, now, mod_revision=kv.mod_revision)
         self._nodes_watch = self.store.watch(
             NODES_PREFIX, prefix_end(NODES_PREFIX),
-            start_revision=res.revision + 1, queue_cap=1 << 20,
+            start_revision=rev + 1, queue_cap=1 << 20,
         )
-        pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
-        for kv in pods.kvs:
+        pod_kvs, pod_rev = list_prefix(self.store, PODS_PREFIX)
+        for kv in pod_kvs:
             self._observe_pod(kv.value, kv.mod_revision)
         self._pods_watch = self.store.watch(
             PODS_PREFIX, prefix_end(PODS_PREFIX),
-            start_revision=pods.revision + 1, queue_cap=1 << 20,
+            start_revision=pod_rev + 1, queue_cap=1 << 20,
         )
 
     def adopt(

@@ -22,7 +22,12 @@ import zlib
 
 from k8s1m_tpu.control.objects import lease_key, pod_key
 from k8s1m_tpu.obs.metrics import Counter, Histogram
-from k8s1m_tpu.store.native import MemStore, drain_events, prefix_end
+from k8s1m_tpu.store.native import (
+    MemStore,
+    drain_events,
+    list_prefix,
+    prefix_end,
+)
 
 log = logging.getLogger("k8s1m.kwok")
 
@@ -90,8 +95,10 @@ class KwokController:
         return labels.get("kwok-group") == self.group
 
     def bootstrap(self, now: float = 0.0) -> None:
-        res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
-        for kv in res.kvs:
+        # Paginated list+watch (native.list_prefix): an unpaginated 1M-node
+        # list is one ~350MB response — over any wire it must chunk.
+        kvs, rev = list_prefix(self.store, NODES_PREFIX)
+        for kv in kvs:
             obj = json.loads(kv.value)
             if self._owns(obj):
                 self._adopt(obj["metadata"]["name"], now)
@@ -99,14 +106,14 @@ class KwokController:
                 self._foreign.add(obj["metadata"]["name"])
         self._nodes_watch = self.store.watch(
             NODES_PREFIX, prefix_end(NODES_PREFIX),
-            start_revision=res.revision + 1, queue_cap=1 << 20,
+            start_revision=rev + 1, queue_cap=1 << 20,
         )
-        pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
-        for kv in pods.kvs:
+        pod_kvs, pod_rev = list_prefix(self.store, PODS_PREFIX)
+        for kv in pod_kvs:
             self._maybe_start_pod(kv.value, kv.mod_revision, now)
         self._pods_watch = self.store.watch(
             PODS_PREFIX, prefix_end(PODS_PREFIX),
-            start_revision=pods.revision + 1, queue_cap=1 << 20,
+            start_revision=pod_rev + 1, queue_cap=1 << 20,
         )
 
     def _adopt(self, name: str, now: float) -> None:
@@ -245,13 +252,44 @@ class KwokController:
                     len(dropped), now - since, node,
                 )
 
+        # Renew every due lease in ONE wave: leases are the dominant
+        # 1M-node write load (100K/s in the reference), and per-lease
+        # RPCs cap a wire-connected controller at the per-request rate;
+        # the BatchKV frame path carries the same wave at ~50x that
+        # (store/remote.py put_batch).
+        due_items = []
+        due_names = []
+        delays = []
         for name, due in self._next_renewal.items():
             if due <= now:
-                self._renew_lease(name, now)
-                delay = now - due
-                _LEASE_DELAY.observe(delay, group=self.group)
-                self._next_renewal[name] = now + self.renew_interval_s
-                renewed += 1
+                due_items.append(
+                    (lease_key(LEASE_NS, name), self._lease_value(name, now))
+                )
+                due_names.append(name)
+                delays.append(now - due)
+        if due_items:
+            try:
+                put_batch = getattr(self.store, "put_batch", None)
+                if put_batch is not None:
+                    put_batch(due_items)
+                else:
+                    for k, v in due_items:
+                        self.store.put(k, v)
+            except Exception:
+                # Schedules advance only on success: a failed wave keeps
+                # every lease due, so the next tick retries instead of
+                # silently slipping them a whole interval (a slip can
+                # exceed leaseDurationSeconds — a false node death).
+                log.warning(
+                    "lease renewal wave failed; %d lease(s) stay due",
+                    len(due_items), exc_info=True,
+                )
+            else:
+                for name in due_names:
+                    self._next_renewal[name] = now + self.renew_interval_s
+                _LEASE_DELAY.observe_many(delays, group=self.group)
+                _LEASE_RENEWALS.inc(len(due_items), group=self.group)
+                renewed += len(due_items)
         return {
             "renewed": renewed,
             "started": self._started_total - started0,
@@ -272,21 +310,18 @@ class KwokController:
         self._waiting_since.pop(name, None)
         self.store.delete(lease_key(LEASE_NS, name))
 
-    def _renew_lease(self, name: str, now: float) -> None:
-        self.store.put(
-            lease_key(LEASE_NS, name),
-            json.dumps(
-                {
-                    "apiVersion": "coordination.k8s.io/v1",
-                    "kind": "Lease",
-                    "metadata": {"name": name, "namespace": LEASE_NS},
-                    "spec": {
-                        "holderIdentity": name,
-                        "leaseDurationSeconds": self.lease_duration_s,
-                        "renewTime": now,
-                    },
+    def _lease_value(self, name: str, now: float) -> bytes:
+        return json.dumps(
+            {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": name, "namespace": LEASE_NS},
+                "spec": {
+                    "holderIdentity": name,
+                    "leaseDurationSeconds": self.lease_duration_s,
+                    "renewTime": now,
                 },
-                separators=(",", ":"),
-            ).encode(),
-        )
-        _LEASE_RENEWALS.inc(group=self.group)
+            },
+            separators=(",", ":"),
+        ).encode()
+

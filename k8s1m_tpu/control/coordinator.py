@@ -74,6 +74,7 @@ from k8s1m_tpu.store.native import (
     MemStore,
     Watcher,
     drain_events_light,
+    list_prefix,
     prefix_end,
 )
 
@@ -313,19 +314,19 @@ class Coordinator:
         resourceVersion handoff kube informers perform.
         """
         with _CYCLE_TIME.time(stage="bootstrap"):
-            res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
-            for kv in res.kvs:
+            kvs, rev = list_prefix(self.store, NODES_PREFIX)
+            for kv in kvs:
                 self.host.upsert(decode_node(kv.value))
             self._nodes_watch = self.store.watch(
                 NODES_PREFIX, prefix_end(NODES_PREFIX),
-                start_revision=res.revision + 1, queue_cap=self.watch_queue_cap,
+                start_revision=rev + 1, queue_cap=self.watch_queue_cap,
             )
-            pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
-            for kv in pods.kvs:
+            pod_kvs, pod_rev = list_prefix(self.store, PODS_PREFIX)
+            for kv in pod_kvs:
                 self._on_pod_put(kv.value, kv.mod_revision)
             self._pods_watch = self.store.watch(
                 PODS_PREFIX, prefix_end(PODS_PREFIX),
-                start_revision=pods.revision + 1, queue_cap=self.watch_queue_cap,
+                start_revision=pod_rev + 1, queue_cap=self.watch_queue_cap,
             )
             self._bind_excludes = isinstance(self._pods_watch, Watcher)
             self.table = self.host.to_device()
@@ -659,9 +660,9 @@ class Coordinator:
             self._nodes_watch.cancel()
             self._pods_watch.cancel()
 
-            res = self.store.range(NODES_PREFIX, prefix_end(NODES_PREFIX))
+            kvs, rev = list_prefix(self.store, NODES_PREFIX)
             listed = set()
-            for kv in res.kvs:
+            for kv in kvs:
                 node = decode_node(kv.value)
                 listed.add(node.name)
                 self._dirty_rows.add(self.host.upsert(node))
@@ -670,12 +671,12 @@ class Coordinator:
                     self._dirty_rows.add(self.host.remove(name))
             self._nodes_watch = self.store.watch(
                 NODES_PREFIX, prefix_end(NODES_PREFIX),
-                start_revision=res.revision + 1, queue_cap=self.watch_queue_cap,
+                start_revision=rev + 1, queue_cap=self.watch_queue_cap,
             )
 
-            pods = self.store.range(PODS_PREFIX, prefix_end(PODS_PREFIX))
+            pod_kvs, pod_rev = list_prefix(self.store, PODS_PREFIX)
             seen = set()
-            for kv in pods.kvs:
+            for kv in pod_kvs:
                 seen.add(kv.key[len(PODS_PREFIX):].decode())
                 self._on_pod_put(kv.value, kv.mod_revision)
             for key in list(self._bound):
@@ -687,7 +688,7 @@ class Coordinator:
             }
             self._pods_watch = self.store.watch(
                 PODS_PREFIX, prefix_end(PODS_PREFIX),
-                start_revision=pods.revision + 1, queue_cap=self.watch_queue_cap,
+                start_revision=pod_rev + 1, queue_cap=self.watch_queue_cap,
             )
         return len(listed) + len(seen)
 

@@ -418,6 +418,59 @@ def parse_pod_events(
     return PodEventBatch.parse(_take_buf(lib, out, out_len))
 
 
+def list_prefix(
+    store, prefix: bytes, *, page: int = 5000, keys_only: bool = False
+):
+    """Consistent paginated list of a prefix: (kvs, revision).
+
+    The first page pins the snapshot revision and every later page reads
+    at it (etcd's paginated-list contract; kube reflectors depend on it
+    for the list+watch handoff).  Unpaginated lists break the WIRE
+    topology outright: a gRPC response carrying 1M nodes is ~350MB,
+    far over any sane message cap — the reference's controllers never
+    list unpaginated either (client-go chunks at 500).
+    Restarts the scan from the current revision if the pinned revision
+    is compacted mid-scan (the reflector-on-410-Gone rule), up to 3
+    attempts.
+    """
+    for _ in range(3):
+        start, end = prefix, prefix_end(prefix)
+        out: list = []
+        rev = 0
+        try:
+            while True:
+                res = store.range(
+                    start, end, limit=page, keys_only=keys_only, revision=rev
+                )
+                if rev == 0:
+                    rev = res.revision
+                out.extend(res.kvs)
+                if not res.more or not res.kvs:
+                    return out, rev
+                start = res.kvs[-1].key + b"\x00"
+        except CompactedError:
+            continue
+    raise CompactedError()
+
+
+def scan_prefix(
+    store, prefix: bytes, *, page: int = 5000, keys_only: bool = False
+):
+    """Streaming paginated scan, deliberately UNPINNED: each page reads
+    the latest revision, so a long scan over a live cluster observes a
+    moving snapshot but can never hit CompactedError mid-stream (a
+    generator cannot restart after yielding).  Verification tools want
+    crash-free approximate scans; the list+watch handoff wants
+    list_prefix's pinned snapshot."""
+    start, end = prefix, prefix_end(prefix)
+    while True:
+        res = store.range(start, end, limit=page, keys_only=keys_only)
+        yield from res.kvs
+        if not res.more or not res.kvs:
+            return
+        start = res.kvs[-1].key + b"\x00"
+
+
 def drain_events(watcher, batch: int = 10000, limit: int = 200_000):
     """Yield queued events from a watcher (native or remote) until its
     queue momentarily empties OR ``limit`` events have been yielded.

@@ -208,7 +208,17 @@ class RemoteStore:
     """Blocking etcd v3 client exposing the MemStore surface."""
 
     def __init__(self, target: str, channel: grpc.Channel | None = None):
-        self.channel = channel or grpc.insecure_channel(target)
+        self.channel = channel or grpc.insecure_channel(
+            target,
+            options=[
+                # Match the servers' 64MB caps (etcd_server/watch_cache);
+                # the default 4MB rejects a ~12K-object list response.
+                # Large lists should still paginate (native.list_prefix)
+                # — this is headroom, not an invitation.
+                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                ("grpc.max_send_message_length", 64 * 1024 * 1024),
+            ],
+        )
         c = self.channel
         pb = rpc_pb2
 

@@ -19,27 +19,16 @@ import json
 import re
 import sys
 
-from k8s1m_tpu.store.native import prefix_end
+from k8s1m_tpu.store.native import scan_prefix
 
 NODES_PREFIX = b"/registry/minions/"
 PODS_PREFIX = b"/registry/pods/"
 
 
-def _scan(store, prefix: bytes, *, keys_only: bool = False, limit: int = 5000):
-    """Yield KVs under a prefix in paginated ranges."""
-    start, end = prefix, prefix_end(prefix)
-    while True:
-        res = store.range(start, end, limit=limit, keys_only=keys_only)
-        yield from res.kvs
-        if not res.more or not res.kvs:
-            return
-        start = res.kvs[-1].key + b"\x00"
-
-
 def count_ready(store) -> dict:
     """{'nodes': {status: count}, 'pods': {phase: count}}."""
     nodes: collections.Counter = collections.Counter()
-    for kv in _scan(store, NODES_PREFIX):
+    for kv in scan_prefix(store, NODES_PREFIX):
         try:
             obj = json.loads(kv.value)
             ready = "Unknown"
@@ -50,7 +39,7 @@ def count_ready(store) -> dict:
         except Exception:
             nodes["undecodable"] += 1
     pods: collections.Counter = collections.Counter()
-    for kv in _scan(store, PODS_PREFIX):
+    for kv in scan_prefix(store, PODS_PREFIX):
         try:
             obj = json.loads(kv.value)
             phase = obj.get("status", {}).get("phase", "Pending")
@@ -67,7 +56,7 @@ def find_gaps(store, prefix: bytes = NODES_PREFIX, pattern: str = r"-(\d+)$"):
     inclusive gap ranges."""
     rx = re.compile(pattern.encode())
     seen = []
-    for kv in _scan(store, prefix, keys_only=True):
+    for kv in scan_prefix(store, prefix, keys_only=True):
         m = rx.search(kv.key)
         if m:
             seen.append(int(m.group(1)))

@@ -150,18 +150,27 @@ def test_shard_set_cluster_schedules_and_stays_disjoint():
             checked += 1
         assert checked == 60
 
-        # A forced rebalance over the wire, on the cluster's simulated
-        # clock: masks stay disjoint and full once deferred claims land.
+        # A forced rebalance over the wire: masks must stay DISJOINT at
+        # every tick (the safety property of drop-before-claim), and
+        # become full again once deferred claims land.  The assignment
+        # travels a real gRPC watch, so wall time — not just simulated
+        # ticks — bounds delivery; tick until full with a small real
+        # sleep between attempts.
+        import time as _time
+
         c._rebalancer.run_once(c.now, force=True)
-        for t in (c.now + 1.0, c.now + 2.0):
+        for attempt in range(100):
             for m in c.shard_members:
-                m.tick(t)
-        union = np.zeros_like(masks[0])
-        fresh = [m.coordinator._row_mask_np for m in c.shard_members]
-        for i, a in enumerate(fresh):
-            for b in fresh[i + 1:]:
-                assert not (a & b).any()
-            union |= a
+                m.tick(c.now + 1.0 + attempt)
+            union = np.zeros_like(masks[0])
+            fresh = [m.coordinator._row_mask_np for m in c.shard_members]
+            for i, a in enumerate(fresh):
+                for b in fresh[i + 1:]:
+                    assert not (a & b).any()
+                union |= a
+            if union.sum() == 48:
+                break
+            _time.sleep(0.02)
         assert union.sum() == 48
 
 

@@ -197,3 +197,81 @@ def test_encode_packed_plain_matches_encode_packed():
     np.testing.assert_array_equal(a.bools, b.bools)
     for name in a.fields:
         np.testing.assert_array_equal(a.fields[name], b.fields[name], name)
+
+
+def test_decode_node_fast_parity_and_fallback():
+    """decode_node's byte-scan fast path must agree with the JSON path on
+    every canonical shape (labels, status churn after allocatable) and
+    reject to JSON for taints/unschedulable/escapes."""
+    from k8s1m_tpu.config import EFFECT_NO_SCHEDULE
+    from k8s1m_tpu.control.objects import (
+        decode_node,
+        decode_node_fast,
+        encode_node,
+    )
+    from k8s1m_tpu.snapshot.node_table import NodeInfo, Taint
+    import json as _json
+
+    cases = [
+        NodeInfo(name="n0", cpu_milli=4000, mem_kib=8 << 20, pods=110),
+        NodeInfo(name="n1", labels={"a": "b", "zone": "z1"},
+                 cpu_milli=1, mem_kib=1, pods=1),
+        NodeInfo(name="n2", labels={}, cpu_milli=999999,
+                 mem_kib=123456789, pods=250),
+    ]
+    for info in cases:
+        data = encode_node(info)
+        fast = decode_node_fast(data)
+        assert fast is not None
+        full = decode_node(data)
+        assert (fast.name, fast.labels, fast.cpu_milli, fast.mem_kib,
+                fast.pods) == (info.name, dict(info.labels),
+                               info.cpu_milli, info.mem_kib, info.pods)
+        assert fast == full
+
+    # Status churn past allocatable (heartbeat writers) stays fast.
+    obj = _json.loads(encode_node(cases[1]))
+    obj["status"]["conditions"].append(
+        {"type": "MemoryPressure", "status": "False",
+         "lastHeartbeatTime": 12345.0}
+    )
+    data = _json.dumps(obj, separators=(",", ":")).encode()
+    fast = decode_node_fast(data)
+    assert fast is not None and fast.labels == {"a": "b", "zone": "z1"}
+
+    # Non-canonical shapes fall back (and JSON handles them).
+    tainted = NodeInfo(name="t", taints=[Taint("k", "v", EFFECT_NO_SCHEDULE)])
+    assert decode_node_fast(encode_node(tainted)) is None
+    assert decode_node(encode_node(tainted)).taints
+    unsched = NodeInfo(name="u", unschedulable=True)
+    assert decode_node_fast(encode_node(unsched)) is None
+    assert decode_node(encode_node(unsched)).unschedulable
+    esc = NodeInfo(name='e"sc', labels={"k": "v"})
+    assert decode_node_fast(encode_node(esc)) is None
+    assert decode_node(encode_node(esc)).name == 'e"sc'
+
+
+def test_decode_node_fast_rejects_nested_allocatable():
+    """A nested 'allocatable' earlier in status must never be parsed as
+    the real one — the fast path anchors allocatable at the status
+    opening or falls back to JSON."""
+    import json as _json
+
+    from k8s1m_tpu.control.objects import (
+        decode_node,
+        decode_node_fast,
+        encode_node,
+    )
+    from k8s1m_tpu.snapshot.node_table import NodeInfo
+
+    obj = _json.loads(
+        encode_node(NodeInfo(name="n", cpu_milli=2000, mem_kib=2, pods=10))
+    )
+    obj["status"] = {
+        "x": {"allocatable": {"cpu": "1m", "memory": "1Ki", "pods": "5"}},
+        "allocatable": obj["status"]["allocatable"],
+    }
+    data = _json.dumps(obj, separators=(",", ":")).encode()
+    assert decode_node_fast(data) is None
+    full = decode_node(data)
+    assert full.cpu_milli == 2000 and full.pods == 10

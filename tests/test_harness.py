@@ -222,3 +222,53 @@ def test_shard_set_behind_watch_cache_tier():
             m.coordinator._bound for m in c.shard_members
         ]
         assert all(len(b) > 0 for b in bound_by)
+
+
+def test_store_crash_recovery_behind_tier(tmp_path):
+    """Store crash with the apiserver tier deployed: the tier's upstream
+    watch breaks, it relists + invalidates (cancelling client watches so
+    THEY relist — the reflector cascade), and the cluster keeps
+    scheduling through the proxied wire."""
+    spec = ClusterSpec(
+        nodes=32, kwok_groups=1, coordinators=1, pod_batch=16, chunk=64,
+        wal_mode="buffered", no_write_prefixes=(), watch_cache=True,
+    )
+    with Cluster(spec, wal_dir=str(tmp_path)) as c:
+        c.make_nodes()
+        c.tick()
+        stats = c.run_pods(10, max_ticks=30)
+        assert stats["bound"] == 10
+
+        c.restart_store()
+        store = c._clients[0]
+        res = store.range(
+            b"/registry/minions/", prefix_end(b"/registry/minions/")
+        )
+        assert res.count == 32
+
+        # KWOK sits behind the tier; its watches cascade-reset via the
+        # tier's invalidate, the coordinators resync directly — both
+        # must converge and keep binding.  The tier reconnects on a real
+        # 0.2s backoff, so convergence is wall-clock-bounded: keep
+        # ticking with real sleeps until the KWOK side (behind the tier)
+        # has started every bound pod.
+        import time as _time
+
+        stats = c.run_pods(10, max_ticks=80)
+        assert stats["bound"] == 10
+        running = stats["running"]
+        for _ in range(200):
+            if running >= 10:
+                break
+            _time.sleep(0.05)
+            c.tick()
+            running = sum(
+                1 for kv in store.range(
+                    b"/registry/pods/", prefix_end(b"/registry/pods/")
+                ).kvs
+                if json.loads(kv.value)["metadata"]["name"].startswith(
+                    stats["prefix"]
+                )
+                and json.loads(kv.value)["status"]["phase"] == "Running"
+            )
+        assert running == 10

@@ -1,0 +1,93 @@
+"""Component-cost probe for the fused pallas kernel.
+
+Times fused_topk over a pct-window-sized table with individual score
+plugins disabled — weights are static arguments, so a zeroed plugin is
+dead-code-eliminated from the trace and its cost shows up as the delta
+against the full profile.  The tool for answering "where do the
+ms/batch go" on the real chip (the XLA scan path can be profiled the
+same way through bench.py --backend xla).
+
+    python -m k8s1m_tpu.tools.kernel_probe --nodes 53248 --batch 8192
+
+Prints one JSON line per variant.  Run variants serially on the one
+real chip; each recompiles (~15-30s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.cluster import populate_kwok_nodes, uniform_pods
+from k8s1m_tpu.ops.pallas_topk import fused_topk
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot import NodeTableHost, PodBatchHost
+
+
+def variants() -> dict[str, Profile]:
+    base = dict(node_affinity=0, topology_spread=0, interpod_affinity=0)
+    return {
+        "full": Profile(**base),
+        "no-least-allocated": Profile(least_allocated=0, **base),
+        "no-balanced-allocation": Profile(balanced_allocation=0, **base),
+        "no-taint-toleration": Profile(taint_toleration=0, **base),
+        "filter-only": Profile(
+            least_allocated=0, balanced_allocation=0, taint_toleration=0,
+            **base,
+        ),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="pallas kernel component probe")
+    ap.add_argument("--nodes", type=int, default=13 * 4096,
+                    help="table rows (default: the 1M-table pct5 window)")
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--chunk", type=int, default=1 << 12)
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated variant names (default: all)")
+    args = ap.parse_args(argv)
+
+    spec = TableSpec(max_nodes=args.nodes)
+    host = NodeTableHost(spec)
+    populate_kwok_nodes(host, args.nodes)
+    table = host.to_device()
+    enc = PodBatchHost(PodSpec(batch=args.batch), spec, host.vocab)
+    batch = enc.encode(uniform_pods(args.batch))
+
+    picked = variants()
+    if args.only:
+        names = {n.strip() for n in args.only.split(",")}
+        picked = {n: p for n, p in picked.items() if n in names}
+    for name, prof in picked.items():
+        idx, _ = fused_topk(
+            table, batch, jnp.int32(0), prof,
+            chunk=args.chunk, k=args.k, with_affinity=False,
+        )
+        jax.device_get(idx)      # compile + settle
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            idx, _ = fused_topk(
+                table, batch, jnp.int32(i + 1), prof,
+                chunk=args.chunk, k=args.k, with_affinity=False,
+            )
+        jax.device_get(idx)      # the relay needs a fetch, not block_until_ready
+        dt = (time.perf_counter() - t0) / args.steps
+        print(json.dumps({
+            "variant": name,
+            "ms_per_batch": round(dt * 1e3, 2),
+            "binds_per_sec_equiv": round(args.batch / dt, 1),
+            "nodes": args.nodes,
+            "batch": args.batch,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

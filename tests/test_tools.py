@@ -223,3 +223,15 @@ def test_docs_build_renders_site(tmp_path):
     written = build(repo, tmp_path / "site", ["README.md", "MISSING.md"])
     assert set(written) == {"readme.html", "index.html"}
     assert "docs body" in (tmp_path / "site" / "readme.html").read_text()
+
+
+def test_kernel_probe_runs(capsys):
+    import json as _json
+
+    from k8s1m_tpu.tools.kernel_probe import main
+
+    main(["--nodes", "256", "--batch", "32", "--chunk", "128",
+          "--steps", "1", "--only", "filter-only"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = _json.loads(line)
+    assert out["variant"] == "filter-only" and out["ms_per_batch"] > 0

@@ -480,3 +480,54 @@ def test_scaled_oracle_chunk_and_tile_boundaries(rng):
     ref_i, ref_p = np_reference_topk(table, batch, 99991, BASE, k=4)
     np.testing.assert_array_equal(np.asarray(prio), ref_p)
     np.testing.assert_array_equal(np.asarray(idx), ref_i)
+
+
+def test_scaled_affinity_oracle_boundaries(rng):
+    """Affinity-kernel oracle parity across chunk and pod-tile
+    boundaries: 1024 labeled nodes / 4 chunks / 512-pod batch of every
+    selector shape, on a workload-fitted PodSpec (the production sizing
+    rule) — pins the per-tile row offsets and cross-chunk top-k carry
+    for the with_aff kernel the way the base-profile scaled test does."""
+    from k8s1m_tpu.config import (
+        SEL_OP_EXISTS,
+        SEL_OP_GT,
+        SEL_OP_IN,
+        SEL_OP_LT,
+        SEL_OP_NOT_IN,
+    )
+
+    spec, host = build_labeled(rng, num_nodes=1024)
+    pspec = PodSpec(
+        batch=512, aff_terms=2, aff_exprs=2, aff_values=2, pref_terms=2,
+    )
+    enc = PodBatchHost(pspec, spec, host.vocab)
+    shapes = [
+        lambda i: PodInfo(f"sel-{i}", node_selector={"tier": "db"}),
+        lambda i: PodInfo(f"in-{i}", required_terms=[NodeSelectorTerm([
+            SelectorRequirement("tier", SEL_OP_IN, ["web", "cache"])])]),
+        lambda i: PodInfo(f"and-{i}", required_terms=[NodeSelectorTerm([
+            SelectorRequirement("disk", SEL_OP_NOT_IN, ["hdd"]),
+            SelectorRequirement("gpu", SEL_OP_EXISTS)])]),
+        lambda i: PodInfo(f"or-{i}", required_terms=[
+            NodeSelectorTerm([SelectorRequirement("tier", SEL_OP_IN, ["db"])]),
+            NodeSelectorTerm([SelectorRequirement("gpu", SEL_OP_EXISTS)])]),
+        lambda i: PodInfo(f"gt-{i}", required_terms=[NodeSelectorTerm([
+            SelectorRequirement("gen", SEL_OP_GT, [str(100_000_000 + i * 7919)]),
+            SelectorRequirement("gen", SEL_OP_LT, [str(103_000_000 + i)])])]),
+        lambda i: PodInfo(f"pref-{i}", preferred_terms=[
+            PreferredSchedulingTerm(3, NodeSelectorTerm([
+                SelectorRequirement("tier", SEL_OP_IN, ["db"])])),
+            PreferredSchedulingTerm(1, NodeSelectorTerm([
+                SelectorRequirement("disk", SEL_OP_IN, ["ssd"])]))]),
+        lambda i: PodInfo(f"plain-{i}"),
+    ]
+    infos = [shapes[i % len(shapes)](i) for i in range(500)]
+    batch = enc.encode(infos)
+    table = host.to_device()
+    prof = Profile(topology_spread=0, interpod_affinity=0)
+    idx, prio = fused_topk(
+        table, batch, jnp.int32(4242), prof, chunk=256, k=4,
+    )
+    ref_i, ref_p = np_reference_topk(table, batch, 4242, prof, k=4)
+    np.testing.assert_array_equal(np.asarray(prio), ref_p)
+    np.testing.assert_array_equal(np.asarray(idx), ref_i)

@@ -4,7 +4,7 @@
 # into BENCH_RECOVERY.md so even a post-session recovery is captured.
 cd /root/repo
 out=BENCH_RECOVERY.md
-for attempt in 1 2 3; do
+for attempt in $(seq 1 "${ATTEMPTS:-3}"); do
   if timeout 3000 python -u -c "import jax; print(jax.devices()[0])" \
       > /tmp/tpu_probe.out 2>&1; then
     {

@@ -170,8 +170,13 @@ class Cluster:
                 "--index", spec.watch_cache_index,
             ])
             # Port bind happens after cache priming (watch_cache.py), so
-            # this doubles as the primed signal.
-            wait_for_port(self.tier_port, proc=self._tier)
+            # this doubles as the primed signal.  Priming walks the whole
+            # store, so the wait must scale with it (1M nodes would blow
+            # the default 30s).
+            prime_timeout = 30.0 + spec.nodes / 5000.0
+            wait_for_port(
+                self.tier_port, timeout_s=prime_timeout, proc=self._tier
+            )
 
         self.shard_members: list = []
         self._rebalancer = None

@@ -446,6 +446,19 @@ class Coordinator:
                 self._nodes_watch.dropped, self._pods_watch.dropped,
             )
             return self.resync()
+        # A server-side cancel (compaction past our revision, shutdown,
+        # tier restart) ends the stream without setting dropped; without a
+        # resync the drains below would poll empty batches forever and
+        # intake would silently stall.
+        if getattr(self._nodes_watch, "canceled", False) or getattr(
+            self._pods_watch, "canceled", False
+        ):
+            log.warning(
+                "watch canceled server-side (nodes=%s pods=%s); resyncing",
+                getattr(self._nodes_watch, "canceled", False),
+                getattr(self._pods_watch, "canceled", False),
+            )
+            return self.resync()
         n = self._drain_node_events(max_events)
         n += self._drain_pod_events(max_events)
         return n

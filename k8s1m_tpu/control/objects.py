@@ -19,6 +19,7 @@ host-side half of the feature compiler (SURVEY.md §7 step 1).
 from __future__ import annotations
 
 import json
+import re
 
 from k8s1m_tpu.config import (
     K8S_DEFAULT_SCHEDULER,
@@ -223,6 +224,159 @@ def _scan_labels(data: bytes, i: int):
         return None
 
 
+_WS = b" \t\n\r"
+# Keys whose re-appearance would shadow state the fast path already
+# consumed (json.loads is last-wins; the byte scanner is first-wins).
+_DUP_STATUS_KEYS = frozenset((b"allocatable",))
+_DUP_TOP_KEYS = frozenset((b"metadata", b"spec", b"status"))
+
+
+# Any raw control byte anywhere in the value demotes to the JSON path:
+# valid compact JSON (what every canonical writer emits) contains none,
+# and inside strings json.loads rejects them — one C-level scan closes
+# that divergence for the whole value, parsed span and tail alike.
+_CTRL_RE = re.compile(rb"[\x00-\x1f]")
+
+# RFC 8259 number grammar (json.loads rejects 01, 1., .5, bare -).
+_NUM_PAT = rb"-?(?:0|[1-9][0-9]*)(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?"
+_NUM_RE = re.compile(_NUM_PAT)
+
+# Fast-accept for the hot tail shape — a flat conditions array of
+# string/number/bool/null fields (the framework's own encoder plus
+# kubelet-style heartbeat churn), matched in one C-level regex pass so
+# the Python FSM below only ever runs on exotic tails.  Strings here are
+# printable-ASCII-only (no quote/backslash/ctrl); anything else (UTF-8
+# text, nesting, ws) falls to the FSM.  The shape admits no status-level
+# key but "conditions" and no top-level key at all, so duplicate
+# landmarks cannot hide in a fast-accepted tail.
+_STR_PAT = rb'"[ !#-\[\]-~]*"'
+_CONDV_PAT = rb"(?:" + _STR_PAT + rb"|" + _NUM_PAT + rb"|true|false|null)"
+_CONDKV_PAT = _STR_PAT + rb":" + _CONDV_PAT
+_CONDOBJ_PAT = rb"\{(?:" + _CONDKV_PAT + rb"(?:," + _CONDKV_PAT + rb")*)?\}"
+_TAIL_CANON_RE = re.compile(
+    rb'\}(?:,"conditions":\[(?:'
+    + _CONDOBJ_PAT
+    + rb"(?:,"
+    + _CONDOBJ_PAT
+    + rb")*)?\])?\}\}\Z"
+)
+
+
+def _node_tail_ok(data: bytes, i: int) -> bool:
+    """Validate the unparsed tail of a canonical node value, starting
+    just past allocatable's closing brace (inside the status object,
+    expecting ',' or '}').
+
+    Two jobs, both required for the fast path's contract ("parse
+    identically to json.loads or not at all"):
+      1. reject duplicate landmark KEYS json.loads would last-win — a
+         second "allocatable" at the status level, a second metadata/
+         spec/status at the top level;
+      2. reject malformed tails json.loads would raise on (garbage
+         literals, mismatched brackets, bad commas), so corrupted bytes
+         never parse fast while raising for every pure-JSON consumer.
+    A strict streaming validator over the (short) conditions tail.
+    Tokenizing is simple because the caller already rejected values
+    containing backslashes or control bytes: a quote always terminates a
+    string.  This is the SLOW fallback — the caller fast-accepts the
+    canonical conditions shape with _TAIL_CANON_RE first, so this runs
+    only on exotic tails.
+    """
+    try:
+        # json.loads(bytes) decodes UTF-8 first; tail strings are never
+        # decoded by the fast path, so validate here or diverge on
+        # invalid UTF-8.
+        data[i:].decode()
+    except UnicodeDecodeError:
+        return False
+    n = len(data)
+    # Container stack: True = object, False = array.  We start inside
+    # status, whose parent is the root object; a key is status-level
+    # when len(stack) == 2 and top-level when len(stack) == 1.
+    stack = [True, True]
+    COMMA_OR_CLOSE, KEY, COLON, VALUE, FIRST_KEY, FIRST_VALUE = range(6)
+    state = COMMA_OR_CLOSE
+    while True:
+        while i < n and data[i] in _WS:
+            i += 1
+        if not stack:
+            return i == n          # root closed; only ws may trail
+        if i >= n:
+            return False           # truncated
+        c = data[i]
+        if state == COMMA_OR_CLOSE:
+            if c == 0x2C:          # ','
+                i += 1
+                state = KEY if stack[-1] else VALUE
+            elif c == (0x7D if stack[-1] else 0x5D):   # '}' / ']'
+                stack.pop()
+                i += 1
+            else:
+                return False
+        elif state == KEY:
+            if c != 0x22:          # '"'
+                return False
+            q = data.find(b'"', i + 1)
+            if q < 0:
+                return False
+            key = data[i + 1 : q]
+            if len(stack) == 2 and key in _DUP_STATUS_KEYS:
+                return False
+            if len(stack) == 1 and key in _DUP_TOP_KEYS:
+                return False
+            i = q + 1
+            state = COLON
+        elif state == COLON:
+            if c != 0x3A:          # ':'
+                return False
+            i += 1
+            state = VALUE
+        elif state == VALUE:
+            if c == 0x22:          # string
+                q = data.find(b'"', i + 1)
+                if q < 0:
+                    return False
+                i = q + 1
+                state = COMMA_OR_CLOSE
+            elif c == 0x7B:        # '{'
+                stack.append(True)
+                i += 1
+                state = FIRST_KEY
+            elif c == 0x5B:        # '['
+                stack.append(False)
+                i += 1
+                state = FIRST_VALUE
+            elif data.startswith(b"true", i):
+                i += 4
+                state = COMMA_OR_CLOSE
+            elif data.startswith(b"false", i):
+                i += 5
+                state = COMMA_OR_CLOSE
+            elif data.startswith(b"null", i):
+                i += 4
+                state = COMMA_OR_CLOSE
+            else:
+                m = _NUM_RE.match(data, i)
+                if m is None:
+                    return False
+                i = m.end()
+                state = COMMA_OR_CLOSE
+        elif state == FIRST_KEY:
+            if c == 0x7D:          # '}': empty object
+                stack.pop()
+                i += 1
+                state = COMMA_OR_CLOSE
+            else:
+                state = KEY        # no advance; re-dispatch this char
+        else:                      # FIRST_VALUE
+            if c == 0x5D:          # ']': empty array
+                stack.pop()
+                i += 1
+                state = COMMA_OR_CLOSE
+            else:
+                state = VALUE      # no advance; re-dispatch this char
+
+
 def decode_node_fast(data: bytes) -> NodeInfo | None:
     """Parse the canonical node shape with byte scans; None = use JSON.
 
@@ -230,7 +384,7 @@ def decode_node_fast(data: bytes) -> NodeInfo | None:
     a heartbeat-churning watch stream) otherwise spends ~26µs/node in
     json.loads for objects this framework's own encoders wrote.
     """
-    if not data.startswith(_FN_HEAD) or b"\\" in data:
+    if not data.startswith(_FN_HEAD) or b"\\" in data or _CTRL_RE.search(data):
         return None
     i = len(_FN_HEAD)
     j = data.find(b'"', i)
@@ -257,6 +411,21 @@ def decode_node_fast(data: bytes) -> NodeInfo | None:
     j = data.find(b'"', i)
     pods_b = data[i:j]
     if not cpu_b.endswith(b"m") or not mem_b.endswith(b"Ki"):
+        return None
+    # allocatable must CLOSE right after pods (a further key in it —
+    # e.g. a duplicate "cpu" — would last-win under json.loads while the
+    # scan above already consumed the first).
+    if data[j + 1 : j + 2] != b"}":
+        return None
+    # The rest of the tail (conditions, heartbeat noise) is unparsed —
+    # but json.loads is last-wins for duplicate keys, so a later
+    # duplicate of any landmark we already consumed would make the two
+    # paths disagree, and a malformed tail would parse fast while
+    # raising for every pure-JSON consumer.  One C-level regex accepts
+    # the hot heartbeat shape; anything else takes the strict FSM walk.
+    if _TAIL_CANON_RE.match(data, j + 1) is None and not _node_tail_ok(
+        data, j + 2
+    ):
         return None
     try:
         return NodeInfo(

@@ -130,6 +130,15 @@ def _hash_jitter(seed, row_ids, col_ids):
     tie-break parity tests pin.  The earlier form ran the whole 5-step
     finalizer at [TB, C] width — ~10 extra full-width ops in the hottest
     loop of the framework for no additional tie-break quality.
+
+    Known trade-off of separability: two pods' orderings over an equal-
+    score candidate set are XOR-translates of each other, i.e. tied
+    waves get correlated (not independent) tie-breaks.  Assignment runs
+    greedily with capacity re-checks, so correlated picks cost at most
+    extra conflict retries, never correctness.  If measured bind-conflict
+    rates on tied waves ever rise above the full-width baseline, the fix
+    is ONE extra full-width mixing step over (rh ^ ch) — e.g.
+    h ^= h >> 16; h *= 0x7FEB352D — not a revert to the 5-step form.
     """
     rh = _mix32(
         seed.astype(jnp.uint32)

@@ -376,6 +376,9 @@ class UpstreamHandle:
         self.progress_count = 0      # progress responses received
         self.requests_sent = 0       # progress requests issued
         self._waiters: list[tuple[int, asyncio.Event]] = []
+        # Serializes request issuance so concurrent confirms coalesce
+        # onto one upstream round trip (see confirm()).
+        self._confirm_gate = asyncio.Lock()
 
     def covers(self, key: bytes, end: bytes) -> bool:
         """True if this stream's prefix contains [key, end) (single key
@@ -421,22 +424,45 @@ class UpstreamHandle:
         call.  Counting (not bare "a response arrived") is what stops a
         response to an EARLIER caller's request — whose barrier may
         predate our caller's write — from satisfying us.
+
+        Concurrent confirms COALESCE (Kubernetes batches its
+        requestWatchProgress calls the same way): callers queue on a
+        gate; whoever holds it issues one request, and every caller that
+        arrived before that issuance shares its response.  Any counter
+        bump observed after a caller's arrival snapshot happened after
+        its arrival, so the shared request's store-side read — which is
+        later still — post-dates every write that caller must observe.
         """
         s = self.session
         if s is None:
             return False
-        target = self.requests_sent + 1
-        self.requests_sent = target
-        try:
-            await s.request_progress()
-        except Exception:
-            # The request never reached the store; leaving the counter
-            # bumped would make every later confirm wait for a response
-            # that can't come (until the next reprime realigns).
-            # Decrement (not restore-to-target-1): a concurrent confirm
-            # may have advanced the counter past ours meanwhile.
-            self.requests_sent -= 1
-            return False
+        arrival = self.requests_sent
+        async with self._confirm_gate:
+            s = self.session
+            if s is None:
+                return False
+            if self.requests_sent > arrival:
+                # A request was issued after we arrived; piggyback on it.
+                target = self.requests_sent
+            else:
+                target = self.requests_sent + 1
+                self.requests_sent = target
+                try:
+                    await s.request_progress()
+                except Exception:
+                    # The request never reached the store; leaving the
+                    # counter bumped would make every later confirm wait
+                    # for a response that can't come (until the next
+                    # reprime realigns).  BUT if the stream was replaced
+                    # while we were sending, reset_after_reprime already
+                    # realigned progress_count to the bumped counter —
+                    # decrementing now would leave progress_count >
+                    # requests_sent and let the NEXT confirm pass with no
+                    # barrier from the new stream.  Only roll back when
+                    # the failure wasn't a replacement.
+                    if self.session is s:
+                        self.requests_sent -= 1
+                    return False
         if self.progress_count >= target:
             return True
         e = asyncio.Event()
@@ -465,8 +491,9 @@ class WatchCacheService:
         """Confirm freshness for the ONE stream whose prefix covers the
         requested range (an unrelated prefix's reconnect must not force
         every read to the store); False -> serve from upstream.
-        Kubernetes additionally coalesces concurrent confirms per
-        resource; at this tier's read rates a per-read request is fine.
+        Concurrent confirms coalesce onto a shared progress round trip
+        inside UpstreamHandle.confirm (as Kubernetes batches its
+        requestWatchProgress calls).
         """
         for h in self.handles:
             if h.covers(key, end):
@@ -485,11 +512,22 @@ class WatchCacheService:
 
     async def Range(self, req: rpc_pb2.RangeRequest, ctx) -> rpc_pb2.RangeResponse:
         if req.revision > 0:
-            # A latest-only cache cannot serve an exact MVCC snapshot
-            # (the apiserver's "resourceVersion >= X" semantics don't map
-            # to etcd's exact-revision reads), so any pinned-revision
-            # Range goes to the store.  revision=0 — the hot list path —
-            # is what the cache exists to absorb.
+            # A latest-only cache cannot serve an arbitrary MVCC snapshot
+            # — EXCEPT when the pinned revision is exactly the cache's
+            # current revision, the common case for pages 2+ of a
+            # paginated list that pinned page 1's header revision on a
+            # quiet prefix.  After a successful progress confirm, every
+            # write committed before this read is in the cache; if
+            # last_revision still equals the pin, none of those writes
+            # exceeded it, so latest-state IS the state at that revision.
+            # Churn (last_revision moved past the pin) falls through to
+            # the store, which owns true time travel.
+            if (
+                req.revision == self.cache.last_revision
+                and await self._confirm_progress(req.key, req.range_end)
+                and req.revision == self.cache.last_revision
+            ):
+                return self._range_from_cache(req, req.revision)
             return await self.upstream._range(req)
         # Consistent read from cache: rev=0 on the etcd wire is
         # linearizable, so a client that just wrote through the tier must
@@ -506,9 +544,14 @@ class WatchCacheService:
         # reconnecting or too far behind.
         if not await self._confirm_progress(req.key, req.range_end):
             return await self.upstream._range(req)
+        return self._range_from_cache(req, self.cache.last_revision)
+
+    def _range_from_cache(
+        self, req: rpc_pb2.RangeRequest, header_rev: int
+    ) -> rpc_pb2.RangeResponse:
         kvs, more, count = self.cache.range(req.key, req.range_end, req.limit)
         return rpc_pb2.RangeResponse(
-            header=self._header(),
+            header=self._header_at(header_rev),
             kvs=[
                 mvcc_pb2.KeyValue(
                     key=k,
@@ -751,6 +794,7 @@ class WatchCacheTier:
     cache: WatchCache
     tasks: list
     upstream: EtcdClient
+    svc: "WatchCacheService | None" = None
 
     async def close(self) -> None:
         for t in self.tasks:
@@ -867,7 +911,7 @@ async def serve_watch_cache(
                 pass
         await upstream.close()
         raise
-    return WatchCacheTier(server, bound, cache, tasks, upstream)
+    return WatchCacheTier(server, bound, cache, tasks, upstream, svc)
 
 
 def main(argv=None) -> None:

@@ -251,6 +251,25 @@ def test_watch_overflow_triggers_resync(store):
     assert node_of(store, "default", "after") == "n1"
 
 
+def test_watch_cancel_triggers_resync(store):
+    """A server-side watch cancel (compaction past our revision, tier
+    restart) ends the stream without dropped events; the coordinator must
+    resync rather than poll dead watchers forever (intake would silently
+    stall — the canceled stream never delivers another event)."""
+    put_node(store, "n0")
+    c = make_coord(store)
+    c.bootstrap()
+    c._pods_watch.canceled = True
+    put_node(store, "n1", labels={"fresh": "yes"})
+    c.drain_watches()
+    assert not c._pods_watch.canceled   # fresh watcher after resync
+    assert set(c.host._row_of) == {"n0", "n1"}
+    # Intake is live again end to end.
+    put_pod(store, "after", node_selector={"fresh": "yes"})
+    c.run_until_idle()
+    assert node_of(store, "default", "after") == "n1"
+
+
 def test_retry_after_spec_change_binds_fresh_bytes(store):
     """A CAS conflict caused by a spec update must retry with the NEW
     object bytes — splicing nodeName into the stale intake bytes would

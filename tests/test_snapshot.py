@@ -275,3 +275,107 @@ def test_decode_node_fast_rejects_nested_allocatable():
     assert decode_node_fast(data) is None
     full = decode_node(data)
     assert full.cpu_milli == 2000 and full.pods == 10
+
+
+def test_decode_node_fast_rejects_duplicate_landmarks_after_span():
+    """json.loads is last-wins for duplicate keys, the byte scanner is
+    first-wins — so any duplicate of a consumed landmark AFTER the parsed
+    span (a second status.allocatable, a second top-level status/spec/
+    metadata) must kick the value to the JSON path; both paths then
+    agree.  Plain heartbeat tails (string-valued "status" in conditions)
+    must stay fast."""
+    from k8s1m_tpu.control.objects import (
+        decode_node,
+        decode_node_fast,
+        encode_node,
+    )
+    from k8s1m_tpu.snapshot.node_table import NodeInfo
+
+    base = encode_node(NodeInfo(name="n", cpu_milli=2000, mem_kib=4, pods=10))
+    assert base.endswith(b"]}}")  # ...conditions]} status} root}
+
+    # Duplicate allocatable inside status, after the parsed one:
+    # json.loads sees cpu=1m, the scanner would have seen 2000m.
+    dup_alloc = base[:-2] + (
+        b',"allocatable":{"cpu":"1m","memory":"1Ki","pods":"5"}}}'
+    )
+    assert decode_node_fast(dup_alloc) is None
+    assert decode_node(dup_alloc).cpu_milli == 1
+
+    # Duplicate top-level status: last-wins replaces the whole object.
+    dup_status = base[:-1] + (
+        b',"status":{"allocatable":{"cpu":"3m","memory":"1Ki","pods":"7"}}}'
+    )
+    assert decode_node_fast(dup_status) is None
+    assert decode_node(dup_status).cpu_milli == 3
+
+    # Duplicate key INSIDE allocatable, after pods: json.loads gives
+    # cpu=1m, the scanner consumed 2000m first.
+    assert b'"pods":"10"}' in base
+    dup_cpu = base.replace(b'"pods":"10"}', b'"pods":"10","cpu":"1m"}')
+    assert decode_node_fast(dup_cpu) is None
+    assert decode_node(dup_cpu).cpu_milli == 1
+
+    # Whitespace-variant duplicates (legal JSON) must not evade.
+    ws_status = base[:-1] + (
+        b', "status" : {"allocatable":{"cpu":"3m","memory":"1Ki",'
+        b'"pods":"7"}}}'
+    )
+    assert decode_node_fast(ws_status) is None
+    assert decode_node(ws_status).cpu_milli == 3
+
+    # String-valued duplicate top-level status: json.loads drops
+    # allocatable entirely.
+    str_status = base[:-1] + b',"status":"gone"}'
+    assert decode_node_fast(str_status) is None
+
+    # Truncated tail: json.loads raises; the fast path must not parse
+    # what the JSON path rejects.
+    assert decode_node_fast(base[:-1]) is None
+
+    # Malformed tails json.loads raises on: garbage literal, mismatched
+    # bracket types, bad comma, trailing garbage, leading-zero number.
+    for tail in (
+        b',"x":nope}}',
+        b',"x":{]}}',
+        b',"x":[}]}}',
+        b',,"x":1}}',
+        b',"x":1}}x',
+        b',"x":01}}',
+        b',"x":1.}}',
+        b',"x":"unterminated',
+        b',"x":"a\nb"}}',          # raw control char in a string
+        b',"x":"\xff"}}',          # invalid UTF-8
+    ):
+        bad = base[:-2] + tail
+        assert decode_node_fast(bad) is None, tail
+        try:
+            import json as _j
+
+            _j.loads(bad)
+            raise AssertionError("json accepted %r" % tail)
+        except ValueError:
+            pass
+
+    # Valid-but-exotic tails json.loads accepts must stay fast: nested
+    # arrays/objects, numbers in every shape, ws, true/false/null.
+    for tail in (
+        b',"x":[1,2.5,-3e2,0,[],{}],"y":{"a":[true,false,null]}}}',
+        b' , "x" : { "deep" : [ { "s" : "v" } ] } } }',
+    ):
+        ok = base[:-2] + tail
+        fast2 = decode_node_fast(ok)
+        assert fast2 is not None and fast2.cpu_milli == 2000, tail
+        assert decode_node(ok) == fast2
+
+    # Benign heartbeat tail (string "status" values inside conditions)
+    # stays on the fast path — the rejection must not demote the hot
+    # churn shape.
+    hb = base[:-2] + (
+        b',"conditions":[{"type":"Ready","status":"True"},'
+        b'{"type":"MemoryPressure","status":"False",'
+        b'"lastHeartbeatTime":12345.5}]}}'
+    )
+    fast = decode_node_fast(hb)
+    assert fast is not None and fast.cpu_milli == 2000
+    assert decode_node(hb) == fast

@@ -322,6 +322,115 @@ def test_tier_read_your_writes_immediately(env):
     loop.run_until_complete(go())
 
 
+def test_pinned_revision_pages_served_from_cache(loop):
+    """Pages 2+ of a paginated list pin page 1's header revision; on a
+    quiet prefix (pin == cache.last_revision) the tier must serve them
+    itself instead of punting every page to the store.  Under churn the
+    pin falls behind and the read goes upstream for true time travel."""
+    store = MemStore()
+
+    async def go():
+        server, port = await serve(store, port=0)
+        sclient = EtcdClient(f"127.0.0.1:{port}")
+        for i in range(6):
+            await sclient.put(PFX + b"p%d" % i, b"v%d" % i)
+        tier = await serve_watch_cache(f"127.0.0.1:{port}", [PFX], port=0)
+        cclient = EtcdClient(f"127.0.0.1:{tier.port}")
+        try:
+            # The progress gate needs the upstream watch stream live;
+            # priming completes slightly before the stream registers.
+            for _ in range(200):
+                if tier.svc.handles[0].session is not None:
+                    break
+                await asyncio.sleep(0.01)
+            calls = {"upstream": 0}
+            real = tier.svc.upstream._range
+
+            async def counting(req):
+                calls["upstream"] += 1
+                return await real(req)
+
+            tier.svc.upstream._range = counting
+
+            # Page 1 (rev=0) from the cache, then pages at its pinned
+            # revision — all cache-served, zero store ranges.
+            p1 = await cclient.range(PFX, prefix_end(PFX), limit=3)
+            pin = p1.header.revision
+            assert p1.more and len(p1.kvs) == 3
+            last = p1.kvs[-1].key
+            p2 = await cclient.range(
+                last + b"\x00", prefix_end(PFX), limit=3, revision=pin
+            )
+            assert [kv.value for kv in p2.kvs] == [b"v3", b"v4", b"v5"]
+            assert p2.header.revision == pin
+            assert calls["upstream"] == 0
+
+            # Churn moves last_revision past the pin -> upstream serves.
+            await sclient.put(PFX + b"p9", b"late")
+            for _ in range(100):
+                if tier.cache.last_revision > pin:
+                    break
+                await asyncio.sleep(0.01)
+            p3 = await cclient.range(
+                last + b"\x00", prefix_end(PFX), limit=3, revision=pin
+            )
+            assert calls["upstream"] == 1
+            # The store's exact-revision view excludes the late write.
+            assert [kv.value for kv in p3.kvs] == [b"v3", b"v4", b"v5"]
+        finally:
+            await cclient.close()
+            await sclient.close()
+            await tier.close()
+            await server.stop(None)
+
+    loop.run_until_complete(go())
+    store.close()
+
+
+def test_confirm_coalescing_one_round_trip_per_burst(loop):
+    """Overlapping confirms must coalesce onto one upstream progress
+    round trip (Kubernetes batches requestWatchProgress the same way):
+    callers that arrive while a request is being issued piggyback on it;
+    callers that arrived strictly before the issuance may not (their
+    write could postdate the request's store-side read)."""
+    from k8s1m_tpu.store.watch_cache import UpstreamHandle
+
+    class FakeSession:
+        """request_progress with wire latency; the 'store' answers each
+        request a beat after the send completes (FIFO, like the real
+        stream)."""
+
+        def __init__(self, h):
+            self.h = h
+            self.sent = 0
+
+        async def request_progress(self):
+            self.sent += 1
+            await asyncio.sleep(0.02)   # send latency: the overlap window
+            asyncio.get_running_loop().call_later(0.005, self.h.note_progress)
+
+    async def go():
+        h = UpstreamHandle(PFX)
+        s = FakeSession(h)
+        h.session = s
+
+        oks = await asyncio.gather(*(h.confirm(5.0) for _ in range(32)))
+        assert all(oks)
+        # Two issuances for the 32-caller burst, not 32: task 1 sends
+        # request 1; task 2's arrival postdates that issuance (its write
+        # could postdate request 1's store-side read) so it must send
+        # request 2; tasks 3..32 arrived before request 2 went out and
+        # all piggyback on it.
+        assert s.sent == 2, s.sent
+
+        # Sequential confirms do NOT share: each needs a fresh request.
+        assert await h.confirm(5.0)
+        assert await h.confirm(5.0)
+        assert s.sent == 4, s.sent
+
+    loop.run_until_complete(go())
+
+
 def test_range_outside_watched_prefixes_goes_upstream(env):
     """The tier watches PFX only; a rev=0 Range elsewhere must come from
     the store (a prefix-scoped cache knows nothing about other keys and

@@ -13,7 +13,11 @@ import subprocess
 import threading
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)), "native", "memstore")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)), "native")
+_SRC_DIRS = (
+    os.path.join(_NATIVE_DIR, "memstore"),
+    os.path.join(_NATIVE_DIR, "wirefront"),
+)
 LIB_PATH = os.path.join(_PKG_DIR, "libmemstore.so")
 
 _lock = threading.Lock()
@@ -23,23 +27,33 @@ def _stale() -> bool:
     if not os.path.exists(LIB_PATH):
         return True
     lib_mtime = os.path.getmtime(LIB_PATH)
-    for name in os.listdir(_SRC_DIR):
-        if name.endswith((".cc", ".h")):
-            if os.path.getmtime(os.path.join(_SRC_DIR, name)) > lib_mtime:
-                return True
+    for d in _SRC_DIRS:
+        for name in os.listdir(d):
+            if name.endswith((".cc", ".h", ".inc")):
+                if os.path.getmtime(os.path.join(d, name)) > lib_mtime:
+                    return True
     return False
 
 
 def ensure_built(force: bool = False) -> str:
-    """Compile libmemstore.so if missing or out of date; returns its path."""
+    """Compile libmemstore.so if missing or out of date; returns its path.
+
+    One shared object holds both the store (native/memstore) and the
+    per-RPC wire front-end (native/wirefront) so the wf_* entry points
+    operate on the same ms_store the ctypes bindings hold.
+    """
     with _lock:
         if not force and not _stale():
             return LIB_PATH
-        tmp = LIB_PATH + ".tmp"
+        # Per-PID tmp: concurrent builds (many freshly spawned harness
+        # subprocesses seeing a stale lib at once) must not clobber each
+        # other's half-written output before the atomic replace.
+        tmp = f"{LIB_PATH}.{os.getpid()}.tmp"
         cmd = [
             "g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
             "-Wall", "-o", tmp,
-            os.path.join(_SRC_DIR, "memstore.cc"),
+            os.path.join(_SRC_DIRS[0], "memstore.cc"),
+            os.path.join(_SRC_DIRS[1], "wirefront.cc"),
         ]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, LIB_PATH)

@@ -249,6 +249,16 @@ def _load_lib():
     ]
     lib.ms_wal_sync.restype = c.c_int
     lib.ms_wal_sync.argtypes = [c.c_void_p]
+    lib.wf_start.restype = c.c_void_p
+    lib.wf_start.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
+    lib.wf_port.restype = c.c_int
+    lib.wf_port.argtypes = [c.c_void_p]
+    lib.wf_stop.argtypes = [c.c_void_p]
+    lib.wf_stress_put.restype = c.c_int64
+    lib.wf_stress_put.argtypes = [
+        c.c_char_p, c.c_int, c.c_int64, c.c_int, c.c_char_p, c.c_int64,
+        c.c_int, c.POINTER(c.c_double),
+    ]
     return lib
 
 
@@ -767,3 +777,56 @@ class MemStore:
     @property
     def db_size(self) -> int:
         return _lib().ms_db_size(self._h)
+
+
+class WireFront:
+    """Native per-RPC etcd wire server over an in-process MemStore.
+
+    The C++ answer to the asyncio server's per-unary-RPC interpreter
+    cost: hand-rolled HTTP/2 + HPACK + the etcd protobuf subset,
+    dispatching straight into the store on the event-loop thread
+    (native/wirefront/wirefront.cc; the reference's equivalent surface
+    is tonic in mem_etcd/src/main.rs:106-156).  Serves KV, Watch, Lease,
+    Maintenance.Status and the k8s1m.BatchKV extension — the same
+    contract as k8s1m_tpu.store.etcd_server, so either can back a
+    cluster.
+    """
+
+    def __init__(self, store: MemStore, host: str = "127.0.0.1",
+                 port: int = 0, threads: int = 1):
+        self._h = _lib().wf_start(
+            store._h, host.encode(), port, threads
+        )
+        if not self._h:
+            raise RuntimeError(f"wf_start failed for {host}:{port}")
+        self.port = _lib().wf_port(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            _lib().wf_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def wire_stress_put(host: str, port: int, count: int, concurrency: int = 64,
+                    prefix: str = "/registry/leases/stress/", key_count: int = 10000,
+                    val_len: int = 256) -> tuple[int, float]:
+    """Native pipelined per-RPC Put load (client side of the standard
+    etcd wire).  Returns (completed_puts, elapsed_seconds).  The client
+    is C++ for the same reason the reference's stress-client is Rust
+    (mem_etcd/stress-client): with one host core a Python client
+    saturates long before any server does.
+    """
+    elapsed = ctypes.c_double()
+    n = _lib().wf_stress_put(
+        host.encode(), port, count, concurrency, prefix.encode(), key_count,
+        val_len, ctypes.byref(elapsed),
+    )
+    if n < 0:
+        raise RuntimeError(f"wf_stress_put failed rc={n}")
+    return int(n), float(elapsed.value)

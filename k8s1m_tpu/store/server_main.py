@@ -35,6 +35,18 @@ def parse_args(argv=None):
         default=[],
         help="prefixes whose writes skip the WAL (e.g. /registry/leases/)",
     )
+    ap.add_argument(
+        "--wire",
+        choices=["asyncio", "native"],
+        default="native",
+        help="wire implementation: the C++ front-end (native/wirefront; "
+        "per-RPC path ~270x the asyncio server's) or the asyncio gRPC "
+        "server",
+    )
+    ap.add_argument(
+        "--wire-threads", type=int, default=1,
+        help="event-loop threads for --wire native",
+    )
     return ap.parse_args(argv)
 
 
@@ -44,6 +56,22 @@ async def amain(args):
         wal_mode=args.wal_default,
         no_write_prefixes=tuple(args.wal_no_write_prefix),
     )
+    if args.wire == "native":
+        from k8s1m_tpu.store.native import WireFront
+
+        wf = WireFront(store, host=args.host, port=args.port,
+                       threads=args.wire_threads)
+        if args.metrics_port:
+            from k8s1m_tpu.obs.http import start_metrics_server
+
+            start_metrics_server(args.metrics_port)
+        logging.info(
+            "memstore serving etcd API on :%d via native wirefront "
+            "(metrics :%d)", wf.port, args.metrics_port,
+        )
+        # Park forever; the C++ loops do the serving.
+        await asyncio.Event().wait()
+        return
     server, port = await serve(
         store, port=args.port, host=args.host, metrics_port=args.metrics_port
     )

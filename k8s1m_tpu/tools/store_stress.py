@@ -30,10 +30,37 @@ def parse_args(argv=None):
     ap.add_argument("--ranges", type=int, default=100)
     ap.add_argument("--value-size", type=int, default=256)
     ap.add_argument("--range-limit", type=int, default=100)
+    ap.add_argument(
+        "--native-client", action="store_true",
+        help="drive the standard per-RPC Put path with the native "
+        "pipelined client (wf_stress_put) instead of Python grpcio — "
+        "with one host core, Python saturates near 20K RPC/s while the "
+        "server can serve 400K+; this measures the SERVER (the "
+        "reference's stress-client is native for the same reason)",
+    )
+    ap.add_argument("--key-count", type=int, default=10000,
+                    help="distinct keys cycled by --native-client")
     return ap.parse_args(argv)
 
 
 async def amain(args) -> dict:
+    if args.native_client:
+        from k8s1m_tpu.store.native import wire_stress_put
+
+        host, _, port = args.target.rpartition(":")
+        n, elapsed = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: wire_stress_put(
+                host or "127.0.0.1", int(port), args.puts,
+                concurrency=args.concurrency,
+                prefix=PREFIX.decode(), key_count=args.key_count,
+                val_len=args.value_size,
+            )
+        )
+        return {
+            "puts": n,
+            "puts_per_sec": round(n / elapsed, 1),
+            "client": "native-per-rpc",
+        }
     value = os.urandom(args.value_size)
     put_rep = RateReporter("puts", quiet=args.quiet)
 

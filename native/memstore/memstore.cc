@@ -253,6 +253,15 @@ class Wal {
   }
 
   bool fsync_mode() const { return mode_ == MS_WAL_FSYNC; }
+  int mode() const { return mode_; }
+  int64_t persisted_revision() {
+    std::lock_guard<std::mutex> g(pm_);
+    return persisted_;
+  }
+  bool io_error() {
+    std::lock_guard<std::mutex> g(pm_);
+    return io_error_;
+  }
 
  private:
   void Run() {
@@ -720,11 +729,11 @@ static int64_t store_set_locked(ms_store* s, const std::string& key,
   return rev;
 }
 
-int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
-               const uint8_t* val, size_t vlen, int has_req,
-               int req_is_version, int64_t req_val, int64_t lease,
-               int64_t* latest_rev_out, uint8_t** cur_out,
-               size_t* cur_len_out) {
+static int64_t ms_set_impl(ms_store* s, const uint8_t* key, size_t klen,
+                           const uint8_t* val, size_t vlen, int has_req,
+                           int req_is_version, int64_t req_val, int64_t lease,
+                           int64_t* latest_rev_out, uint8_t** cur_out,
+                           size_t* cur_len_out, bool wait_durable) {
   std::string k(reinterpret_cast<const char*>(key), klen);
   int64_t rev;
   bool fsync_wait = false;
@@ -734,11 +743,43 @@ int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
                            req_is_version, req_val, lease, latest_rev_out,
                            cur_out, cur_len_out, &fsync_wait);
   }
-  if (rev > 0 && fsync_wait) {
+  if (wait_durable && rev > 0 && fsync_wait) {
     // fsync mode: block until durable (reference store.rs:415-437).
     s->wal->WaitPersisted(rev);
   }
   return rev;
+}
+
+int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
+               const uint8_t* val, size_t vlen, int has_req,
+               int req_is_version, int64_t req_val, int64_t lease,
+               int64_t* latest_rev_out, uint8_t** cur_out,
+               size_t* cur_len_out) {
+  return ms_set_impl(s, key, klen, val, vlen, has_req, req_is_version,
+                     req_val, lease, latest_rev_out, cur_out, cur_len_out,
+                     true);
+}
+
+int64_t ms_set_nowait(ms_store* s, const uint8_t* key, size_t klen,
+                      const uint8_t* val, size_t vlen, int has_req,
+                      int req_is_version, int64_t req_val, int64_t lease,
+                      int64_t* latest_rev_out, uint8_t** cur_out,
+                      size_t* cur_len_out) {
+  return ms_set_impl(s, key, klen, val, vlen, has_req, req_is_version,
+                     req_val, lease, latest_rev_out, cur_out, cur_len_out,
+                     false);
+}
+
+int ms_wal_mode(ms_store* s) {
+  return s->wal ? s->wal->mode() : MS_WAL_NONE;
+}
+
+int64_t ms_wal_persisted_revision(ms_store* s) {
+  return s->wal ? s->wal->persisted_revision() : 0;
+}
+
+int ms_wal_io_error(ms_store* s) {
+  return s->wal && s->wal->io_error() ? 1 : 0;
 }
 
 int64_t ms_put_batch(ms_store* s, const uint8_t* buf, size_t len, int n,

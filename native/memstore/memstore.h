@@ -84,6 +84,30 @@ int64_t ms_set(ms_store* s, const uint8_t* key, size_t klen,
 
 /* In fsync mode, ms_set returns only after the record is durable. */
 
+/* Non-blocking twin of ms_set for completion-driven servers (the wire
+ * front-end): never blocks on WAL durability.  In fsync mode the caller
+ * must hold the client's response until ms_wal_persisted_revision()
+ * reaches the returned revision — that is what turns N concurrent
+ * per-RPC puts into ONE group-committed fsync (the reference gets the
+ * same effect from its batched writer threads, wal.rs:173-248). */
+int64_t ms_set_nowait(ms_store* s, const uint8_t* key, size_t klen,
+                      const uint8_t* val, size_t vlen, int has_req,
+                      int req_is_version, int64_t req_val, int64_t lease,
+                      int64_t* latest_rev_out, uint8_t** cur_out,
+                      size_t* cur_len_out);
+
+/* WAL mode of this store (MS_WAL_*). */
+int ms_wal_mode(ms_store* s);
+
+/* Highest revision whose WAL records are durably written (fsync'd in
+ * fsync mode; written in buffered mode; 0 when the WAL is disabled). */
+int64_t ms_wal_persisted_revision(ms_store* s);
+
+/* Nonzero once a WAL write/fsync has failed; persisted_revision never
+ * advances afterwards, so completion-driven callers must fail their
+ * held responses instead of waiting. */
+int ms_wal_io_error(ms_store* s);
+
 /* Batch write: n records packed as
  *   u32 klen | u32 vlen | key bytes | val bytes
  * with vlen == 0xFFFFFFFF marking a delete.  The whole batch executes

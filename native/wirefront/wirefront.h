@@ -1,0 +1,73 @@
+/* wirefront — native per-RPC etcd wire front-end for the memstore.
+ *
+ * The reference serves the STANDARD etcd gRPC wire — one Txn/Put per
+ * RPC — at 100K+ puts/s fsync-capped (reference README.adoc:343-353,
+ * mem_etcd/src/kv_service.rs:126-337, tonic tuning main.rs:145-147).
+ * A Python asyncio gRPC server pays ~300-600us of interpreter work per
+ * unary RPC, capping the same contract near 1.6K puts/s.  This module
+ * is the C++ answer: a minimal HTTP/2 + gRPC server (hand-rolled HPACK
+ * per RFC 7541, frames per RFC 7540, etcd protobuf subset hand-coded)
+ * dispatching straight into the in-process memstore with zero
+ * per-request heap-churn beyond the response buffer.
+ *
+ * Also exports a pipelined gRPC stress CLIENT (the reference ships a
+ * native stress-client for the same reason, mem_etcd/stress-client):
+ * with one host core, a Python client saturates long before any server
+ * does, so wire throughput must be measured native-to-native.
+ *
+ * Concurrency contract: with --wire-threads > 1, a multi-key range
+ * DeleteRange (Range keys, then per-key deletes) can interleave with
+ * writes from another loop thread — the SAME interleaving the asyncio
+ * server exhibits at its await points, and a divergence from etcd's
+ * atomic DeleteRange that Kubernetes' hot paths never exercise (they
+ * are single-key; see the matching note in etcd_server.py DeleteRange).
+ *
+ * Scope: KV (Range/Put/DeleteRange/Txn/Compact), Watch (bidi), Lease
+ * (Grant/Revoke/KeepAlive — deliberately fake TTLs like the reference,
+ * lease_service.rs), Maintenance (Status), k8s1m.BatchKV (PutFrame/
+ * BindFrame).  Anything else answers UNIMPLEMENTED.  Semantics mirror
+ * k8s1m_tpu/store/etcd_server.py so the same test corpus passes against
+ * either server.
+ */
+#ifndef WIREFRONT_H
+#define WIREFRONT_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#include "../memstore/memstore.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct wf_server wf_server;
+
+/* Start serving `store` on host:port (port 0 = ephemeral) with n event
+ * loop threads (each its own epoll + SO_REUSEPORT listener).  Returns
+ * NULL on bind failure. */
+wf_server* wf_start(ms_store* store, const char* host, int port,
+                    int threads);
+
+/* Bound port (useful with port=0). */
+int wf_port(wf_server* s);
+
+/* Stop accepting, close connections, join threads, free. */
+void wf_stop(wf_server* s);
+
+/* Pipelined per-RPC Put stress client: opens one connection to
+ * host:port, keeps `concurrency` unary KV.Put RPCs in flight until
+ * `count` total completed.  Keys cycle through `key_count` distinct
+ * keys "<prefix><i>"; values are `val_len` bytes.  Returns completed
+ * puts (== count) or a negative errno-style value on connect/protocol
+ * failure.  elapsed_s_out receives wall seconds. */
+int64_t wf_stress_put(const char* host, int port, int64_t count,
+                      int concurrency, const char* prefix,
+                      int64_t key_count, int val_len,
+                      double* elapsed_s_out);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* WIREFRONT_H */

@@ -21,10 +21,32 @@ def loop():
     loop.close()
 
 
-@pytest.fixture()
-def env(loop):
-    """(client, store) against a live in-process server on a random port."""
+@pytest.fixture(params=["asyncio", "native"])
+def env(loop, request):
+    """(client, store) against a live server on a random port.
+
+    Parametrized over BOTH wire implementations — the asyncio gRPC
+    server (etcd_server.py) and the C++ front-end (native/wirefront) —
+    so one corpus pins the contract for either, the way the reference's
+    kv_service tests pin tonic's behavior.
+    """
     store = MemStore()
+    if request.param == "native":
+        from k8s1m_tpu.store.native import WireFront
+
+        wf = WireFront(store)
+
+        async def _mk():
+            # grpc.aio binds the channel to the running loop; create it
+            # inside `loop` like the asyncio variant does.
+            return EtcdClient(f"127.0.0.1:{wf.port}")
+
+        client = loop.run_until_complete(_mk())
+        yield loop, client, store
+        loop.run_until_complete(client.close())
+        wf.close()
+        store.close()
+        return
     server, client = loop.run_until_complete(_start(store))
     yield loop, client, store
     loop.run_until_complete(client.close())

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-3 TPU pool probe: self-exiting fail-fast probes (never externally
+# killed mid-op — see bench.py _require_device for why). Exits 0 and writes
+# /tmp/tpu_up the moment jax.devices() answers.
+cd /root/repo
+while true; do
+  if python -u -c "
+import threading, os
+t = threading.Timer(250.0, lambda: os._exit(3)); t.daemon = True; t.start()
+import jax
+print(jax.devices()[0], flush=True)
+os._exit(0)
+" > /tmp/tpu_probe3.out 2>&1; then
+    date -u +%FT%TZ > /tmp/tpu_up
+    exit 0
+  fi
+  sleep 150
+done

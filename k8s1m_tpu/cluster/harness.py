@@ -63,6 +63,11 @@ class ClusterSpec:
     rebalance_interval_s: float = 30.0
     zones: int = 8
     regions: int = 4
+    # When set, every subprocess's stderr is shipped into ONE
+    # timestamped JSONL under this directory (obs/logship.py — the
+    # fluent-bit role at rig scale, reference terraform/kubernetes/
+    # fluentbit.tf).  None = inherit stderr (test-friendly default).
+    log_dir: str | None = None
     wal_mode: str = "buffered"
     # The reference skips the WAL for the lease-flood prefix
     # (--wal-no-write-prefix; leases are 100K writes/s of pure churn).
@@ -141,6 +146,12 @@ class Cluster:
         # so a partial-init crash still tears the subprocess down cleanly
         # at exit.
         self._server = None
+        self.log_shipper = None
+        if spec.log_dir:
+            from k8s1m_tpu.obs.logship import LogShipper
+
+            self.log_shipper = LogShipper(spec.log_dir)
+            self.log_shipper.attach_logging()
         self._clients: list[RemoteStore] = []
         self.coordinators: list[HACoordinator] = []
         self.kwoks: list[KwokController] = []
@@ -154,7 +165,7 @@ class Cluster:
         ]
         for p in spec.no_write_prefixes:
             cmd += ["--wal-no-write-prefix", p]
-        self._server = subprocess.Popen(cmd)
+        self._server = subprocess.Popen(cmd, stderr=self._ship("store"))
         self._tier = None
         self.tier_port: int | None = None
         atexit.register(self.shutdown)
@@ -168,7 +179,7 @@ class Cluster:
                 "--host", "127.0.0.1", "--port", str(self.tier_port),
                 "--prefix", "/registry/",
                 "--index", spec.watch_cache_index,
-            ])
+            ], stderr=self._ship("tier"))
             # Port bind happens after cache priming (watch_cache.py), so
             # this doubles as the primed signal.  Priming walks the whole
             # store, so the wait must scale with it (1M nodes would blow
@@ -372,6 +383,11 @@ class Cluster:
             "binds_per_sec": round(bound / total_s, 1),
         }
 
+    def _ship(self, src: str):
+        """stderr target for a subprocess: the log shipper's pipe when
+        aggregation is on, else inherit."""
+        return self.log_shipper.pipe(src) if self.log_shipper else None
+
     def _stop_server(self) -> None:
         self._server.terminate()
         try:
@@ -386,7 +402,9 @@ class Cluster:
         streams surface as dropped and every consumer relists."""
         cmd = self._server.args
         self._stop_server()
-        self._server = subprocess.Popen(cmd)
+        self._server = subprocess.Popen(
+            cmd, stderr=self._ship("store")
+        )
         # WAL-skipped prefixes (leases) lower the replayed revision below
         # the pre-crash counter; a stale compaction target would then be
         # a future revision the store rejects.
@@ -436,6 +454,9 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 self._tier.kill()
                 self._tier.wait()
+        if self.log_shipper is not None:
+            self.log_shipper.close()
+            self.log_shipper = None
             self._tier = None
         self._stop_server()
         self._server = None

@@ -58,6 +58,10 @@ _EVENTS_OUT = Counter(
 _WATCHERS = Gauge("watchcache_watchers", "active client watches", ())
 
 _DEFAULT_WINDOW = 65536
+
+# Priming list page size: bounds any single upstream response to a few
+# MB regardless of prefix population (client-go chunking equivalent).
+_PRIME_PAGE = 10_000
 _QUEUE_CAP = 10_000
 _WATCH_BATCH = 1000
 
@@ -327,13 +331,26 @@ async def run_upstream(
                 # relist; cancel every client watch (they relist) and
                 # rebuild.
                 cache.invalidate()
-            resp = await client.prefix(prefix)
-            cache.prime(resp.kvs, resp.header.revision)
+            # Paginated prime at a pinned revision: one unpaginated list
+            # of a six-figure prefix is a single multi-MB response (the
+            # 100K-watch scale run measured 6.3MB — over default client
+            # message caps), exactly why every other bootstrap in this
+            # framework paginates (native.list_prefix).
+            page = await client.range(prefix, end, limit=_PRIME_PAGE)
+            rev = page.header.revision
+            kvs = list(page.kvs)
+            while page.more:
+                page = await client.range(
+                    page.kvs[-1].key + b"\x00", end,
+                    limit=_PRIME_PAGE, revision=rev,
+                )
+                kvs.extend(page.kvs)
+            cache.prime(kvs, rev)
             primed_once = True
             if primed is not None:
                 primed.set()
             async with client.watch(
-                prefix, end, start_revision=resp.header.revision + 1
+                prefix, end, start_revision=rev + 1
             ) as session:
                 if session.compact_revision:
                     continue    # relist: our revision already compacted

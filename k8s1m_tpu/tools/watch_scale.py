@@ -1,0 +1,271 @@
+"""Watch-cache tier SCALE proof: hold >=100K concurrent client watches
+on one core and measure what they cost.
+
+The reference's finding is 18 apiserver watches per node -> 18M client
+watches at 1M nodes, none reaching etcd (reference README.adoc:410-416).
+`watch_fanout_ab.py` proves the amplification economics at bench scale;
+this tool proves the TIER ITSELF holds six figures of concurrent
+watches: creation rate, resident memory per watch, store-side watcher
+count (constant), and live fan-out throughput with the idle population
+attached.
+
+Watches are MULTIPLEXED over a few bidi streams with explicit watch ids
+— exactly how kube-apiserver talks to etcd (one stream, many watches),
+and the only honest way to hold 100K watches from one client core.
+
+    python -m k8s1m_tpu.tools.watch_scale --idle 100000 --active 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+import grpc
+from grpc import aio
+
+from k8s1m_tpu.store.etcd_client import EtcdClient
+from k8s1m_tpu.store.native import MemStore
+from k8s1m_tpu.store.proto import rpc_pb2
+from k8s1m_tpu.store.watch_cache import serve_watch_cache
+
+IDLE_PREFIX = b"/registry/configmaps/scale/"
+HOT_PREFIX = b"/registry/leases/scale/"
+
+
+def _rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+def _tier_rss_mb(pid: int) -> float:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return 0.0
+
+
+class MuxWatch:
+    """One bidi Watch stream carrying many watches (client side)."""
+
+    def __init__(self, channel: aio.Channel):
+        self._call = channel.stream_stream(
+            "/etcdserverpb.Watch/Watch",
+            request_serializer=rpc_pb2.WatchRequest.SerializeToString,
+            response_deserializer=rpc_pb2.WatchResponse.FromString,
+        )()
+        self.created = 0
+        self.delivered = 0
+        self.canceled = 0
+        self._created_ev = asyncio.Event()
+        self._reader = asyncio.create_task(self._read())
+
+    async def create(self, keys: list[bytes], first_id: int) -> None:
+        for i, key in enumerate(keys):
+            await self._call.write(
+                rpc_pb2.WatchRequest(
+                    create_request=rpc_pb2.WatchCreateRequest(
+                        key=key, watch_id=first_id + i
+                    )
+                )
+            )
+
+    async def wait_created(self, n: int, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while self.created < n:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {self.created}/{n} watches created"
+                )
+            await asyncio.sleep(0.05)
+
+    async def _read(self) -> None:
+        try:
+            async for resp in self._call:
+                if resp.canceled:
+                    self.canceled += 1
+                elif resp.created:
+                    self.created += 1
+                else:
+                    self.delivered += len(resp.events)
+        except (asyncio.CancelledError, grpc.RpcError):
+            pass
+
+    async def close(self) -> None:
+        self._reader.cancel()
+        try:
+            await self._reader
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="tier watch-scale proof")
+    ap.add_argument("--idle", type=int, default=100_000)
+    ap.add_argument("--active", type=int, default=2_000)
+    ap.add_argument("--streams", type=int, default=8,
+                    help="bidi streams the watches multiplex over")
+    ap.add_argument("--writes", type=int, default=20_000)
+    ap.add_argument("--index", choices=("hash", "btree"), default="hash")
+    return ap.parse_args(argv)
+
+
+async def amain(args) -> dict:
+    import subprocess
+    import sys
+
+    from k8s1m_tpu.store.native import WireFront
+
+    store = MemStore()
+    # Native wire server: keeps the store off this event loop entirely
+    # (the asyncio server would contend with the mux readers for it).
+    wf = WireFront(store)
+    store_port = wf.port
+    seed = EtcdClient(f"127.0.0.1:{store_port}")
+    # Idle objects exist but never change after creation.
+    wave = []
+    for i in range(args.idle):
+        wave.append((IDLE_PREFIX + b"cm-%07d" % i, b'{"data":{}}'))
+        if len(wave) == 8192:
+            await seed.put_batch(wave)
+            wave.clear()
+    for i in range(args.active):
+        wave.append((HOT_PREFIX + b"lease-%05d" % i, b"0"))
+    if wave:
+        await seed.put_batch(wave)
+
+    # Tier as a SUBPROCESS so its RSS is attributable.
+    from k8s1m_tpu.cluster.harness import _free_port
+
+    tier_port = _free_port()
+    tier_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+            "--upstream", f"127.0.0.1:{store_port}",
+            "--host", "127.0.0.1", "--port", str(tier_port),
+            "--prefix", IDLE_PREFIX.decode(),
+            "--prefix", HOT_PREFIX.decode(),
+            "--index", args.index,
+        ],
+        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        # The in-process store server shares THIS event loop; a blocking
+        # wait_for_port would starve it and deadlock the tier's priming.
+        import socket as _socket
+
+        deadline = time.monotonic() + 120 + args.idle / 2000
+        while True:
+            if tier_proc.poll() is not None:
+                raise RuntimeError(f"tier exited rc={tier_proc.returncode}")
+            try:
+                with _socket.create_connection(
+                    ("127.0.0.1", tier_port), timeout=0.2
+                ):
+                    break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("tier did not bind")
+                await asyncio.sleep(0.05)
+        rss0 = _tier_rss_mb(tier_proc.pid)
+
+        channel = aio.insecure_channel(
+            f"127.0.0.1:{tier_port}",
+            options=[("grpc.max_receive_message_length", 64 << 20)],
+        )
+        muxes = [MuxWatch(channel) for _ in range(args.streams)]
+
+        # Create idle watches round-robin over the streams.
+        t0 = time.perf_counter()
+        per = (args.idle + args.streams - 1) // args.streams
+        next_id = 1
+        creates = []
+        for m in muxes:
+            lo = next_id - 1
+            keys = [
+                IDLE_PREFIX + b"cm-%07d" % (lo + i)
+                for i in range(min(per, args.idle - lo))
+            ]
+            creates.append((m, keys, next_id))
+            next_id += len(keys)
+        await asyncio.gather(
+            *(m.create(keys, fid) for m, keys, fid in creates)
+        )
+        for m, keys, _ in creates:
+            await m.wait_created(len(keys), timeout=240)
+        create_s = time.perf_counter() - t0
+
+        # Active watches on the hot keys, on stream 0.
+        hot_first = next_id
+        hot_keys = [HOT_PREFIX + b"lease-%05d" % i for i in range(args.active)]
+        await muxes[0].create(hot_keys, hot_first)
+        await muxes[0].wait_created(per + args.active, timeout=120)
+
+        rss1 = _tier_rss_mb(tier_proc.pid)
+        store_watchers = store.stats()["watchers"]
+
+        # Live fan-out: write the hot keys while 100K idle watches sit
+        # attached; every write fans to exactly one active watch.
+        t0 = time.perf_counter()
+        written = 0
+        base_delivered = sum(m.delivered for m in muxes)
+        while written < args.writes:
+            n = min(2000, args.writes - written)
+            await seed.put_batch([
+                (hot_keys[(written + i) % args.active], b"%d" % (written + i))
+                for i in range(n)
+            ])
+            written += n
+        # Wait for deliveries to drain.
+        deadline = time.monotonic() + 120
+        while (
+            sum(m.delivered for m in muxes) - base_delivered < args.writes
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.05)
+        window = time.perf_counter() - t0
+        delivered = sum(m.delivered for m in muxes) - base_delivered
+
+        for m in muxes:
+            await m.close()
+        await channel.close()
+    finally:
+        tier_proc.terminate()
+        try:
+            tier_proc.wait(timeout=10)
+        except Exception:
+            tier_proc.kill()
+        await seed.close()
+        wf.close()
+        store.close()
+
+    total_watches = args.idle + args.active
+    return {
+        "metric": "tier_concurrent_watches",
+        "value": total_watches,
+        "unit": "watches",
+        "vs_baseline": round(total_watches / 18_000_000, 4),
+        "create_per_sec": round(args.idle / create_s, 1),
+        "tier_rss_mb": round(rss1, 1),
+        "kb_per_watch": round((rss1 - rss0) * 1024.0 / total_watches, 2),
+        "store_watchers": store_watchers,
+        "delivered": delivered,
+        "delivered_per_sec": round(delivered / window, 1),
+        "canceled": sum(m.canceled for m in muxes),
+    }
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    print(json.dumps(asyncio.run(amain(args))))
+
+
+if __name__ == "__main__":
+    main()

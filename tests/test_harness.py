@@ -272,3 +272,30 @@ def test_store_crash_recovery_behind_tier(tmp_path):
                 and json.loads(kv.value)["status"]["phase"] == "Running"
             )
         assert running == 10
+
+
+def test_log_aggregation_one_jsonl_per_run(tmp_path):
+    """ClusterSpec.log_dir funnels every subprocess's stderr into one
+    timestamped JSONL (the fluent-bit role, obs/logship.py): store and
+    tier records land in a single stream with source labels."""
+    import glob
+
+    spec = ClusterSpec(
+        nodes=16, kwok_groups=1, coordinators=1, pod_batch=8, chunk=16,
+        wal_mode="none", watch_cache=True, log_dir=str(tmp_path),
+    )
+    with Cluster(spec) as c:
+        c.make_nodes()
+        c.put_pod("default", "ship-me")
+        c.run_until_bound("default", "ship-me")
+        path = c.log_shipper.path
+    files = glob.glob(str(tmp_path / "cluster-*.jsonl"))
+    assert files == [path]
+    srcs = set()
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            assert {"ts", "src", "line"} <= set(rec)
+            srcs.add(rec["src"])
+    # Both subprocesses logged at least their startup line.
+    assert {"store", "tier"} <= srcs, srcs

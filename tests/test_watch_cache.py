@@ -446,3 +446,50 @@ def test_range_outside_watched_prefixes_goes_upstream(env):
         assert len(resp.kvs) == 1
 
     loop.run_until_complete(go())
+
+
+def test_prime_paginates_large_prefixes(loop):
+    """Priming a prefix bigger than one page must arrive via pinned-
+    revision pages (one unpaginated six-figure list is a multi-MB
+    response over default client caps — found by the 100K-watch scale
+    run) and still yield a complete, consistent cache."""
+    from k8s1m_tpu.store import watch_cache as wc
+
+    store = MemStore()
+
+    async def go():
+        server, port = await serve(store, port=0)
+        sclient = EtcdClient(f"127.0.0.1:{port}")
+        n = wc._PRIME_PAGE * 2 + 7   # forces 3 pages
+        wave = []
+        for i in range(n):
+            wave.append((PFX + b"pg-%06d" % i, b"v"))
+            if len(wave) == 8192:
+                await sclient.put_batch(wave)
+                wave.clear()
+        if wave:
+            await sclient.put_batch(wave)
+        tier = await serve_watch_cache(f"127.0.0.1:{port}", [PFX], port=0)
+        cclient = EtcdClient(f"127.0.0.1:{tier.port}")
+        try:
+            assert len(tier.cache.objects) == n
+            # Cache-served count and point reads see every page's rows.
+            resp = await cclient.prefix(PFX, count_only=True)
+            assert resp.count == n
+            kv = await cclient.get(PFX + b"pg-%06d" % (n - 1))
+            assert kv is not None and kv.value == b"v"
+            # Live watch still rides the primed revision.
+            s = cclient.watch(PFX + b"pg-000000")
+            async with s:
+                await sclient.put(PFX + b"pg-000000", b"v2")
+                b = await s.next(timeout=5)
+                assert b.events[0].kv.value == b"v2"
+                await s.cancel()
+        finally:
+            await cclient.close()
+            await sclient.close()
+            await tier.close()
+            await server.stop(None)
+
+    loop.run_until_complete(go())
+    store.close()

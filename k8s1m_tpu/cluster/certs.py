@@ -1,0 +1,119 @@
+"""Self-signed TLS provisioning for the rig — the terraform role.
+
+The reference provisions webhook TLS out-of-band: a terraform
+``tls_self_signed_cert`` CA signs a server cert whose SANs cover the
+webhook Service, and the cert/key land in ``/etc/webhook/certs`` for the
+server (reference terraform/kubernetes/dist-scheduler.tf:713-740,
+pkg/webhook/webhook.go:33-35); the VM metrics proxies get the same
+treatment (terraform/k8s-server/server.tf:204-229).
+
+Here the same chain is one function: ``provision(dir)`` writes a CA and
+a CA-signed server cert/key and returns ready-to-use ``ssl.SSLContext``
+builders for both sides.  The harness calls it when
+``ClusterSpec.webhook_tls`` is set; tests use the client context to
+verify the chain end to end (a client without the CA must fail).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import ipaddress
+import os
+import ssl
+
+
+@dataclasses.dataclass
+class CertPaths:
+    ca_pem: str
+    cert_pem: str
+    key_pem: str
+
+    def server_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_pem, self.key_pem)
+        return ctx
+
+    def client_context(self) -> ssl.SSLContext:
+        """Verifying client context: trusts only this rig's CA."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(self.ca_pem)
+        return ctx
+
+
+def provision(
+    cert_dir: str,
+    *,
+    common_name: str = "k8s1m-webhook",
+    hostnames: tuple[str, ...] = ("localhost",),
+    ips: tuple[str, ...] = ("127.0.0.1",),
+    days: int = 7,
+) -> CertPaths:
+    """Write ca.pem / cert.pem / key.pem under ``cert_dir``.
+
+    CA-signed (not bare self-signed) so clients exercise real chain
+    verification, like the reference's terraform chain.
+    """
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(cert_dir, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    not_after = now + datetime.timedelta(days=days)
+
+    ca_key = ec.generate_private_key(ec.SECP256R1())
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "k8s1m-rig-ca")]
+    )
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    san = x509.SubjectAlternativeName(
+        [x509.DNSName(h) for h in hostnames]
+        + [x509.IPAddress(ipaddress.ip_address(i)) for i in ips]
+    )
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+        )
+        .issuer_name(ca_name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(not_after)
+        .add_extension(san, False)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    paths = CertPaths(
+        ca_pem=os.path.join(cert_dir, "ca.pem"),
+        cert_pem=os.path.join(cert_dir, "cert.pem"),
+        key_pem=os.path.join(cert_dir, "key.pem"),
+    )
+    with open(paths.ca_pem, "wb") as f:
+        f.write(ca_cert.public_bytes(serialization.Encoding.PEM))
+    with open(paths.cert_pem, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(paths.key_pem, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+    return paths

@@ -82,6 +82,10 @@ class ClusterSpec:
     # (README.adoc:410-416) — connect to the tier; writes proxy through.
     watch_cache: bool = False
     watch_cache_index: str = "hash"
+    # Serve the webhook intake over HTTPS with rig-provisioned certs
+    # (cluster/certs.py — the reference's terraform-provisioned webhook
+    # TLS, dist-scheduler.tf:713-740, webhook.go:33-35).
+    webhook_tls: bool = False
     table: TableSpec | None = None
     pod_batch: int = 256
     profile: Profile = dataclasses.field(
@@ -237,7 +241,16 @@ class Cluster:
             KwokController(self._kwok_client(), group=g)
             for g in range(spec.kwok_groups)
         ]
-        self.webhook = WebhookServer(self._webhook_sink).start()
+        self.certs = None
+        ssl_context = None
+        if spec.webhook_tls:
+            from k8s1m_tpu.cluster.certs import provision
+
+            self.certs = provision(f"{self.wal_dir}/certs")
+            ssl_context = self.certs.server_context()
+        self.webhook = WebhookServer(
+            self._webhook_sink, ssl_context=ssl_context
+        ).start()
         self._kwok_bootstrapped = False
         self.now = 0.0  # simulated time, monotonic across run_pods calls
         self._next_compact = spec.compact_interval_s
@@ -341,6 +354,9 @@ class Cluster:
             Cluster._run_seq += 1
             prefix = f"bench{Cluster._run_seq}"
         store = self._clients[0]
+        # Invariant across the loop; building it per request would charge
+        # N cert parses to the measured window.
+        tls_ctx = self.certs.client_context() if self.certs else None
         t0 = time.perf_counter()
         for i in range(count):
             pod = encode_pod(
@@ -354,13 +370,18 @@ class Cluster:
                     "kind": "AdmissionReview",
                     "request": {"uid": f"{prefix}-{i}", "object": json.loads(pod)},
                 }
+                # Chain-verified when TLS is on: the client trusts only
+                # the rig CA and checks the cert's 127.0.0.1 IP SAN.
+                scheme = "https" if self.certs else "http"
                 req = urllib.request.Request(
-                    f"http://127.0.0.1:{self.webhook.port}/validate",
+                    f"{scheme}://127.0.0.1:{self.webhook.port}/validate",
                     data=json.dumps(review).encode(),
                     headers={"Content-Type": "application/json"},
                     method="POST",
                 )
-                with urllib.request.urlopen(req, timeout=10) as resp:
+                with urllib.request.urlopen(
+                    req, timeout=10, context=tls_ctx
+                ) as resp:
                     assert json.loads(resp.read())["response"]["allowed"]
             store.put(pod_key("default", f"{prefix}-{i}"), pod)
         created_s = time.perf_counter() - t0
@@ -454,12 +475,16 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 self._tier.kill()
                 self._tier.wait()
-        if self.log_shipper is not None:
-            self.log_shipper.close()
-            self.log_shipper = None
             self._tier = None
         self._stop_server()
         self._server = None
+        if self.log_shipper is not None:
+            # After the subprocesses exit: pipe readers only see EOF once
+            # the last holder of the write fd is gone, so closing earlier
+            # burns the join timeout and drops the store's final stderr
+            # lines — the shutdown errors the shipper exists to capture.
+            self.log_shipper.close()
+            self.log_shipper = None
 
     def __enter__(self):
         return self

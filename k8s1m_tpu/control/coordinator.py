@@ -36,6 +36,7 @@ import collections
 import dataclasses
 import json
 import logging
+import os
 import threading
 import time
 import weakref
@@ -188,6 +189,10 @@ class Coordinator:
         scheduler_name: str = DEFAULT_SCHEDULER,
         seed: int = 0,
         flight_recorder: FlightRecorder | None = None,
+        # Sampling profiler (obs/profiler.py) to dump alongside a slow-
+        # cycle flight dump — the reference's always-answerable "where
+        # did the time go" (parca-agent.tf, scheduler_metrics.go:68-74).
+        profiler=None,
         backend: str = "xla",
         pipeline: bool = False,
         depth: int = 2,
@@ -205,6 +210,8 @@ class Coordinator:
         self.max_attempts = max_attempts
         self.scheduler_name = scheduler_name
         self.flight = flight_recorder
+        self.profiler = profiler
+        self._profile_dumps = 0
         self.backend = backend
         self.pipeline = pipeline
         if depth < 1:
@@ -1025,13 +1032,32 @@ class Coordinator:
             )
 
         if self.flight is not None:
+            cycle_s = time.perf_counter() - t_start
             self.flight.record(
                 "cycle",
-                time.perf_counter() - t_start,
+                cycle_s,
                 pods=len(batch_pods),
                 bound=nbound,
                 queue=len(self.queue),
             )
+            if (
+                self.profiler is not None
+                and cycle_s > self.flight.threshold_s
+                # Same cap discipline as the flight recorder: sustained
+                # slow cycles must not fill the disk, and the dump cost
+                # itself lengthens cycles (self-amplifying otherwise).
+                and self._profile_dumps < self.flight.max_dumps
+            ):
+                self._profile_dumps += 1
+                # The flight dump says WHAT was slow; the profile dump
+                # says WHERE the window's time went.
+                self.profiler.dump(
+                    os.path.join(
+                        self.flight.dump_dir,
+                        f"profile-slowcycle-{int(time.time() * 1e3)}"
+                        f"-{self._profile_dumps}.json",
+                    )
+                )
         return nbound
 
     def step(self) -> int:

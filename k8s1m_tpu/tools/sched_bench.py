@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 from k8s1m_tpu.config import PodSpec, TableSpec
@@ -97,6 +98,12 @@ def parse_args(argv=None):
         help="stressor's concurrent writers (keep low on a single-core "
         "host or the stressor starves the scheduler it is stressing)",
     )
+    ap.add_argument(
+        "--profile", metavar="PATH", default=None,
+        help="sample the measured window with obs/profiler.py, write "
+        "the collapsed-stack artifact to PATH, and print the self-time "
+        "top table to stderr (the pprof/Parca role)",
+    )
     return ap.parse_args(argv)
 
 
@@ -119,18 +126,29 @@ import contextlib
 
 @contextlib.contextmanager
 def _bench_window(args, coord, store):
-    """Measured-window lifecycle: optional watch stressor for the whole
-    window, and guaranteed teardown (stressor, coordinator watches,
-    store channel) even when the window raises mid-run."""
+    """Measured-window lifecycle: optional watch stressor and sampling
+    profiler for the whole window, and guaranteed teardown (stressor,
+    coordinator watches, store channel) even when the window raises
+    mid-run."""
     stress = (
         _start_watch_stress(
             args.target, args.stress_watchers, args.stress_write_concurrency
         )
         if args.stress_watchers else None
     )
+    prof = None
+    if args.profile:
+        from k8s1m_tpu.obs.profiler import SamplingProfiler
+
+        prof = SamplingProfiler().start()
+        coord.profiler = prof
     try:
         yield
     finally:
+        if prof is not None:
+            prof.stop()
+            prof.dump(args.profile)
+            print(prof.format_top(), file=sys.stderr)
         if stress is not None:
             stress.terminate()
             try:

@@ -273,6 +273,23 @@ def main(argv=None):
                 raise RuntimeError("a shard worker died mid-run")
             time.sleep(0.1)
         window = time.perf_counter() - t0
+        # The window closed at the last bind; workers post their final
+        # done:true status only after their idle countdown, so give them
+        # a moment — otherwise per_worker reports a stale done:false.
+        deadline = time.monotonic() + 10.0
+        while (not all(s.get("done") for s in stats.values())
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+            res = store.range(STATUS_PREFIX, prefix_end(STATUS_PREFIX))
+            for kv in res.kvs:
+                doc = json.loads(kv.value)
+                stats[doc["worker"]] = doc
+            if all(w.poll() is not None for w in workers):
+                # Every worker exited and the refresh above ran after
+                # that: a normal exit's done:true is in; a crashed
+                # worker's done:false surfaces in the report instead of
+                # spinning out the deadline.
+                break
     finally:
         for w in workers:
             if w.poll() is None:

@@ -385,6 +385,13 @@ constexpr size_t PREFACE_LEN = 24;
 const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 constexpr uint32_t OUR_INITIAL_WINDOW = (1u << 30);
 constexpr uint32_t CONN_WINDOW_TOPUP = (1u << 20);
+// Abuse guards: the port is a real TCP listener, so one misbehaving
+// client must not exhaust server memory.  A unary stream that never
+// half-closes is capped at 64 MiB of buffered request data (the repo's
+// own clients cap messages at 64 MB); an accumulated header block
+// (HEADERS + CONTINUATIONs) at 1 MiB.
+constexpr size_t MAX_STREAM_BUF = size_t(64) << 20;
+constexpr size_t MAX_HEADER_BLOCK = size_t(1) << 20;
 
 // grpc status codes used
 constexpr int G_OK = 0, G_INVALID = 3, G_NOT_FOUND_UNUSED = 5,
@@ -534,6 +541,7 @@ struct Stream {
   bool responded = false;    // we sent trailers
   Bytes data;                // request DATA bytes (grpc framed)
   size_t consumed = 0;       // parsed prefix of `data`
+  uint64_t recv_unacked = 0; // bytes received since last stream WINDOW_UPDATE
   int64_t send_window = 65535;
   std::unique_ptr<WatchStream> watch;
 };
@@ -1698,13 +1706,13 @@ void process_input(Conn& c, ServerState& sv) {
           uint32_t v = (uint32_t(pl[off + 2]) << 24) |
                        (uint32_t(pl[off + 3]) << 16) |
                        (uint32_t(pl[off + 4]) << 8) | uint32_t(pl[off + 5]);
-          if (id == 0x1) {  // HEADER_TABLE_SIZE
-            c.hpack.settings_max = v;
-            if (c.hpack.max_size > v) {
-              c.hpack.max_size = v;
-              c.hpack.evict();
-            }
-          } else if (id == 0x4) {  // INITIAL_WINDOW_SIZE
+          // 0x1 HEADER_TABLE_SIZE constrains the peer's (our) ENCODER
+          // (RFC 7540 §6.5.2) — and our encode side is stateless, so it
+          // is a no-op.  Our DECODER table stays governed by our own
+          // advertised default (4096); a client announcing a small table
+          // while legitimately encoding against our 4096 must not have
+          // its dynamic-table references rejected.
+          if (id == 0x4) {  // INITIAL_WINDOW_SIZE
             int64_t delta = int64_t(v) - c.peer_initial_window;
             c.peer_initial_window = int64_t(v);
             for (auto& kv : c.streams) kv.second->send_window += delta;
@@ -1766,6 +1774,10 @@ void process_input(Conn& c, ServerState& sv) {
       case F_CONT: {
         if (!c.cont_stream) { c.dead = true; return; }
         c.cont_block.append(reinterpret_cast<const char*>(pl), flen);
+        if (c.cont_block.size() > MAX_HEADER_BLOCK) {
+          c.dead = true;
+          return;
+        }
         if (flags & FLAG_END_HEADERS) {
           uint32_t s2 = c.cont_stream;
           uint8_t f2 = c.cont_flags;
@@ -1791,8 +1803,23 @@ void process_input(Conn& c, ServerState& sv) {
         if (it != c.streams.end()) {
           Stream& s = *it->second;
           s.data.append(reinterpret_cast<const char*>(q), n);
+          if (s.data.size() - s.consumed > MAX_STREAM_BUF) {
+            c.dead = true;
+            return;
+          }
           if (flags & FLAG_END_STREAM) s.end_stream = true;
+          s.recv_unacked += flen;
           process_stream_data(c, s, sv);
+          // Top up the STREAM receive window for long-lived bidi RPCs
+          // (Watch/LeaseKeepAlive): SETTINGS_INITIAL_WINDOW_SIZE gives
+          // each stream a one-time 2^30; without updates a conformant
+          // client stalls after ~1 GiB of cumulative request bytes.
+          if (s.recv_unacked >= CONN_WINDOW_TOPUP && !s.end_stream &&
+              !s.responded) {
+            frame_header(c.out, 4, F_WINUPD, 0, sid);
+            put_u32be(c.out, uint32_t(s.recv_unacked));
+            s.recv_unacked = 0;
+          }
         }
         // Top up the connection receive window.
         if (c.recv_unacked >= CONN_WINDOW_TOPUP) {

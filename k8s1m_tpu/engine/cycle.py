@@ -135,6 +135,39 @@ def _slice_table(table: NodeTable, start, chunk: int) -> NodeTable:
     )
 
 
+def topk_by_argmax(prio, k: int):
+    """``lax.top_k`` semantics (descending values, earlier index wins
+    ties) as k argmax passes.
+
+    The chunk scan only ever needs tiny k (4) over wide rows (the node
+    chunk): a full TopK sort is the wrong primitive — XLA CPU's TopK
+    custom-call runs ~200ns/element on [4096, 16384] int32 (13.4s per
+    wave!) where an argmax pass is ~2ns/element; the fused pallas kernel
+    already extracts its running top-k by repeated max for the same
+    reason (ops/pallas_topk.py).  k linear passes beat one sort on both
+    backends whenever k is small.
+    """
+    iota = lax.broadcasted_iota(jnp.int32, prio.shape, prio.ndim - 1)
+    lowest = (
+        jnp.iinfo(prio.dtype).min
+        if jnp.issubdtype(prio.dtype, jnp.integer) else -jnp.inf
+    )
+    vals, idxs = [], []
+    p = prio
+    for _ in range(k):
+        i = jnp.argmax(p, axis=-1).astype(jnp.int32)
+        # Values come from the ORIGINAL array (the knock-out sentinel
+        # must never surface), and duplicates extract in increasing
+        # index order — both exactly top_k's tie rule.
+        vals.append(jnp.take_along_axis(prio, i[..., None], axis=-1))
+        idxs.append(i[..., None])
+        p = jnp.where(iota == i[..., None], lowest, p)
+    return (
+        jnp.concatenate(vals, axis=-1),
+        jnp.concatenate(idxs, axis=-1),
+    )
+
+
 def merge_topk(a: Candidates, b: Candidates, k: int) -> Candidates:
     """Merge two candidate sets, keeping the k highest priorities."""
     prio = jnp.concatenate([a.prio, b.prio], axis=-1)
@@ -195,7 +228,7 @@ def filter_score_topk(
         )
         mask, score = score_and_filter(tchunk, batch, profile, cchunk, stats)
         prio = pack(score, jax.random.fold_in(key, ci), mask)   # [B, chunk]
-        top_prio, idx = lax.top_k(prio, k)                      # [B, k]
+        top_prio, idx = topk_by_argmax(prio, k)                 # [B, k]
         free_cpu, free_mem, free_pods = tchunk.free()
         local = Candidates(
             idx=(idx + start + row_offset).astype(jnp.int32),

@@ -1,8 +1,17 @@
 """/metrics HTTP endpoint (the reference serves one per component —
-mem_etcd's axum server on --metrics-port, reference main.rs:83-101)."""
+mem_etcd's axum server on --metrics-port, reference main.rs:83-101).
+
+``ssl_context`` + ``basic_auth`` reproduce the reference's exposure
+path: VM-level nginx reverse proxies terminate TLS and check basic-auth
+before the scrape reaches the component (reference
+terraform/k8s-server/server.tf:204-229).  Certs come from
+cluster/certs.py, the same chain the webhook uses.
+"""
 
 from __future__ import annotations
 
+import base64
+import hmac
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -10,16 +19,32 @@ from k8s1m_tpu.obs.metrics import REGISTRY
 
 
 def start_metrics_server(
-    port: int, host: str = "127.0.0.1", extra=None
+    port: int,
+    host: str = "127.0.0.1",
+    extra=None,
+    ssl_context=None,
+    basic_auth: tuple[str, str] | None = None,
 ) -> ThreadingHTTPServer:
     """Serve REGISTRY (plus an optional extra text producer) on /metrics.
 
     Runs in a daemon thread; returns the server (``.server_port`` for
     port=0 auto-assignment, ``.shutdown()`` to stop).
     """
+    expected = None
+    if basic_auth is not None:
+        expected = "Basic " + base64.b64encode(
+            f"{basic_auth[0]}:{basic_auth[1]}".encode()
+        ).decode()
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
+            if expected is not None and not hmac.compare_digest(
+                self.headers.get("Authorization", ""), expected
+            ):
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", "Basic realm=metrics")
+                self.end_headers()
+                return
             if self.path.rstrip("/") not in ("", "/metrics"):
                 self.send_response(404)
                 self.end_headers()
@@ -36,5 +61,10 @@ def start_metrics_server(
             pass
 
     server = ThreadingHTTPServer((host, port), Handler)
+    server.daemon_threads = True
+    if ssl_context is not None:
+        server.socket = ssl_context.wrap_socket(
+            server.socket, server_side=True
+        )
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server

@@ -189,6 +189,12 @@ def _start_watch_stress(target: str, watchers: int, write_concurrency: int):
 
 
 def main(argv=None):
+    from k8s1m_tpu.obs.profiler import install_signal_dump
+
+    # Always-on on-demand stack dump (SIGUSR2 -> /tmp/stacks-<pid>.txt),
+    # the py-spy-dump role: a long run that stops progressing can be
+    # interrogated without being killed.
+    install_signal_dump()
     args = parse_args(argv)
     if args.chunk is None:
         args.chunk = (1 << 12) if args.backend == "pallas" else (1 << 14)

@@ -223,7 +223,9 @@ class Histogram(Metric):
             return list(self._counts)
 
     def quantile(self, q: float, **labels) -> float:
-        """Approximate quantile from bucket upper bounds (test/bench helper)."""
+        """Approximate quantile, linearly interpolated within the bucket
+        (Prometheus histogram_quantile semantics) — edge-snapping made a
+        whole latency curve report one flat number per bucket."""
         key = self._key(labels)
         with self._lock:
             counts = list(self._counts.get(key, []))
@@ -233,9 +235,14 @@ class Histogram(Metric):
         target = q * total
         seen = 0
         for i, c in enumerate(counts):
+            if seen + c >= target:
+                if i >= len(self.buckets):
+                    return float("inf")
+                hi = self.buckets[i]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                frac = (target - seen) / c if c else 1.0
+                return lo + (hi - lo) * frac
             seen += c
-            if seen >= target:
-                return self.buckets[i] if i < len(self.buckets) else float("inf")
         return float("inf")
 
     def render(self) -> list[str]:

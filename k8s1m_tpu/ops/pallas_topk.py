@@ -74,7 +74,13 @@ from k8s1m_tpu.config import (
     TOPO_HOSTNAME,
     TOPO_ZONE,
 )
-from k8s1m_tpu.ops.priority import JITTER_BITS, MAX_SCORE
+from k8s1m_tpu.ops.priority import (
+    JITTER_BITS,
+    MAX_SCORE,
+    hash_jitter,
+    mix32,
+    seed_of as _priority_seed_of,
+)
 from k8s1m_tpu.plugins.registry import Profile
 from k8s1m_tpu.snapshot.node_table import NodeTable
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
@@ -109,46 +115,18 @@ def _check_slots(batch: PodBatch) -> None:
         )
 
 
-def _mix32(h):
-    """murmur3 finalizer in uint32 (wraps identically everywhere)."""
-    h = h ^ (h >> 16)
-    h = h * jnp.uint32(0x7FEB352D)
-    h = h ^ (h >> 15)
-    h = h * jnp.uint32(0x846CA68B)
-    h = h ^ (h >> 16)
-    return h
-
-
-def _hash_jitter(seed, row_ids, col_ids):
-    """Stateless uniform bits in [0, 2^JITTER_BITS) per (pod, node).
-
-    Separable construction: each axis is murmur3-finalized on its own
-    narrow shape ([TB, 1] rows, [1, C] cols) and the full-width work is
-    ONE xor + one mask — the XOR of two independently well-mixed values
-    is uniform, and integer ops reproduce bit-for-bit on every backend
-    (compiled TPU, Mosaic interpreter, numpy oracle), which is what the
-    tie-break parity tests pin.  The earlier form ran the whole 5-step
-    finalizer at [TB, C] width — ~10 extra full-width ops in the hottest
-    loop of the framework for no additional tie-break quality.
-
-    Known trade-off of separability: two pods' orderings over an equal-
-    score candidate set are XOR-translates of each other, i.e. tied
-    waves get correlated (not independent) tie-breaks.  Assignment runs
-    greedily with capacity re-checks, so correlated picks cost at most
-    extra conflict retries, never correctness.  If measured bind-conflict
-    rates on tied waves ever rise above the full-width baseline, the fix
-    is ONE extra full-width mixing step over (rh ^ ch) — e.g.
-    h ^= h >> 16; h *= 0x7FEB352D — not a revert to the 5-step form.
-    """
-    rh = _mix32(
-        seed.astype(jnp.uint32)
-        ^ (row_ids.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
-    )
-    ch = _mix32(
-        seed.astype(jnp.uint32)
-        ^ (col_ids.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
-    )
-    return ((rh ^ ch) & jnp.uint32((1 << JITTER_BITS) - 1)).astype(jnp.int32)
+# The separable hash lives in ops/priority.py now — it is shared by this
+# kernel, the XLA scan path (pack_hashed), and the numpy oracle, so every
+# backend produces IDENTICAL tie-breaks for the same wave.  The
+# correlated-tie trade-off note: two pods' orderings over an equal-score
+# candidate set are XOR-translates of each other, i.e. tied waves get
+# correlated (not independent) tie-breaks.  Assignment runs greedily with
+# capacity re-checks, so correlated picks cost at most extra conflict
+# retries, never correctness.  If measured bind-conflict rates on tied
+# waves ever rise above a full-width-hash baseline, the fix is ONE extra
+# full-width mixing step over (rh ^ ch), not a revert.
+_mix32 = mix32
+_hash_jitter = hash_jitter
 
 
 def _kernel(
@@ -889,9 +867,9 @@ def fused_topk(
     )
 
 
-def seed_of(key: jax.Array) -> jax.Array:
-    """Derive an i32 kernel seed from a jax PRNG key (host or traced)."""
-    return jax.random.randint(key, (), -(1 << 31), (1 << 31) - 1, jnp.int32)
+# Shared with the XLA path (ops/priority.py) so both backends derive the
+# same per-wave seed from the same key.
+seed_of = _priority_seed_of
 
 
 def pallas_candidates(

@@ -144,8 +144,10 @@ def test_candidates_drop_in(rng):
 
 
 def test_schedule_batch_backend_parity(rng):
-    """End-to-end schedule_batch agrees across backends on placements'
-    scores (jitter differs, so exact node choice may differ on ties)."""
+    """End-to-end schedule_batch is BIT-IDENTICAL across backends: both
+    derive tie-break jitter from the same separable hash over
+    (seed_of(key), pod row, node column) — ops/priority.hash_jitter —
+    so ties resolve to the same node, not just the same score."""
     spec, host = build(rng)
     batch = pods(host, spec, tolerate=True)
     t1 = host.to_device()
@@ -158,9 +160,12 @@ def test_schedule_batch_backend_parity(rng):
         t2, batch, key, profile=BASE, chunk=CHUNK, k=4, backend="pallas"
     )
     np.testing.assert_array_equal(np.asarray(asg_x.bound), np.asarray(asg_p.bound))
-    # Same greedy order over the same candidate scores -> same final score.
     np.testing.assert_array_equal(
         np.asarray(asg_x.score), np.asarray(asg_p.score)
+    )
+    # The strong form: identical placements, tie-breaks included.
+    np.testing.assert_array_equal(
+        np.asarray(asg_x.node_row), np.asarray(asg_p.node_row)
     )
 
 

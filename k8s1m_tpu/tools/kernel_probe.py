@@ -53,6 +53,13 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--only", default=None,
                     help="comma-separated variant names (default: all)")
+    ap.add_argument(
+        "--backend", choices=("pallas", "xla"), default="pallas",
+        help="pallas times the fused kernel; xla times the scan path's "
+        "filter_score_topk with the same plugin-knockout variants "
+        "(engine/cycle.py) — the decomposition tool for whichever "
+        "backend is under investigation",
+    )
     args = ap.parse_args(argv)
 
     spec = TableSpec(max_nodes=args.nodes)
@@ -62,26 +69,50 @@ def main(argv=None):
     enc = PodBatchHost(PodSpec(batch=args.batch), spec, host.vocab)
     batch = enc.encode(uniform_pods(args.batch))
 
+    if args.backend == "xla":
+        import functools
+
+        from k8s1m_tpu.engine.cycle import filter_score_topk
+
+        def run_xla(prof, key):
+            fn = jax.jit(functools.partial(
+                filter_score_topk, profile=prof,
+                chunk=args.chunk, k=args.k,
+            ))
+            cand = fn(table, batch, key)
+            return cand.idx
+
     picked = variants()
     if args.only:
         names = {n.strip() for n in args.only.split(",")}
         picked = {n: p for n, p in picked.items() if n in names}
     for name, prof in picked.items():
-        idx, _ = fused_topk(
-            table, batch, jnp.int32(0), prof,
-            chunk=args.chunk, k=args.k, with_affinity=False,
-        )
-        jax.device_get(idx)      # compile + settle
-        t0 = time.perf_counter()
-        for i in range(args.steps):
+        if args.backend == "xla":
+            keys = list(jax.random.split(jax.random.key(0), args.steps + 1))
+            idx = run_xla(prof, keys[0])
+            jax.device_get(idx)  # compile + settle
+            t0 = time.perf_counter()
+            for i in range(args.steps):
+                idx = run_xla(prof, keys[i + 1])
+            jax.device_get(idx)
+        else:
             idx, _ = fused_topk(
-                table, batch, jnp.int32(i + 1), prof,
+                table, batch, jnp.int32(0), prof,
                 chunk=args.chunk, k=args.k, with_affinity=False,
             )
-        jax.device_get(idx)      # the relay needs a fetch, not block_until_ready
+            jax.device_get(idx)      # compile + settle
+            t0 = time.perf_counter()
+            for i in range(args.steps):
+                idx, _ = fused_topk(
+                    table, batch, jnp.int32(i + 1), prof,
+                    chunk=args.chunk, k=args.k, with_affinity=False,
+                )
+            # the relay needs a fetch, not block_until_ready
+            jax.device_get(idx)
         dt = (time.perf_counter() - t0) / args.steps
         print(json.dumps({
             "variant": name,
+            "backend": args.backend,
             "ms_per_batch": round(dt * 1e3, 2),
             "binds_per_sec_equiv": round(args.batch / dt, 1),
             "nodes": args.nodes,

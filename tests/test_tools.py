@@ -235,3 +235,9 @@ def test_kernel_probe_runs(capsys):
     line = capsys.readouterr().out.strip().splitlines()[-1]
     out = _json.loads(line)
     assert out["variant"] == "filter-only" and out["ms_per_batch"] > 0
+
+    # The XLA scan-path mode decomposes the other backend the same way.
+    main(["--nodes", "256", "--batch", "32", "--chunk", "128",
+          "--steps", "1", "--only", "full", "--backend", "xla"])
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["backend"] == "xla" and out["ms_per_batch"] > 0

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 
+import grpc
 from grpc import aio
 
 from k8s1m_tpu.store.native import pack_bind_frame, pack_put_frame, prefix_end
@@ -29,13 +30,41 @@ class WatchBatch:
     canceled: bool = False
 
 
+def secure_channel_for(
+    target: str,
+    ca_pem: str,
+    token: str | None = None,
+    options: list[tuple[str, int | str]] | None = None,
+    _aio: bool = True,
+):
+    """A TLS channel trusting only ``ca_pem`` (the rig CA,
+    cluster/certs.py), optionally attaching ``authorization: Bearer
+    <token>`` call credentials — the client half of the tier's
+    apiserver-style TLS + bearer auth."""
+    with open(ca_pem, "rb") as f:
+        creds = grpc.ssl_channel_credentials(root_certificates=f.read())
+    if token is not None:
+        creds = grpc.composite_channel_credentials(
+            creds, grpc.access_token_call_credentials(token)
+        )
+    mk = aio.secure_channel if _aio else grpc.secure_channel
+    return mk(target, creds, options=options)
+
+
 class EtcdClient:
     def __init__(
         self,
         target: str,
         channel: aio.Channel | None = None,
         options: list[tuple[str, int | str]] | None = None,
+        *,
+        ca_pem: str | None = None,
+        token: str | None = None,
     ):
+        if channel is None and ca_pem is not None:
+            channel = secure_channel_for(
+                target, ca_pem, token, options=options
+            )
         self.channel = channel or aio.insecure_channel(target, options=options)
         c = self.channel
         pb = rpc_pb2

@@ -207,17 +207,30 @@ class RemoteWatcher:
 class RemoteStore:
     """Blocking etcd v3 client exposing the MemStore surface."""
 
-    def __init__(self, target: str, channel: grpc.Channel | None = None):
+    def __init__(
+        self,
+        target: str,
+        channel: grpc.Channel | None = None,
+        *,
+        ca_pem: str | None = None,
+        token: str | None = None,
+    ):
+        options = [
+            # Match the servers' 64MB caps (etcd_server/watch_cache);
+            # the default 4MB rejects a ~12K-object list response.
+            # Large lists should still paginate (native.list_prefix)
+            # — this is headroom, not an invitation.
+            ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+            ("grpc.max_send_message_length", 64 * 1024 * 1024),
+        ]
+        if channel is None and ca_pem is not None:
+            from k8s1m_tpu.store.etcd_client import secure_channel_for
+
+            channel = secure_channel_for(
+                target, ca_pem, token, options=options, _aio=False
+            )
         self.channel = channel or grpc.insecure_channel(
-            target,
-            options=[
-                # Match the servers' 64MB caps (etcd_server/watch_cache);
-                # the default 4MB rejects a ~12K-object list response.
-                # Large lists should still paginate (native.list_prefix)
-                # — this is headroom, not an invitation.
-                ("grpc.max_receive_message_length", 64 * 1024 * 1024),
-                ("grpc.max_send_message_length", 64 * 1024 * 1024),
-            ],
+            target, options=options
         )
         c = self.channel
         pb = rpc_pb2

@@ -825,6 +825,50 @@ class WatchCacheTier:
         await self.server.stop(None)
 
 
+class _BearerAuth(aio.ServerInterceptor):
+    """Reject every RPC without the expected ``authorization`` metadata.
+
+    The closest honest analogue of the apiserver's client auth for an
+    etcd-wire tier: Kubernetes clients authenticate to the apiserver
+    with TLS + bearer tokens; here the tier (the apiserver stand-in)
+    requires ``authorization: Bearer <token>`` on every call.
+    """
+
+    def __init__(self, token: str):
+        self._expect = f"Bearer {token}"
+
+        async def _deny_unary(request, context):
+            await context.abort(
+                grpc.StatusCode.UNAUTHENTICATED,
+                "invalid or missing bearer token",
+            )
+
+        async def _deny_stream(request_iterator, context):
+            await context.abort(
+                grpc.StatusCode.UNAUTHENTICATED,
+                "invalid or missing bearer token",
+            )
+            yield  # pragma: no cover - abort never returns
+
+        self._deny_unary = _deny_unary
+        self._deny_stream = _deny_stream
+
+    async def intercept_service(self, continuation, details):
+        md = dict(details.invocation_metadata or ())
+        handler = await continuation(details)
+        if md.get("authorization") == self._expect or handler is None:
+            return handler
+        # Mirror the real handler's cardinality so the deny travels the
+        # right stub path on the client.
+        if handler.unary_unary:
+            return grpc.unary_unary_rpc_method_handler(self._deny_unary)
+        if handler.unary_stream:
+            return grpc.unary_stream_rpc_method_handler(self._deny_stream)
+        if handler.stream_unary:
+            return grpc.stream_unary_rpc_method_handler(self._deny_unary)
+        return grpc.stream_stream_rpc_method_handler(self._deny_stream)
+
+
 async def serve_watch_cache(
     upstream_target: str,
     prefixes: list[bytes],
@@ -832,9 +876,17 @@ async def serve_watch_cache(
     host: str = "127.0.0.1",
     index: str = "hash",
     window: int = _DEFAULT_WINDOW,
+    tls=None,
+    auth_token: str | None = None,
 ) -> WatchCacheTier:
     """Start the tier: one upstream watch per prefix, etcd wire served on
-    ``port``."""
+    ``port``.
+
+    ``tls`` (a cluster.certs.CertPaths) serves the wire over TLS with
+    the rig chain; ``auth_token`` additionally requires a bearer token
+    on every RPC — together the client-facing posture of the apiserver
+    the tier stands in for (the reference's k3s serves TLS and
+    authenticates clients; its plaintext side faces only mem_etcd)."""
     cache = WatchCache(index=index, window=window)
     upstream = EtcdClient(upstream_target)
     handles = [UpstreamHandle(p) for p in prefixes]
@@ -852,7 +904,10 @@ async def serve_watch_cache(
             ("grpc.max_concurrent_streams", 100),
             ("grpc.max_receive_message_length", 64 * 1024 * 1024),
             ("grpc.max_send_message_length", 64 * 1024 * 1024),
-        ]
+        ],
+        interceptors=(
+            (_BearerAuth(auth_token),) if auth_token is not None else ()
+        ),
     )
     from k8s1m_tpu.store.proto import batch_pb2
 
@@ -913,7 +968,15 @@ async def serve_watch_cache(
     try:
         for e in primed_events:
             await e.wait()
-        bound = server.add_insecure_port(f"{host}:{port}")
+        if tls is not None:
+            with open(tls.key_pem, "rb") as f:
+                key = f.read()
+            with open(tls.cert_pem, "rb") as f:
+                cert = f.read()
+            creds = grpc.ssl_server_credentials([(key, cert)])
+            bound = server.add_secure_port(f"{host}:{port}", creds)
+        else:
+            bound = server.add_insecure_port(f"{host}:{port}")
         if bound == 0:
             raise OSError(f"failed to bind {host}:{port}")
         await server.start()
@@ -943,13 +1006,29 @@ def main(argv=None) -> None:
                          "BtreeWatchCache experiment axis)")
     ap.add_argument("--window", type=int, default=_DEFAULT_WINDOW)
     ap.add_argument("--metrics-port", type=int, default=0)
+    ap.add_argument("--tls-cert", default=None,
+                    help="serve TLS: path to the server cert PEM")
+    ap.add_argument("--tls-key", default=None,
+                    help="serve TLS: path to the server key PEM")
+    ap.add_argument("--auth-token", default=None,
+                    help="require 'authorization: Bearer <token>' on "
+                    "every RPC (the apiserver client-auth role)")
     args = ap.parse_args(argv)
     prefixes = [p.encode() for p in (args.prefix or ["/registry/"])]
+    tls = None
+    if bool(args.tls_cert) != bool(args.tls_key):
+        ap.error("--tls-cert and --tls-key must be passed together")
+    if args.tls_cert:
+        from k8s1m_tpu.cluster.certs import CertPaths
+
+        tls = CertPaths(ca_pem="", cert_pem=args.tls_cert,
+                        key_pem=args.tls_key)
 
     async def run():
         tier = await serve_watch_cache(
             args.upstream, prefixes, port=args.port, host=args.host,
             index=args.index, window=args.window,
+            tls=tls, auth_token=args.auth_token,
         )
         if args.metrics_port:
             from k8s1m_tpu.obs.http import start_metrics_server

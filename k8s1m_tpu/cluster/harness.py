@@ -86,6 +86,11 @@ class ClusterSpec:
     # (cluster/certs.py — the reference's terraform-provisioned webhook
     # TLS, dist-scheduler.tf:713-740, webhook.go:33-35).
     webhook_tls: bool = False
+    # Secure the watch-cache tier like the apiserver it stands in for:
+    # rig-CA TLS + bearer-token auth on every RPC; the KWOK/kubelet
+    # consumers behind the tier connect with the CA + token.  Requires
+    # watch_cache=True.
+    tier_tls: bool = False
     table: TableSpec | None = None
     pod_batch: int = 256
     profile: Profile = dataclasses.field(
@@ -103,6 +108,8 @@ class ClusterSpec:
                 f"watch_cache_index must be hash|btree, "
                 f"got {self.watch_cache_index!r}"
             )
+        if self.tier_tls and not self.watch_cache:
+            raise ValueError("tier_tls requires watch_cache=True")
 
     def table_spec(self) -> TableSpec:
         if self.table is not None:
@@ -175,15 +182,37 @@ class Cluster:
         atexit.register(self.shutdown)
         wait_for_port(self.port, proc=self._server)
 
+        # Rig TLS chain, shared by whichever endpoints are secured
+        # (webhook https intake, tier wire) — the terraform-provisioned
+        # cert chain role (cluster/certs.py).
+        self.certs = None
+        self.tier_token: str | None = None
+        if spec.webhook_tls or spec.tier_tls:
+            from k8s1m_tpu.cluster.certs import provision
+
+            self.certs = provision(f"{self.wal_dir}/certs")
+
         if spec.watch_cache:
             self.tier_port = _free_port()
-            self._tier = subprocess.Popen([
+            tier_cmd = [
                 sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
                 "--upstream", f"127.0.0.1:{self.port}",
                 "--host", "127.0.0.1", "--port", str(self.tier_port),
                 "--prefix", "/registry/",
                 "--index", spec.watch_cache_index,
-            ], stderr=self._ship("tier"))
+            ]
+            if spec.tier_tls:
+                import secrets
+
+                self.tier_token = secrets.token_hex(16)
+                tier_cmd += [
+                    "--tls-cert", self.certs.cert_pem,
+                    "--tls-key", self.certs.key_pem,
+                    "--auth-token", self.tier_token,
+                ]
+            self._tier = subprocess.Popen(
+                tier_cmd, stderr=self._ship("tier")
+            )
             # Port bind happens after cache priming (watch_cache.py), so
             # this doubles as the primed signal.  Priming walks the whole
             # store, so the wait must scale with it (1M nodes would blow
@@ -241,13 +270,9 @@ class Cluster:
             KwokController(self._kwok_client(), group=g)
             for g in range(spec.kwok_groups)
         ]
-        self.certs = None
-        ssl_context = None
-        if spec.webhook_tls:
-            from k8s1m_tpu.cluster.certs import provision
-
-            self.certs = provision(f"{self.wal_dir}/certs")
-            ssl_context = self.certs.server_context()
+        ssl_context = (
+            self.certs.server_context() if spec.webhook_tls else None
+        )
         self.webhook = WebhookServer(
             self._webhook_sink, ssl_context=ssl_context
         ).start()
@@ -258,15 +283,25 @@ class Cluster:
 
     # ---- plumbing ------------------------------------------------------
 
-    def _client(self, port: int | None = None) -> RemoteStore:
-        c = RemoteStore(f"127.0.0.1:{port if port is not None else self.port}")
+    def _client(
+        self, port: int | None = None, *, secure: bool = False
+    ) -> RemoteStore:
+        c = RemoteStore(
+            f"127.0.0.1:{port if port is not None else self.port}",
+            ca_pem=self.certs.ca_pem if secure else None,
+            token=self.tier_token if secure else None,
+        )
         self._clients.append(c)
         return c
 
     def _kwok_client(self) -> RemoteStore:
         """Node-simulation consumers connect through the watch-cache tier
-        when deployed (the kubelet→apiserver edge); else to the store."""
-        return self._client(self.tier_port)
+        when deployed (the kubelet→apiserver edge); else to the store.
+        With ``tier_tls`` they authenticate like kubelets to an
+        apiserver: rig-CA TLS + bearer token."""
+        return self._client(
+            self.tier_port, secure=self.spec.tier_tls
+        )
 
     def _webhook_sink(self, obj: dict) -> None:
         if self.shard_members:
@@ -356,7 +391,9 @@ class Cluster:
         store = self._clients[0]
         # Invariant across the loop; building it per request would charge
         # N cert parses to the measured window.
-        tls_ctx = self.certs.client_context() if self.certs else None
+        tls_ctx = (
+            self.certs.client_context() if self.spec.webhook_tls else None
+        )
         t0 = time.perf_counter()
         for i in range(count):
             pod = encode_pod(
@@ -372,7 +409,7 @@ class Cluster:
                 }
                 # Chain-verified when TLS is on: the client trusts only
                 # the rig CA and checks the cert's 127.0.0.1 IP SAN.
-                scheme = "https" if self.certs else "http"
+                scheme = "https" if tls_ctx is not None else "http"
                 req = urllib.request.Request(
                     f"{scheme}://127.0.0.1:{self.webhook.port}/validate",
                     data=json.dumps(review).encode(),

@@ -225,6 +225,37 @@ def test_cluster_behind_watch_cache_tier():
             assert obj["status"]["phase"] == "Running"
 
 
+def test_cluster_behind_secured_tier():
+    """The tier serves TLS + bearer auth (the apiserver's client-facing
+    posture); KWOK controllers authenticate with the rig CA + token and
+    the whole experiment still completes.  An unauthenticated client at
+    the same port is refused."""
+    import grpc
+
+    from k8s1m_tpu.store.remote import RemoteStore
+
+    spec = ClusterSpec(
+        nodes=32, kwok_groups=2, coordinators=1, pod_batch=16, chunk=64,
+        wal_mode="none", watch_cache=True, tier_tls=True,
+    )
+    with Cluster(spec) as c:
+        assert c.tier_token is not None
+        c.make_nodes()
+        stats = c.run_pods(12, max_ticks=60)
+        assert stats["bound"] == 12
+        assert stats["running"] == 12
+        # TLS but no token -> UNAUTHENTICATED at the tier.
+        bare = RemoteStore(
+            f"127.0.0.1:{c.tier_port}", ca_pem=c.certs.ca_pem
+        )
+        try:
+            with pytest.raises(grpc.RpcError) as ei:
+                bare.get(b"/registry/pods/x")
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        finally:
+            bare.close()
+
+
 def test_shard_set_behind_watch_cache_tier():
     """The fullest topology: N scheduler shards + the apiserver tier in
     one cluster — shards split the pod stream, KWOK runs behind the

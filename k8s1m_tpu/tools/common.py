@@ -118,6 +118,12 @@ def add_common_args(ap):
     ap.add_argument("--concurrency", type=int, default=100)
     ap.add_argument("--clients", type=int, default=4, help="separate gRPC channels")
     ap.add_argument("--quiet", action="store_true")
+    # Secured-tier targets (store/watch_cache.py --tls-cert/--auth-token):
+    # the generators authenticate like any other apiserver client.
+    ap.add_argument("--ca-pem", default=None,
+                    help="TLS: trust this CA for --target (rig chain)")
+    ap.add_argument("--token", default=None,
+                    help="bearer token sent as authorization metadata")
 
 
 def client_factory(args):
@@ -128,5 +134,7 @@ def client_factory(args):
     # max_concurrent_streams=100 (RST_STREAM REFUSED_STREAM) under load —
     # the same reason the reference shards across 10-12 clientsets.
     return lambda: EtcdClient(
-        args.target, options=[("grpc.use_local_subchannel_pool", 1)]
+        args.target, options=[("grpc.use_local_subchannel_pool", 1)],
+        ca_pem=getattr(args, "ca_pem", None),
+        token=getattr(args, "token", None),
     )

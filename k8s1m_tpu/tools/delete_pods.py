@@ -29,7 +29,7 @@ def parse_args(argv=None):
 
 
 async def amain(args) -> dict:
-    lister = EtcdClient(args.target)
+    lister = EtcdClient(args.target, ca_pem=getattr(args, 'ca_pem', None), token=getattr(args, 'token', None))
     key_prefix = f"/registry/pods/{args.namespace}/{args.prefix}".encode()
     resp = await lister.range(key_prefix, prefix_end(key_prefix), keys_only=True)
     keys = [kv.key for kv in resp.kvs]

@@ -31,7 +31,7 @@ def parse_args(argv=None):
 
 async def amain(args) -> dict:
     # Every watcher sees every write: total deliveries = watchers x writes.
-    watch_client = EtcdClient(args.target)
+    watch_client = EtcdClient(args.target, ca_pem=getattr(args, 'ca_pem', None), token=getattr(args, 'token', None))
     sessions = []
     for _ in range(args.watchers):
         s = watch_client.watch(PREFIX, prefix_end(PREFIX))
@@ -60,7 +60,7 @@ async def amain(args) -> dict:
 
     drainers = [asyncio.create_task(drain(s)) for s in sessions]
 
-    write_client = EtcdClient(args.target)
+    write_client = EtcdClient(args.target, ca_pem=getattr(args, 'ca_pem', None), token=getattr(args, 'token', None))
     t0 = time.perf_counter()
 
     async def writer(wid: int):

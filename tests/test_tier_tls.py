@@ -110,6 +110,21 @@ def test_plaintext_client_rejected(env):
     loop.run_until_complete(go())
 
 
+def test_load_generator_against_secured_tier(env):
+    """The load generators (tools/) authenticate like any apiserver
+    client: --ca-pem/--token flags thread through client_factory."""
+    loop, certs, tier, _ = env
+    from k8s1m_tpu.tools import make_nodes
+
+    args = make_nodes.parse_args([
+        "--target", f"127.0.0.1:{tier.port}", "--count", "8", "--quiet",
+        "--concurrency", "4", "--clients", "1",
+        "--ca-pem", certs.ca_pem, "--token", TOKEN,
+    ])
+    out = loop.run_until_complete(make_nodes.amain(args))
+    assert out["count"] == 8 and out["errors"] == 0
+
+
 def test_sync_remote_store_over_tls(env):
     loop, certs, tier, _ = env
 

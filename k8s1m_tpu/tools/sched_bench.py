@@ -160,12 +160,36 @@ def _bench_window(args, coord, store):
             store.close()
 
 
-def _bound_keys(coord, key_strs, lo, hi):
-    """Keys in [lo, hi) whose pods the coordinator has actually bound —
-    churn must only delete bound pods (bind order diverges from key
-    order whenever pods retry, so a bound-count prefix is not enough)."""
-    bound = coord._bound
-    return [i for i in range(lo, hi) if key_strs[i] in bound]
+class _ChurnFrontier:
+    """Tracks which emitted pods are safe to delete.
+
+    Churn must only delete BOUND pods (bind order diverges from key
+    order whenever pods retry), but a pod that binds *after* the delete
+    frontier sweeps past must still be deleted later — otherwise any
+    bind lag (retries, a backed-up run, a slow device) silently turns
+    the sustained create+delete shape back into a fill-up.  Skipped
+    indices stay pending and are retried on every advance.
+    """
+
+    def __init__(self, coord, key_strs, start: int = 1):
+        self._coord = coord
+        self._key_strs = key_strs
+        self._at = start
+        self._pending: list[int] = []
+
+    def advance(self, frontier: int) -> list[int]:
+        """Bound indices in [previous, frontier) plus previously-skipped
+        ones that have bound since; the rest stay pending."""
+        if frontier > self._at:
+            self._pending.extend(range(self._at, frontier))
+            self._at = frontier
+        bound = self._coord._bound
+        ks = self._key_strs
+        dels = [i for i in self._pending if ks[i] in bound]
+        if dels:
+            hit = set(dels)
+            self._pending = [i for i in self._pending if i not in hit]
+        return dels
 
 
 def _start_watch_stress(target: str, watchers: int, write_concurrency: int):
@@ -294,7 +318,7 @@ def main(argv=None):
         t0 = time.perf_counter()
         bound = 0
         emitted = 1
-        frontier_at = 1
+        churn = _ChurnFrontier(coord, key_strs)
         deleted = 0
         with _bench_window(args, coord, store):
             while emitted < args.pods or coord.queue or coord._inflights:
@@ -306,14 +330,10 @@ def main(argv=None):
                         store, list(zip(keys[emitted:due], values[emitted:due]))
                     )
                     emitted = due
-                    frontier = emitted - lag
-                    if args.churn and frontier > frontier_at:
-                        dels = _bound_keys(
-                            coord, key_strs, frontier_at, frontier
-                        )
+                    if args.churn:
+                        dels = churn.advance(emitted - lag)
                         write_wave(store, [(keys[i], None) for i in dels])
                         deleted += len(dels)
-                        frontier_at = frontier
                 bound += coord.step()
                 if (
                     emitted >= args.pods
@@ -355,7 +375,7 @@ def main(argv=None):
     bound = 0
     off = 1
     deleted = 0
-    frontier_at = 1
+    churn = _ChurnFrontier(coord, key_strs)
     with _bench_window(args, coord, store):
         while off < args.pods:
             write_wave(
@@ -364,14 +384,11 @@ def main(argv=None):
             if args.churn:
                 # Delete BOUND pods behind the emission lag — the
                 # scheduler keeps binding into capacity that deletions
-                # keep freeing; pods still pending (retries, a backed-up
-                # run under --stress-watchers) are skipped, not deleted.
-                frontier = off - 2 * wave
-                if frontier > frontier_at:
-                    dels = _bound_keys(coord, key_strs, frontier_at, frontier)
-                    write_wave(store, [(keys[i], None) for i in dels])
-                    deleted += len(dels)
-                    frontier_at = frontier
+                # keep freeing; pods not yet bound stay pending in the
+                # frontier and are deleted once they bind.
+                dels = churn.advance(off - 2 * wave)
+                write_wave(store, [(keys[i], None) for i in dels])
+                deleted += len(dels)
             off += wave
             bound += coord.step()
         bound += coord.run_until_idle()

@@ -47,6 +47,25 @@ def test_shard_bench_smoke_two_workers_disjoint_and_done():
     assert [w["bound"] for w in workers] == out["pod_share"]
 
 
+def test_sched_bench_churn_deletes_late_binders():
+    """Config-5 shape: the delete frontier must also claim pods that
+    bound AFTER it swept past (the pending set in _ChurnFrontier) —
+    sustained create+delete, not a fill-up."""
+    out = _run(
+        [
+            sys.executable, "-m", "k8s1m_tpu.tools.sched_bench",
+            "--nodes", "4096", "--pods", "1500", "--batch", "256",
+            "--chunk", "1024", "--score-pct", "100", "--backend", "xla",
+            "--churn",
+        ],
+        timeout=420,
+    )
+    det = out["detail"]
+    assert det["bound"] >= 1498
+    # Everything older than the 2-wave emission lag got deleted.
+    assert det["deleted"] >= 1500 - 3 * 256, det
+
+
 def test_watch_scale_smoke_mux_and_fanout():
     idle, active, writes = 600, 80, 400
     out = _run(

@@ -1,0 +1,189 @@
+"""Raw-wire flow-control and abuse-guard behavior of the native front-end.
+
+These pin the round-4 hardening with a hand-rolled h2 client (no grpc):
+
+- per-stream WINDOW_UPDATE top-ups on long-lived bidi RPCs (without
+  them a conformant client stalls after ~1 GiB on one stream);
+- the accumulated header-block cap (HEADERS + endless CONTINUATION is
+  a memory-exhaustion vector — the server must kill the connection);
+- a client announcing SETTINGS_HEADER_TABLE_SIZE must NOT perturb the
+  server's HPACK decoder (RFC 7540 §6.5.2: that setting constrains the
+  peer's encoder; the server's encode side is stateless).
+"""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from k8s1m_tpu.store.native import MemStore, WireFront
+from k8s1m_tpu.store.proto import rpc_pb2
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+F_DATA, F_HEADERS, F_SETTINGS, F_WINUPD, F_CONT = 0, 1, 4, 8, 9
+END_STREAM, END_HEADERS = 0x1, 0x4
+
+
+def frame(ftype: int, flags: int, sid: int, payload: bytes) -> bytes:
+    n = len(payload)
+    return (
+        bytes([(n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF, ftype, flags])
+        + struct.pack(">I", sid & 0x7FFFFFFF)
+        + payload
+    )
+
+
+def _raw_str(s: bytes) -> bytes:
+    out = b""
+    n = len(s)
+    if n < 127:
+        out += bytes([n])
+    else:
+        out += bytes([127])
+        n -= 127
+        while n >= 128:
+            out += bytes([(n & 0x7F) | 0x80])
+            n >>= 7
+        out += bytes([n])
+    return out + s
+
+
+def headers_block(path: bytes) -> bytes:
+    """Stateless HPACK request block like the in-tree C++ client's."""
+    b = bytes([0x80 | 3])            # :method POST (static 3)
+    b += bytes([0x80 | 6])           # :scheme http (static 6)
+    b += bytes([0x04]) + _raw_str(path)       # :path literal, name idx 4
+    b += bytes([0x01]) + _raw_str(b"memstore")  # :authority, name idx 1
+    b += bytes([0x00]) + _raw_str(b"content-type") + _raw_str(
+        b"application/grpc"
+    )
+    b += bytes([0x00]) + _raw_str(b"te") + _raw_str(b"trailers")
+    return b
+
+
+def grpc_msg(pb: bytes) -> bytes:
+    return b"\x00" + struct.pack(">I", len(pb)) + pb
+
+
+def connect(port: int, settings_payload: bytes = b"") -> socket.socket:
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.settimeout(10)
+    s.sendall(
+        PREFACE
+        + frame(F_SETTINGS, 0, 0, settings_payload)
+        + frame(F_WINUPD, 0, 0, struct.pack(">I", (1 << 30) - 65535))
+    )
+    return s
+
+
+class FrameReader:
+    def __init__(self, sock):
+        self.sock = sock
+        self.buf = b""
+        self.eof = False
+
+    def poll(self) -> list[tuple[int, int, int, bytes]]:
+        """(type, flags, sid, payload) for every complete frame buffered."""
+        try:
+            data = self.sock.recv(1 << 18)
+            if not data:
+                self.eof = True
+            self.buf += data
+        except socket.timeout:
+            pass
+        except OSError:
+            self.eof = True
+        out = []
+        while len(self.buf) >= 9:
+            n = (self.buf[0] << 16) | (self.buf[1] << 8) | self.buf[2]
+            if len(self.buf) < 9 + n:
+                break
+            ftype, flags = self.buf[3], self.buf[4]
+            sid = struct.unpack(">I", self.buf[5:9])[0] & 0x7FFFFFFF
+            out.append((ftype, flags, sid, self.buf[9:9 + n]))
+            self.buf = self.buf[9 + n:]
+        return out
+
+
+@pytest.fixture()
+def wire():
+    with MemStore() as store:
+        with WireFront(store) as wf:
+            yield wf
+
+
+def test_stream_window_update_on_long_bidi(wire):
+    """>1 MiB of request DATA on ONE Watch stream earns a stream-level
+    WINDOW_UPDATE (not just the connection-level one)."""
+    s = connect(wire.port)
+    s.sendall(frame(F_HEADERS, END_HEADERS, 1,
+                    headers_block(b"/etcdserverpb.Watch/Watch")))
+    # Each create watches a distinct fat key; ~48 x 32KiB > 1.5 MiB.
+    reader = FrameReader(s)
+    sent = 0
+    for i in range(48):
+        req = rpc_pb2.WatchRequest(
+            create_request=rpc_pb2.WatchCreateRequest(
+                key=b"/registry/fat/%04d/" % i + b"k" * (32 << 10)
+            )
+        ).SerializeToString()
+        payload = grpc_msg(req)
+        s.sendall(frame(F_DATA, 0, 1, payload))
+        sent += len(payload)
+    assert sent > (1 << 20)
+    stream_updates = []
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not stream_updates:
+        for ftype, _fl, sid, _pl in reader.poll():
+            if ftype == F_WINUPD and sid == 1:
+                stream_updates.append(sid)
+        if reader.eof:
+            break
+    assert stream_updates, "no stream-level WINDOW_UPDATE for stream 1"
+    s.close()
+
+
+def test_header_block_cap_kills_connection(wire):
+    """HEADERS + CONTINUATION accumulating past the cap must kill the
+    connection, not the memory."""
+    s = connect(wire.port)
+    # Start a header block and never finish it.
+    s.sendall(frame(F_HEADERS, 0, 1, b"\x00" * 16384))
+    killed = False
+    try:
+        for _ in range(200):  # ~3 MiB of CONTINUATION
+            s.sendall(frame(F_CONT, 0, 1, b"\x00" * 16384))
+    except OSError:
+        killed = True  # server closed mid-send (RST on write)
+    if not killed:
+        reader = FrameReader(s)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not reader.eof:
+            reader.poll()
+        killed = reader.eof
+    assert killed, "connection survived an unbounded header block"
+    s.close()
+
+
+def test_client_header_table_size_setting_is_ignored(wire):
+    """A client announcing a tiny HEADER_TABLE_SIZE still gets served:
+    the setting constrains the SERVER's encoder (which is stateless),
+    never the server's decoder (RFC 7540 §6.5.2)."""
+    # SETTINGS_HEADER_TABLE_SIZE (0x1) = 0.
+    s = connect(wire.port, settings_payload=struct.pack(">HI", 0x1, 0))
+    s.sendall(frame(F_HEADERS, END_HEADERS, 1,
+                    headers_block(b"/etcdserverpb.KV/Put")))
+    pb = rpc_pb2.PutRequest(
+        key=b"/registry/pods/ns/hts", value=b"v"
+    ).SerializeToString()
+    s.sendall(frame(F_DATA, END_STREAM, 1, grpc_msg(pb)))
+    reader = FrameReader(s)
+    got_response = False
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not reader.eof and not got_response:
+        for ftype, _fl, sid, _pl in reader.poll():
+            if ftype == F_HEADERS and sid == 1:
+                got_response = True
+    assert got_response, "Put on a conn announcing HEADER_TABLE_SIZE died"
+    s.close()

@@ -73,14 +73,32 @@ def main(argv=None):
         import functools
 
         from k8s1m_tpu.engine.cycle import filter_score_topk
+        from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
+
+        # The PRODUCTION path: packed buffers + trace-time field groups,
+        # so selector-free waves prune the affinity machinery exactly
+        # like the coordinator's step does.  (Probing with a plain
+        # PodBatch keeps all-NONE selector arrays as runtime inputs XLA
+        # cannot DCE — ~45s/wave of dead label resolution on CPU.)
+        packed = enc.encode_packed(uniform_pods(args.batch))
+
+        @functools.lru_cache(maxsize=None)
+        def _xla_fn(prof):
+            # One jit wrapper per profile — rebuilding it per step would
+            # recompile every step.
+            def fn(table, ints, bools, key):
+                b = unpack_pod_batch(
+                    ints, bools, packed.spec, packed.table_spec,
+                    packed.groups,
+                )
+                return filter_score_topk(
+                    table, b, key, prof, chunk=args.chunk, k=args.k
+                ).idx
+
+            return jax.jit(fn)
 
         def run_xla(prof, key):
-            fn = jax.jit(functools.partial(
-                filter_score_topk, profile=prof,
-                chunk=args.chunk, k=args.k,
-            ))
-            cand = fn(table, batch, key)
-            return cand.idx
+            return _xla_fn(prof)(table, packed.ints, packed.bools, key)
 
     picked = variants()
     if args.only:

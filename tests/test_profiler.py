@@ -23,15 +23,23 @@ def _spin(deadline):
 
 
 def test_profiler_attributes_hot_function(tmp_path):
-    # The GIL bounds the effective rate on a 1-core host (the busy
-    # thread holds it for ~5ms switch intervals) and suite-load skews
-    # it further, so spin until enough samples exist rather than
-    # asserting a rate.
-    prof = SamplingProfiler(hz=250)
-    deadline = time.perf_counter() + 10.0
-    with prof:
-        while prof.samples < 25 and time.perf_counter() < deadline:
-            _spin(time.perf_counter() + 0.3)
+    import sys
+
+    # The GIL bounds the effective rate on a 1-core host: the spinning
+    # main thread holds it for whole switch intervals and under suite
+    # load the sampler can starve entirely.  A 1ms switch interval for
+    # the test's duration guarantees wakeups; the window is adaptive on
+    # top of that.
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    try:
+        prof = SamplingProfiler(hz=250)
+        deadline = time.perf_counter() + 20.0
+        with prof:
+            while prof.samples < 25 and time.perf_counter() < deadline:
+                _spin(time.perf_counter() + 0.3)
+    finally:
+        sys.setswitchinterval(old)
     assert prof.samples > 5
     rep = prof.report()
     # _spin must dominate self-time.

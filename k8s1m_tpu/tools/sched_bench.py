@@ -58,6 +58,10 @@ def parse_args(argv=None):
     ap.add_argument("--backend", choices=("xla", "pallas"), default="pallas")
     ap.add_argument("--target", default=None,
                     help="remote store addr (default: in-process store)")
+    ap.add_argument("--ca-pem", default=None,
+                    help="TLS: trust this CA for --target (a secured tier)")
+    ap.add_argument("--token", default=None,
+                    help="bearer token for --target")
     ap.add_argument(
         "--rate", type=int, default=0,
         help="offered load in pods/s (paced producer + adaptive batch "
@@ -228,7 +232,11 @@ def main(argv=None):
     if args.target:
         from k8s1m_tpu.store.remote import RemoteStore
 
-        store = RemoteStore(args.target)
+        store = RemoteStore(
+            args.target,
+            ca_pem=getattr(args, 'ca_pem', None),
+            token=getattr(args, 'token', None),
+        )
     else:
         store = MemStore()
 

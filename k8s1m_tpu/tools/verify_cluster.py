@@ -70,6 +70,8 @@ def find_gaps(store, prefix: bytes = NODES_PREFIX, pattern: str = r"-(\d+)$"):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="cluster state verification")
+    ap.add_argument("--ca-pem", default=None, help="TLS: trust this CA")
+    ap.add_argument("--token", default=None, help="bearer token")
     ap.add_argument("--target", default=None,
                     help="remote store addr (default: in-process test store)")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -81,7 +83,7 @@ def main(argv=None):
     if args.target:
         from k8s1m_tpu.store.remote import RemoteStore
 
-        store = RemoteStore(args.target)
+        store = RemoteStore(args.target, ca_pem=args.ca_pem, token=args.token)
     else:
         ap.error("--target is required outside tests")
     try:

@@ -60,11 +60,33 @@ def start_metrics_server(
         def log_message(self, *args):  # quiet
             pass
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    if ssl_context is None:
+        server = ThreadingHTTPServer((host, port), Handler)
+    else:
+        # Wrap per-connection, after accept, with the handshake deferred
+        # into the handler thread — wrapping the *listening* socket runs
+        # the handshake inside the serve_forever accept loop, so one
+        # client stalling mid-handshake would block every later scrape.
+        class TLSServer(ThreadingHTTPServer):
+            def get_request(self):
+                sock, addr = super().get_request()
+                sock.settimeout(10.0)  # bound a stalled handshake/read
+                return (
+                    ssl_context.wrap_socket(
+                        sock, server_side=True,
+                        do_handshake_on_connect=False,
+                    ),
+                    addr,
+                )
+
+            def finish_request(self, request, client_address):
+                request.do_handshake()  # in the per-connection thread
+                super().finish_request(request, client_address)
+
+            def handle_error(self, request, client_address):
+                pass  # failed handshakes are the client's problem
+
+        server = TLSServer((host, port), Handler)
     server.daemon_threads = True
-    if ssl_context is not None:
-        server.socket = ssl_context.wrap_socket(
-            server.socket, server_side=True
-        )
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server

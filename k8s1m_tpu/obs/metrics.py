@@ -240,7 +240,9 @@ class Histogram(Metric):
                     return float("inf")
                 hi = self.buckets[i]
                 lo = self.buckets[i - 1] if i > 0 else 0.0
-                frac = (target - seen) / c if c else 1.0
+                # q=0 (or an empty leading bucket) must report the
+                # bucket's LOWER edge, not snap to its upper bound.
+                frac = (target - seen) / c if c else 0.0
                 return lo + (hi - lo) * frac
             seen += c
         return float("inf")

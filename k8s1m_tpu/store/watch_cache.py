@@ -36,6 +36,7 @@ import asyncio
 import bisect
 import collections
 import dataclasses
+import hmac
 import json
 import logging
 
@@ -856,7 +857,10 @@ class _BearerAuth(aio.ServerInterceptor):
     async def intercept_service(self, continuation, details):
         md = dict(details.invocation_metadata or ())
         handler = await continuation(details)
-        if md.get("authorization") == self._expect or handler is None:
+        if (
+            hmac.compare_digest(md.get("authorization", ""), self._expect)
+            or handler is None
+        ):
             return handler
         # Mirror the real handler's cardinality so the deny travels the
         # right stub path on the client.

@@ -388,9 +388,11 @@ constexpr uint32_t CONN_WINDOW_TOPUP = (1u << 20);
 // Abuse guards: the port is a real TCP listener, so one misbehaving
 // client must not exhaust server memory.  A unary stream that never
 // half-closes is capped at 64 MiB of buffered request data (the repo's
-// own clients cap messages at 64 MB); an accumulated header block
-// (HEADERS + CONTINUATIONs) at 1 MiB.
-constexpr size_t MAX_STREAM_BUF = size_t(64) << 20;
+// own clients cap messages at 64 MB) plus 1 KiB of slack for the
+// 5-byte gRPC frame prefix — without the slack a maximum-size legal
+// message trips the cap and kills the connection; an accumulated
+// header block (HEADERS + CONTINUATIONs) at 1 MiB.
+constexpr size_t MAX_STREAM_BUF = (size_t(64) << 20) + 1024;
 constexpr size_t MAX_HEADER_BLOCK = size_t(1) << 20;
 
 // grpc status codes used

@@ -57,3 +57,16 @@ def test_tls_basic_auth_metrics(tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_histogram_quantile_zero_reports_lower_edge():
+    """q=0 must report the distribution's lower edge, not snap to the
+    first bucket's upper bound when that bucket is empty (round-4
+    advisor: frac=1.0 fallback on c==0 returned bucket[0]'s top)."""
+    from k8s1m_tpu.obs.metrics import Histogram, Registry
+
+    h = Histogram("q0_pin", "t", (), buckets=(0.1, 1.0, 10.0),
+                  registry=Registry())
+    h.observe(5.0)   # lands in (1.0, 10.0]
+    assert h.quantile(0.0) == 0.0   # distribution lower edge, not 0.1
+    assert h.quantile(1.0) == 10.0

@@ -55,6 +55,7 @@ from k8s1m_tpu.control.objects import (
 )
 from k8s1m_tpu.engine.cycle import (
     adjust_constraints,
+    adjust_constraints_impl,
     commit_fields_np,
     commit_fields_of,
     sample_offset_for,
@@ -200,6 +201,7 @@ class Coordinator:
         watch_queue_cap: int = DEEP_WATCH_QUEUE,
         score_pct: int = 100,
         intake_filter=None,
+        mesh=None,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -225,8 +227,35 @@ class Coordinator:
         # filters+scores one rotating chunk-aligned window of the table.
         if not 1 <= score_pct <= 100:
             raise ValueError(f"score_pct must be in [1, 100], got {score_pct}")
+        # Mesh scale-out (the reference's "more replicas" axis): the node
+        # table's rows shard over ``sp`` devices, the pod batch over
+        # ``dp``; the device step becomes the shard_mapped
+        # make_sharded_packed_step and percentageOfNodesToScore windows
+        # rotate SHARD-LOCALLY (each device samples its own rows, like
+        # each dist-scheduler replica samples the nodes it owns).
+        self.mesh = mesh
+        if mesh is not None:
+            dp_size, sp_size = mesh.shape["dp"], mesh.shape["sp"]
+            local_rows = table_spec.max_nodes // sp_size
+            if local_rows * sp_size != table_spec.max_nodes:
+                raise ValueError(
+                    f"max_nodes {table_spec.max_nodes} not divisible by "
+                    f"sp={sp_size}"
+                )
+            if local_rows % chunk:
+                raise ValueError(
+                    f"rows-per-shard {local_rows} not divisible by "
+                    f"chunk {chunk}"
+                )
+            if pod_spec.batch % dp_size:
+                raise ValueError(
+                    f"batch {pod_spec.batch} not divisible by dp={dp_size}"
+                )
+            self._window_nodes = local_rows
+        else:
+            self._window_nodes = table_spec.max_nodes
         self._sample_rows = sample_rows_for(
-            table_spec.max_nodes, score_pct, chunk
+            self._window_nodes, score_pct, chunk
         )
         self._window_i = 0
 
@@ -247,6 +276,42 @@ class Coordinator:
         self.constraints = (
             empty_constraints(table_spec) if with_constraints else None
         )
+        self._table_sharding = None
+        self._scatter = _scatter_rows
+        self._adjust = adjust_constraints
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            if adaptive_batch and self.min_batch % mesh.shape["dp"]:
+                raise ValueError(
+                    f"adaptive min batch {self.min_batch} not divisible "
+                    f"by dp={mesh.shape['dp']}"
+                )
+            self._table_sharding = NamedSharding(mesh, P("sp"))
+            # Dirty-row scatters must not let the partitioner drift the
+            # table off its row sharding (a replicated output here would
+            # silently serialize every later wave).
+            self._scatter = jax.jit(
+                _scatter_rows_impl, out_shardings=self._table_sharding
+            )
+            if self.constraints is not None:
+                from k8s1m_tpu.parallel.mesh import constraint_specs
+
+                cons_shardings = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s),
+                    constraint_specs(self.constraints),
+                )
+                self.constraints = jax.device_put(
+                    self.constraints, cons_shardings
+                )
+                # Same drift guard as _scatter: out-of-step constraint
+                # corrections (deletes, CAS rollbacks) must hand the
+                # state back sharded, or every later wave reshards it.
+                self._adjust = jax.jit(
+                    adjust_constraints_impl, static_argnames=("sign",),
+                    out_shardings=cons_shardings,
+                )
         self.key = jax.random.key(seed)
 
         self.queue: collections.deque[PendingPod] = collections.deque()
@@ -300,6 +365,12 @@ class Coordinator:
         (flipping bits) never recompiles — the TPU re-expression of the
         reference's node-label rebalancer moving nodes between replicas
         (reference cmd/dist-scheduler/leader_activities.go:227-343)."""
+        if self.mesh is not None and mask is not None:
+            raise ValueError(
+                "row masks (process-level node sharding) and a device "
+                "mesh are different scale-out axes; compose them across "
+                "processes, not inside one coordinator"
+            )
         if mask is None:
             self._row_mask_np = None
             self._row_mask_dev = None
@@ -336,7 +407,7 @@ class Coordinator:
                 start_revision=pod_rev + 1, queue_cap=self.watch_queue_cap,
             )
             self._bind_excludes = isinstance(self._pods_watch, Watcher)
-            self.table = self.host.to_device()
+            self.table = self.host.to_device(self._table_sharding)
 
     # ---- watch delta application --------------------------------------
 
@@ -720,7 +791,7 @@ class Coordinator:
         (tens of bytes per row — cheap at any realistic delta rate).
         """
         if self.table is None:
-            self.table = self.host.to_device()
+            self.table = self.host.to_device(self._table_sharding)
             self._dirty_rows.clear()
             return
         if not self._dirty_rows:
@@ -748,7 +819,7 @@ class Coordinator:
                 "zone": h.zone[rows], "region": h.region[rows],
                 "name_id": h.name_id[rows],
             }
-            self.table = _scatter_rows(self.table, rows, delta)
+            self.table = self._scatter(self.table, rows, delta)
 
     # ---- the cycle -----------------------------------------------------
 
@@ -776,7 +847,7 @@ class Coordinator:
                         mask_node[i] = True
                     zone[i], region[i] = z, r
                     mask_dom[i] = True
-                self.constraints = adjust_constraints(
+                self.constraints = self._adjust(
                     self.constraints, fields,
                     jnp.asarray(node_row), jnp.asarray(zone), jnp.asarray(region),
                     jnp.asarray(mask_node), jnp.asarray(mask_dom), sign=sign,
@@ -868,9 +939,7 @@ class Coordinator:
     def _next_window(self) -> int:
         i = self._window_i
         self._window_i += 1
-        return sample_offset_for(
-            i, self.table_spec.max_nodes, self._sample_rows
-        )
+        return sample_offset_for(i, self._window_nodes, self._sample_rows)
 
     def _launch(self, batch_pods, batch):
         """Enqueue the device step for an encoded batch (async — no
@@ -887,6 +956,7 @@ class Coordinator:
                     self._next_window() if self._sample_rows else 0
                 ),
                 row_mask=self._row_mask_dev,
+                mesh=self.mesh,
             )
         # Start the device->host copy of the bind decision now: by the
         # time _complete runs (a drain + encode later), the bytes are
@@ -1026,7 +1096,7 @@ class Coordinator:
                     _BIND_LATENCY.observe_many(lats)
         if failed.any() and self.constraints is not None:
             m = jnp.asarray(failed)
-            self.constraints = adjust_constraints(
+            self.constraints = self._adjust(
                 self.constraints, commit_fields_np(batch.fields),
                 asg.node_row, asg.zone, asg.region, m, m, sign=-1,
             )
@@ -1237,10 +1307,12 @@ class Coordinator:
         return total
 
 
-@jax.jit
-def _scatter_rows(table, rows, delta: dict):
+def _scatter_rows_impl(table, rows, delta: dict):
     updates = {
         name: getattr(table, name).at[rows].set(arr)
         for name, arr in delta.items()
     }
     return table.replace(**updates)
+
+
+_scatter_rows = jax.jit(_scatter_rows_impl)

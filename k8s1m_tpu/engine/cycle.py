@@ -329,8 +329,7 @@ def finalize_batch(
     return table, constraints, asg
 
 
-@functools.partial(jax.jit, static_argnames=("sign",))
-def adjust_constraints(
+def adjust_constraints_impl(
     constraints: ConstraintState,
     fields: CommitFields,
     node_row,      # i32[B] (clipped to a valid row where mask_node is off)
@@ -356,6 +355,11 @@ def adjust_constraints(
         fields.ipa_own_valid, fields.ipa_tid, fields.ipa_topo,
         sign=sign,
     )
+
+
+adjust_constraints = jax.jit(
+    adjust_constraints_impl, static_argnames=("sign",)
+)
 
 
 def _schedule_batch_impl(
@@ -616,12 +620,21 @@ def schedule_batch_packed(
     sample_rows: int | None = None,
     sample_offset: int = 0,
     row_mask=None,
+    mesh=None,
 ):
     """schedule_batch over a PackedPodBatch: the pod features cross the
     host->device boundary as two buffers and the bind decision comes back
     as one i32[B] row array (-1 = unbound) — 3 transfers per cycle total
     instead of ~40, which is what the per-call cost of a remote device
     relay demands.
+
+    ``mesh`` (a (dp, sp) jax.sharding.Mesh) routes the step through
+    parallel/sharded_cycle.make_sharded_packed_step: the table must be
+    placed with its rows sharded over ``sp`` and ``sample_rows`` /
+    ``sample_offset`` become SHARD-LOCAL (each shard scores a rotating
+    window of its own rows).  Mutually exclusive with ``row_mask``
+    (node-space process sharding and mesh sharding are different axes
+    of scale-out; compose them across processes, not inside one step).
 
     ``sample_rows``/``sample_offset`` implement percentageOfNodesToScore:
     only rows [offset, offset+sample_rows) are filtered+scored this cycle
@@ -648,6 +661,22 @@ def schedule_batch_packed(
                 "profile enables constraint plugins but no constraint "
                 "state was passed (see ops/pallas_topk.py)"
             )
+    if mesh is not None:
+        if row_mask is not None:
+            raise ValueError("mesh and row_mask are mutually exclusive")
+        from k8s1m_tpu.parallel.sharded_cycle import make_sharded_packed_step
+
+        step = make_sharded_packed_step(
+            mesh, profile, chunk=chunk, k=k,
+            pod_spec=packed.spec, table_spec=packed.table_spec,
+            groups=packed.groups, sample_rows=sample_rows, backend=backend,
+        )
+        offset = np.int32(sample_offset)
+        if constraints is not None:
+            return step(
+                table, packed.ints, packed.bools, key, offset, constraints
+            )
+        return step(table, packed.ints, packed.bools, key, offset)
     step = _jitted_schedule_packed(
         profile, chunk, k, constraints is not None, backend,
         packed.spec, packed.table_spec, packed.groups, sample_rows,

@@ -84,7 +84,13 @@ def start_metrics_server(
                 super().finish_request(request, client_address)
 
             def handle_error(self, request, client_address):
-                pass  # failed handshakes are the client's problem
+                # Failed/stalled handshakes are the client's problem
+                # (ssl.SSLError is an OSError subclass); anything else
+                # is OUR bug and must not vanish.
+                import sys
+
+                if not isinstance(sys.exc_info()[1], OSError):
+                    super().handle_error(request, client_address)
 
         server = TLSServer((host, port), Handler)
     server.daemon_threads = True

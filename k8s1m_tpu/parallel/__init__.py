@@ -1,4 +1,13 @@
 from k8s1m_tpu.parallel.mesh import make_mesh, table_specs, batch_specs
-from k8s1m_tpu.parallel.sharded_cycle import make_sharded_step
+from k8s1m_tpu.parallel.sharded_cycle import (
+    make_sharded_packed_step,
+    make_sharded_step,
+)
 
-__all__ = ["make_mesh", "table_specs", "batch_specs", "make_sharded_step"]
+__all__ = [
+    "make_mesh",
+    "table_specs",
+    "batch_specs",
+    "make_sharded_step",
+    "make_sharded_packed_step",
+]

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+import functools
+
 from k8s1m_tpu.engine.cycle import (
     Assignment,
     commit_fields_of,
@@ -41,6 +43,55 @@ from k8s1m_tpu.snapshot.node_table import NodeTable
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
 
 
+def fold_mesh_key(key):
+    """Per-device PRNG key: tie-break jitter decorrelated across both
+    mesh axes (call inside shard_map)."""
+    sp = lax.axis_index("sp")
+    dp = lax.axis_index("dp")
+    return jax.random.fold_in(jax.random.fold_in(key, sp), dp)
+
+
+def gather_and_finalize(table, batch, cand, constraints, *, k: int):
+    """The shared sharded epilogue (call inside shard_map over (dp, sp)):
+
+    1. gather candidates across node shards (``sp``), keep global top-k —
+       the ICI replacement for the CollectScore gRPC gather
+       (reference pkg/scoreevaluator/scoreevaluator.go:45-126);
+    2. gather candidates and commit fields across ``dp`` (pods stay in
+       batch order: dp shards are contiguous blocks) — only CommitFields
+       crosses this hop, the selector tensors never leave home;
+    3. replicated greedy conflict resolution (identical inputs ->
+       identical result on every device, no coordination), then commit
+       the binds landing in this shard's row range; zone/region count
+       tables are replicated and take the full identical update.
+
+    Returns (table, constraints|None, Assignment).
+    """
+    rows = table.num_rows
+    row_offset = lax.axis_index("sp") * rows
+
+    def gather_sp(x):
+        g = lax.all_gather(x, "sp")                 # [SP, b, k]
+        return jnp.moveaxis(g, 0, 1).reshape(x.shape[0], -1)
+
+    cand = jax.tree.map(gather_sp, cand)
+    top_prio, sel = lax.top_k(cand.prio, k)
+    cand = jax.tree.map(
+        lambda x: jnp.take_along_axis(x, sel, axis=-1), cand
+    ).replace(prio=top_prio)
+
+    def gather_dp(x):
+        g = lax.all_gather(x, "dp")
+        return g.reshape(-1, *x.shape[1:])
+
+    cand = jax.tree.map(gather_dp, cand)
+    fields = jax.tree.map(gather_dp, commit_fields_of(batch))
+
+    return finalize_batch(
+        table, constraints, cand, fields, row_offset=row_offset, rows=rows
+    )
+
+
 def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int):
     """Build the jitted multi-device scheduling step for a fixed mesh.
 
@@ -52,54 +103,20 @@ def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int):
 
     def _local_step(table: NodeTable, batch: PodBatch, key: jax.Array,
                     constraints: ConstraintState | None = None):
-        sp = lax.axis_index("sp")
-        dp = lax.axis_index("dp")
-        rows = table.num_rows                       # rows per sp shard
-        row_offset = sp * rows
+        row_offset = lax.axis_index("sp") * table.num_rows
 
         stats = (
             topology.prologue(table, constraints, axis_name="sp")
             if constraints is not None else None
         )
 
-        # 1. local filter+score+top-k over this device's block.  Jitter is
-        # decorrelated across both mesh axes.
-        local_key = jax.random.fold_in(jax.random.fold_in(key, sp), dp)
+        # Local filter+score+top-k over this device's block.
         cand = filter_score_topk(
-            table, batch, local_key, profile,
+            table, batch, fold_mesh_key(key), profile,
             chunk=chunk, k=k, constraints=constraints, stats=stats,
             row_offset=row_offset,
         )
-
-        # 2. gather candidates across node shards, keep global top-k.
-        def gather_sp(x):
-            g = lax.all_gather(x, "sp")             # [SP, b, k]
-            return jnp.moveaxis(g, 0, 1).reshape(x.shape[0], -1)
-
-        cand = jax.tree.map(gather_sp, cand)
-        top_prio, sel = lax.top_k(cand.prio, k)
-        cand = jax.tree.map(
-            lambda x: jnp.take_along_axis(x, sel, axis=-1), cand
-        ).replace(prio=top_prio)
-
-        # 3. gather the epilogue's slice of the batch across dp (pods stay
-        # in batch order: dp shards are contiguous blocks).  Only
-        # CommitFields crosses this hop — the selector tensors never leave
-        # their home device.
-        def gather_dp(x):
-            g = lax.all_gather(x, "dp")
-            return g.reshape(-1, *x.shape[1:])
-
-        cand = jax.tree.map(gather_dp, cand)
-        fields = jax.tree.map(gather_dp, commit_fields_of(batch))
-
-        # 4+5. replicated greedy conflict resolution (identical inputs ->
-        # identical result on every device), then commit the binds that
-        # landed in this shard's row range; zone/region count tables are
-        # replicated and take the full (identical) update everywhere.
-        return finalize_batch(
-            table, constraints, cand, fields, row_offset=row_offset, rows=rows
-        )
+        return gather_and_finalize(table, batch, cand, constraints, k=k)
 
     def step(table, batch, key, constraints=None):
         asg_specs = Assignment(P(), P(), P(), P(), P())
@@ -111,5 +128,136 @@ def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int):
             out_specs=(table_specs(table), cons_specs, asg_specs),
             check_vma=False,
         )(table, batch, key, constraints)
+
+    return jax.jit(step)
+
+
+@functools.lru_cache(maxsize=64)
+def make_sharded_packed_step(
+    mesh,
+    profile: Profile,
+    *,
+    chunk: int,
+    k: int,
+    pod_spec,
+    table_spec,
+    groups: frozenset,
+    sample_rows: int | None = None,
+    backend: str = "xla",
+):
+    """The mesh analogue of engine.cycle._jitted_schedule_packed: the
+    coordinator's production step — packed two-buffer pod upload,
+    percentageOfNodesToScore windows, one i32[B] bind-row result — run
+    as a shard_map over the (dp, sp) mesh so the e2e loop (store ->
+    watch -> schedule -> CAS bind) drives every chip, not one.
+
+    This is the TPU re-expression of the reference's scheduler fan-out:
+    "more replicas" (reference pkg/schedulerset/schedulerset.go:161-193,
+    289 Go replicas at 1M nodes) becomes "more mesh devices", with the
+    CollectScore gRPC gather replaced by an ICI all-gather.
+
+    Sharding layout (parallel/mesh.py):
+    - node table rows over ``sp`` (each shard owns N/sp rows);
+    - the pod batch over ``dp`` — the packed buffers are replicated
+      (they are a flat field concatenation, a few KB) and each dp rank
+      unpacks the full wave then slices its contiguous pod block, so the
+      O(B*N) filter+score work is dp-sharded even though the upload is
+      not;
+    - ``sample_rows`` is SHARD-LOCAL: each shard filters+scores a
+      rotating chunk-aligned window of its own rows (the reference's
+      percentageOfNodesToScore works the same way per replica —
+      dist-scheduler samples 5% of the nodes *it owns*).
+
+    Returns step(table, ints, bools, key, offset[, constraints])
+    -> (table, constraints|None, Assignment, rows i32[B]); table and
+    constraint node tables sharded, everything else replicated.
+    """
+    from k8s1m_tpu.plugins import topology
+    from k8s1m_tpu.snapshot.constraints import slice_constraints
+    from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
+
+    dp_size = mesh.shape["dp"]
+    b_full = pod_spec.batch
+    if b_full % dp_size:
+        raise ValueError(f"batch {b_full} not divisible by dp={dp_size}")
+    b_local = b_full // dp_size
+    aff = bool(groups & {"sel", "req", "pref"})
+
+    def _local_step(table, ints, bools, key, offset, constraints=None):
+        dp = lax.axis_index("dp")
+        row_offset = lax.axis_index("sp") * table.num_rows
+
+        full = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
+        batch = jax.tree.map(
+            lambda x: (
+                lax.dynamic_slice_in_dim(x, dp * b_local, b_local, 0)
+                if x.ndim >= 1 and x.shape[0] == b_full else x
+            ),
+            full,
+        ).replace(qkey=full.qkey)   # qkey is [Q]; stays whole on every rank
+
+        stats = (
+            topology.prologue(table, constraints, axis_name="sp")
+            if constraints is not None else None
+        )
+        local_key = fold_mesh_key(key)
+
+        if sample_rows is None:
+            view, view_cons, view_off = table, constraints, row_offset
+        else:
+            view = jax.tree.map(
+                lambda a: lax.dynamic_slice_in_dim(a, offset, sample_rows, 0),
+                table,
+            )
+            view_cons = (
+                slice_constraints(constraints, offset, sample_rows)
+                if constraints is not None else None
+            )
+            view_off = row_offset + offset
+
+        if backend == "pallas":
+            from k8s1m_tpu.ops.pallas_topk import pallas_candidates
+
+            cand = pallas_candidates(
+                view, batch, local_key, profile, chunk=chunk, k=k,
+                with_affinity=aff, constraints=view_cons, stats=stats,
+            )
+            cand = cand.replace(
+                idx=jnp.where(cand.idx >= 0, cand.idx + view_off, -1)
+            )
+        else:
+            cand = filter_score_topk(
+                view, batch, local_key, profile, chunk=chunk, k=k,
+                constraints=view_cons, stats=stats, row_offset=view_off,
+            )
+
+        table, cons, asg = gather_and_finalize(
+            table, batch, cand, constraints, k=k
+        )
+        rows_out = jnp.where(asg.bound, asg.node_row, -1).astype(jnp.int32)
+        return table, cons, asg, rows_out
+
+    def step(table, ints, bools, key, offset, constraints=None):
+        asg_specs = Assignment(P(), P(), P(), P(), P())
+        cons_specs = (
+            constraint_specs(constraints) if constraints is not None else None
+        )
+        if constraints is not None:
+            fn = jax.shard_map(
+                _local_step,
+                mesh=mesh,
+                in_specs=(table_specs(table), P(), P(), P(), P(), cons_specs),
+                out_specs=(table_specs(table), cons_specs, asg_specs, P()),
+                check_vma=False,
+            )
+            return fn(table, ints, bools, key, offset, constraints)
+        fn = jax.shard_map(
+            lambda t, i, bl, kk, off: _local_step(t, i, bl, kk, off, None),
+            mesh=mesh,
+            in_specs=(table_specs(table), P(), P(), P(), P()),
+            out_specs=(table_specs(table), None, asg_specs, P()),
+            check_vma=False,
+        )
+        return fn(table, ints, bools, key, offset)
 
     return jax.jit(step)

@@ -103,6 +103,14 @@ def parse_args(argv=None):
         "host or the stressor starves the scheduler it is stressing)",
     )
     ap.add_argument(
+        "--mesh", default=None, metavar="DP,SP",
+        help="drive the wave through the sharded step over a dp x sp "
+        "device mesh (parallel/sharded_cycle.make_sharded_packed_step) — "
+        "the reference's multi-replica fan-out as mesh devices.  "
+        "Requires dp*sp <= len(jax.devices()); on one chip use 1,1; on "
+        "a v5e-8 use e.g. 1,8 or 2,4.",
+    )
+    ap.add_argument(
         "--profile", metavar="PATH", default=None,
         help="sample the measured window with obs/profiler.py, write "
         "the collapsed-stack artifact to PATH, and print the self-time "
@@ -252,12 +260,21 @@ def main(argv=None):
     nodes_s = time.perf_counter() - t0
 
     cap = 1 << max(10, (args.nodes - 1).bit_length())
+    mesh = None
+    if args.mesh:
+        from k8s1m_tpu.parallel import make_mesh
+
+        dp, sp = (int(x) for x in args.mesh.split(","))
+        mesh = make_mesh(dp=dp, sp=sp)
+        # The chunked scan runs per shard; clamp to the shard's rows.
+        args.chunk = min(args.chunk, cap // sp)
     profile = Profile(node_affinity=0, topology_spread=0, interpod_affinity=0)
     coord = Coordinator(
         store, TableSpec(max_nodes=cap), PodSpec(batch=args.batch),
         profile, chunk=args.chunk, with_constraints=False,
         backend=args.backend, pipeline=not args.no_pipeline, depth=args.depth,
         score_pct=args.score_pct, adaptive_batch=bool(args.rate),
+        mesh=mesh,
     )
     t0 = time.perf_counter()
     coord.bootstrap()
@@ -362,6 +379,7 @@ def main(argv=None):
             "vs_baseline": None,
             "detail": {
                 "rate": args.rate,
+                "mesh": args.mesh,
                 "score_pct": args.score_pct,
                 "binds_per_sec": round(e2e, 1),
                 "bound": bound,
@@ -418,6 +436,7 @@ def main(argv=None):
         "vs_baseline": round(e2e / REFERENCE_E2E, 3),
         "detail": {
             "score_pct": args.score_pct,
+            "mesh": args.mesh,
             "pods": args.pods,
             "bound": bound,
             "deleted": deleted,

@@ -552,7 +552,14 @@ struct PendingData {
   uint32_t stream_id;
   Bytes payload;
   size_t off = 0;
-  bool end_stream = false;
+  // Pre-framed bytes (a trailers HEADERS frame): appended to the wire
+  // verbatim — no DATA framing, no window accounting — but only after
+  // every earlier queued entry of the same stream has drained.  Keeps
+  // trailers ORDERED behind window-blocked response data: writing them
+  // immediately would end the stream before the body finished (the
+  // peer then discards the truncated message and the RPC "succeeds"
+  // with no response).
+  bool raw = false;
 };
 
 // A write response held back until the WAL reports its revision durable
@@ -659,6 +666,14 @@ void drain_pending(Conn& c) {
         stream_blocked = true;  // earlier bytes of this stream wait
         break;
       }
+    if (pd.raw) {
+      if (stream_blocked) {
+        keep.push_back(std::move(pd));
+      } else {
+        c.out += pd.payload;    // pre-framed trailers, in order
+      }
+      continue;
+    }
     while (!stream_blocked && pd.off < pd.payload.size()) {
       size_t remaining = pd.payload.size() - pd.off;
       int64_t allow = int64_t(c.peer_max_frame);
@@ -685,6 +700,27 @@ void drain_pending(Conn& c) {
   c.pending = std::move(keep);
 }
 
+// Emit a HEADERS frame carrying END_STREAM.  Window-blocked response
+// bytes may still be queued for this stream; the end-of-stream frame
+// must follow them on the wire (PendingData.raw) — writing it directly
+// would truncate the body (the peer discards the incomplete message and
+// the RPC "succeeds" empty).
+void emit_end_headers(Conn& c, uint32_t stream_id, const Bytes& block) {
+  for (const PendingData& pd : c.pending) {
+    if (pd.stream_id == stream_id) {
+      Bytes frame;
+      frame_header(frame, block.size(), F_HEADERS,
+                   FLAG_END_HEADERS | FLAG_END_STREAM, stream_id);
+      frame += block;
+      c.pending.push_back({stream_id, std::move(frame), 0, true});
+      return;
+    }
+  }
+  frame_header(c.out, block.size(), F_HEADERS,
+               FLAG_END_HEADERS | FLAG_END_STREAM, stream_id);
+  c.out += block;
+}
+
 // Response headers frame (:status 200, content-type) — no END_STREAM.
 void send_response_headers(Conn& c, uint32_t stream_id) {
   Bytes block;
@@ -706,9 +742,7 @@ void send_trailers(Conn& c, uint32_t stream_id, int status,
     hpack_raw_string(block, "grpc-message", 12);
     hpack_raw_string(block, esc.data(), esc.size());
   }
-  frame_header(c.out, block.size(), F_HEADERS,
-               FLAG_END_HEADERS | FLAG_END_STREAM, stream_id);
-  c.out += block;
+  emit_end_headers(c, stream_id, block);
 }
 
 // Trailers-only error response.
@@ -725,9 +759,7 @@ void send_error(Conn& c, Stream& s, int status, const char* message) {
     hpack_raw_string(block, "grpc-message", 12);
     hpack_raw_string(block, esc.data(), esc.size());
   }
-  frame_header(c.out, block.size(), F_HEADERS,
-               FLAG_END_HEADERS | FLAG_END_STREAM, s.id);
-  c.out += block;
+  emit_end_headers(c, s.id, block);
   s.responded = true;
 }
 

@@ -187,3 +187,40 @@ def test_client_header_table_size_setting_is_ignored(wire):
                 got_response = True
     assert got_response, "Put on a conn announcing HEADER_TABLE_SIZE died"
     s.close()
+
+
+def test_large_response_trailers_follow_data():
+    """A response bigger than the peer's flow-control window must not be
+    truncated by early trailers: send_trailers queues behind window-
+    blocked DATA (PendingData.raw), so the 8 MiB body arrives complete
+    even though the initial stream window is 64 KiB."""
+    import asyncio
+
+    from k8s1m_tpu.store.etcd_client import EtcdClient
+    from k8s1m_tpu.store.native import MemStore, WireFront
+
+    store = MemStore()
+    wf = WireFront(store)
+    loop = asyncio.new_event_loop()
+    try:
+        async def run():
+            c = EtcdClient(
+                f"127.0.0.1:{wf.port}",
+                options=[("grpc.max_receive_message_length", 64 << 20)],
+            )
+            big = bytes(bytearray(range(256)) * (32 << 10))   # 8 MiB
+            await c.put(b"/big", big)
+            kv = await c.get(b"/big")
+            assert kv is not None and kv.value == big
+            # The connection survives for later RPCs (no stray DATA on a
+            # closed stream).
+            await c.put(b"/after", b"ok")
+            kv2 = await c.get(b"/after")
+            assert kv2.value == b"ok"
+            await c.close()
+
+        loop.run_until_complete(run())
+    finally:
+        loop.close()
+        wf.close()
+        store.close()

@@ -82,6 +82,11 @@ class ClusterSpec:
     # (README.adoc:410-416) — connect to the tier; writes proxy through.
     watch_cache: bool = False
     watch_cache_index: str = "hash"
+    # Tier replica count: N watch-cache processes over the ONE store,
+    # consumers assigned round-robin — the reference's 11-apiserver
+    # fleet behind haproxy SRV round-robin (reference
+    # README.adoc:721-723, terraform/k8s-server/server.tf:230-251).
+    tier_replicas: int = 1
     # Serve the webhook intake over HTTPS with rig-provisioned certs
     # (cluster/certs.py — the reference's terraform-provisioned webhook
     # TLS, dist-scheduler.tf:713-740, webhook.go:33-35).
@@ -110,6 +115,10 @@ class ClusterSpec:
             )
         if self.tier_tls and not self.watch_cache:
             raise ValueError("tier_tls requires watch_cache=True")
+        if self.tier_replicas < 1:
+            raise ValueError("tier_replicas must be >= 1")
+        if self.tier_replicas > 1 and not self.watch_cache:
+            raise ValueError("tier_replicas > 1 requires watch_cache=True")
 
     def table_spec(self) -> TableSpec:
         if self.table is not None:
@@ -192,35 +201,42 @@ class Cluster:
 
             self.certs = provision(f"{self.wal_dir}/certs")
 
+        self._tiers: list = []
+        self.tier_ports: list[int] = []
+        self._tier_rr = 0
         if spec.watch_cache:
-            self.tier_port = _free_port()
-            tier_cmd = [
-                sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
-                "--upstream", f"127.0.0.1:{self.port}",
-                "--host", "127.0.0.1", "--port", str(self.tier_port),
-                "--prefix", "/registry/",
-                "--index", spec.watch_cache_index,
-            ]
             if spec.tier_tls:
                 import secrets
 
                 self.tier_token = secrets.token_hex(16)
-                tier_cmd += [
-                    "--tls-cert", self.certs.cert_pem,
-                    "--tls-key", self.certs.key_pem,
-                    "--auth-token", self.tier_token,
+            for i in range(spec.tier_replicas):
+                port = _free_port()
+                tier_cmd = [
+                    sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+                    "--upstream", f"127.0.0.1:{self.port}",
+                    "--host", "127.0.0.1", "--port", str(port),
+                    "--prefix", "/registry/",
+                    "--index", spec.watch_cache_index,
                 ]
-            self._tier = subprocess.Popen(
-                tier_cmd, stderr=self._ship("tier")
-            )
+                if spec.tier_tls:
+                    tier_cmd += [
+                        "--tls-cert", self.certs.cert_pem,
+                        "--tls-key", self.certs.key_pem,
+                        "--auth-token", self.tier_token,
+                    ]
+                self._tiers.append(subprocess.Popen(
+                    tier_cmd, stderr=self._ship(f"tier-{i}")
+                ))
+                self.tier_ports.append(port)
+            self._tier = self._tiers[0]
+            self.tier_port = self.tier_ports[0]
             # Port bind happens after cache priming (watch_cache.py), so
             # this doubles as the primed signal.  Priming walks the whole
             # store, so the wait must scale with it (1M nodes would blow
             # the default 30s).
             prime_timeout = 30.0 + spec.nodes / 5000.0
-            wait_for_port(
-                self.tier_port, timeout_s=prime_timeout, proc=self._tier
-            )
+            for proc, port in zip(self._tiers, self.tier_ports):
+                wait_for_port(port, timeout_s=prime_timeout, proc=proc)
 
         self.shard_members: list = []
         self._rebalancer = None
@@ -298,10 +314,27 @@ class Cluster:
         """Node-simulation consumers connect through the watch-cache tier
         when deployed (the kubelet→apiserver edge); else to the store.
         With ``tier_tls`` they authenticate like kubelets to an
-        apiserver: rig-CA TLS + bearer token."""
-        return self._client(
-            self.tier_port, secure=self.spec.tier_tls
-        )
+        apiserver: rig-CA TLS + bearer token.  With ``tier_replicas`` > 1
+        consumers are assigned round-robin over the LIVE replicas (the
+        haproxy SRV round-robin role; a killed replica is skipped the
+        way haproxy pulls a dead backend)."""
+        port = self.tier_port
+        if len(self.tier_ports) > 1:
+            for _ in range(len(self.tier_ports)):
+                i = self._tier_rr % len(self.tier_ports)
+                self._tier_rr += 1
+                if self._tiers[i].poll() is None:
+                    port = self.tier_ports[i]
+                    break
+        return self._client(port, secure=self.spec.tier_tls)
+
+    def kill_tier_replica(self, i: int) -> None:
+        """Crash drill: SIGKILL tier replica ``i``.  Consumers connected
+        to it lose their watches (stream reset -> resync, the same
+        contract as a store watch cancel); new consumers round-robin
+        over the survivors."""
+        self._tiers[i].kill()
+        self._tiers[i].wait()
 
     def _webhook_sink(self, obj: dict) -> None:
         if self.shard_members:
@@ -505,14 +538,16 @@ class Cluster:
                 c.close()
             except Exception:
                 pass
-        if self._tier is not None:
-            self._tier.terminate()
+        for tier in self._tiers:
+            tier.terminate()
+        for tier in self._tiers:
             try:
-                self._tier.wait(timeout=10)
+                tier.wait(timeout=10)
             except subprocess.TimeoutExpired:
-                self._tier.kill()
-                self._tier.wait()
-            self._tier = None
+                tier.kill()
+                tier.wait()
+        self._tiers = []
+        self._tier = None
         self._stop_server()
         self._server = None
         if self.log_shipper is not None:

@@ -269,8 +269,11 @@ class Coordinator:
         # bucket is its own compiled executable, so this is opt-in: warm
         # EVERY bucket before a latency-sensitive window or a mid-run
         # compile (tens of seconds on TPU) lands in the tail.
+        # min 64: wave cost is ~linear in B down to a small fixed floor
+        # (measured round 5: 31ms at B=64 vs 82ms at B=256, 131K/pct5
+        # CPU), so smaller buckets directly cut the sub-knee p50.
         self.adaptive_batch = adaptive_batch
-        self.min_batch = min(256, pod_spec.batch)
+        self.min_batch = min(64, pod_spec.batch)
         self._encoders = {pod_spec.batch: self.encoder}
         self.table = None           # device NodeTable, built lazily
         self.constraints = (
@@ -1183,6 +1186,16 @@ class Coordinator:
             self._process_adjusts()
         if batch_pods is not None:
             self._inflights.append(self._launch(batch_pods, batch))
+            if self.adaptive_batch and batch.batch < self.pod_spec.batch:
+                # Light load (partial bucket): pipelining buys no
+                # throughput — the queue is draining faster than it
+                # fills — but holding the wave until the NEXT step adds
+                # 1-2 extra wave times to every pod's latency.  This was
+                # the round-4 "flat 288ms p50 at every sub-knee rate":
+                # 3x the 82ms bucket-256 wave, not the wave itself.
+                # Retire immediately; full buckets keep the deep
+                # pipeline (saturation is where overlap pays).
+                done += self.flush()
         return done
 
     def flush(self) -> int:

@@ -215,6 +215,7 @@ class RemoteStore:
         ca_pem: str | None = None,
         token: str | None = None,
     ):
+        self.target = target
         options = [
             # Match the servers' 64MB caps (etcd_server/watch_cache);
             # the default 4MB rejects a ~12K-object list response.

@@ -355,8 +355,15 @@ def main(argv=None):
                         store, list(zip(keys[emitted:due], values[emitted:due]))
                     )
                     emitted = due
-                    if args.churn:
-                        dels = churn.advance(emitted - lag)
+                if args.churn:
+                    # Advance on EVERY cycle, not only on emission: when
+                    # binds lag the producer (CPU), most land after
+                    # emission finished, and a frontier advanced only on
+                    # emission would leave them pending forever —
+                    # config 5 is a sustained create+DELETE shape, so
+                    # deletions must keep executing through the drain.
+                    dels = churn.advance(emitted - lag)
+                    if dels:
                         write_wave(store, [(keys[i], None) for i in dels])
                         deleted += len(dels)
                 bound += coord.step()
@@ -366,6 +373,13 @@ def main(argv=None):
                     and not coord._inflights
                 ):
                     bound += coord.run_until_idle()
+                    if args.churn:
+                        dels = churn.advance(emitted - lag)
+                        if dels:
+                            write_wave(
+                                store, [(keys[i], None) for i in dels]
+                            )
+                            deleted += len(dels)
                     break
             sched_s = time.perf_counter() - t0
             lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
@@ -417,7 +431,29 @@ def main(argv=None):
                 deleted += len(dels)
             off += wave
             bound += coord.step()
-        bound += coord.run_until_idle()
+        if args.churn:
+            # Drain with the frontier still advancing (same lag): on CPU
+            # most binds land here, after the producer finished, and the
+            # sustained-delete shape must hold through the drain.
+            # Cycle-bounded like run_until_idle: unschedulable pods
+            # retry forever and would otherwise spin this loop forever.
+            idle = 0
+            for _ in range(10_000):
+                n = coord.step()
+                bound += n
+                dels = churn.advance(args.pods - 2 * wave)
+                if dels:
+                    write_wave(store, [(keys[i], None) for i in dels])
+                    deleted += len(dels)
+                if not coord.queue and not coord._inflights:
+                    idle += 1
+                    if idle > 1 and coord.drain_watches() == 0:
+                        break
+                else:
+                    idle = 0
+            bound += coord.flush()
+        else:
+            bound += coord.run_until_idle()
         sched_s = time.perf_counter() - t0
     create_s = sched_s  # creation is inside the measured window
     e2e = bound / sched_s if sched_s else 0.0

@@ -55,7 +55,8 @@ def _tier_rss_mb(pid: int) -> float:
 class MuxWatch:
     """One bidi Watch stream carrying many watches (client side)."""
 
-    def __init__(self, channel: aio.Channel):
+    def __init__(self, channel: aio.Channel, replica: int = 0):
+        self.replica = replica      # which tier replica this stream rides
         self._call = channel.stream_stream(
             "/etcdserverpb.Watch/Watch",
             request_serializer=rpc_pb2.WatchRequest.SerializeToString,
@@ -64,15 +65,28 @@ class MuxWatch:
         self.created = 0
         self.delivered = 0
         self.canceled = 0
+        self.last_rev = 0           # highest event revision seen (any watch)
+        self.create_rev = 0         # revision at watch registration
+        # Per-watch-id resume point: the stream-level max would SKIP
+        # events for a watch whose delivery lagged the stream max.
+        self.watch_rev: dict[int, int] = {}
         self._created_ev = asyncio.Event()
         self._reader = asyncio.create_task(self._read())
 
-    async def create(self, keys: list[bytes], first_id: int) -> None:
+    async def create(
+        self, keys: list[bytes], first_id: int,
+        start_revision: int | list[int] = 0,
+    ) -> None:
         for i, key in enumerate(keys):
             await self._call.write(
                 rpc_pb2.WatchRequest(
                     create_request=rpc_pb2.WatchCreateRequest(
-                        key=key, watch_id=first_id + i
+                        key=key, watch_id=first_id + i,
+                        start_revision=(
+                            start_revision[i]
+                            if isinstance(start_revision, list)
+                            else start_revision
+                        ),
                     )
                 )
             )
@@ -93,8 +107,17 @@ class MuxWatch:
                     self.canceled += 1
                 elif resp.created:
                     self.created += 1
+                    if resp.header.revision > self.create_rev:
+                        self.create_rev = resp.header.revision
                 else:
                     self.delivered += len(resp.events)
+                    for ev in resp.events:
+                        if ev.kv.mod_revision > self.last_rev:
+                            self.last_rev = ev.kv.mod_revision
+                    if resp.events:
+                        r = resp.events[-1].kv.mod_revision
+                        if r > self.watch_rev.get(resp.watch_id, 0):
+                            self.watch_rev[resp.watch_id] = r
         except (asyncio.CancelledError, grpc.RpcError):
             pass
 
@@ -114,6 +137,19 @@ def parse_args(argv=None):
                     help="bidi streams the watches multiplex over")
     ap.add_argument("--writes", type=int, default=20_000)
     ap.add_argument("--index", choices=("hash", "btree"), default="hash")
+    ap.add_argument(
+        "--replicas", type=int, default=1,
+        help="tier replica processes over the ONE store; client streams "
+        "round-robin across them — the reference's 11-apiserver fleet "
+        "behind haproxy SRV round-robin (reference README.adoc:721-723, "
+        "terraform/k8s-server/server.tf:230-251)",
+    )
+    ap.add_argument(
+        "--kill-one", action="store_true",
+        help="crash drill: SIGKILL the last replica halfway through the "
+        "fan-out window, re-attach its hot watches to a survivor from "
+        "the last delivered revision, assert zero event loss",
+    )
     return ap.parse_args(argv)
 
 
@@ -141,46 +177,64 @@ async def amain(args) -> dict:
     if wave:
         await seed.put_batch(wave)
 
-    # Tier as a SUBPROCESS so its RSS is attributable.
+    # Tier replicas as SUBPROCESSES so their RSS is attributable.  N
+    # replicas share the ONE store upstream (each holds its own cache +
+    # upstream watch); client streams round-robin across them — the
+    # reference's 11-apiserver fleet behind haproxy SRV round-robin
+    # (reference README.adoc:721-723, server.tf:230-251).
     from k8s1m_tpu.cluster.harness import _free_port
 
-    tier_port = _free_port()
-    tier_proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
-            "--upstream", f"127.0.0.1:{store_port}",
-            "--host", "127.0.0.1", "--port", str(tier_port),
-            "--prefix", IDLE_PREFIX.decode(),
-            "--prefix", HOT_PREFIX.decode(),
-            "--index", args.index,
-        ],
-        env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
-    )
+    n_rep = max(1, args.replicas)
+    if args.streams < n_rep:
+        args.streams = n_rep        # at least one stream per replica
+    tier_ports = [_free_port() for _ in range(n_rep)]
+    tier_procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+                "--upstream", f"127.0.0.1:{store_port}",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--prefix", IDLE_PREFIX.decode(),
+                "--prefix", HOT_PREFIX.decode(),
+                "--index", args.index,
+            ],
+            env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
+        )
+        for port in tier_ports
+    ]
+    channels = []
     try:
         # The in-process store server shares THIS event loop; a blocking
         # wait_for_port would starve it and deadlock the tier's priming.
         import socket as _socket
 
-        deadline = time.monotonic() + 120 + args.idle / 2000
-        while True:
-            if tier_proc.poll() is not None:
-                raise RuntimeError(f"tier exited rc={tier_proc.returncode}")
-            try:
-                with _socket.create_connection(
-                    ("127.0.0.1", tier_port), timeout=0.2
-                ):
-                    break
-            except OSError:
-                if time.monotonic() > deadline:
-                    raise TimeoutError("tier did not bind")
-                await asyncio.sleep(0.05)
-        rss0 = _tier_rss_mb(tier_proc.pid)
+        deadline = time.monotonic() + 120 + n_rep * args.idle / 2000
+        for proc, port in zip(tier_procs, tier_ports):
+            while True:
+                if proc.poll() is not None:
+                    raise RuntimeError(f"tier exited rc={proc.returncode}")
+                try:
+                    with _socket.create_connection(
+                        ("127.0.0.1", port), timeout=0.2
+                    ):
+                        break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise TimeoutError("tier did not bind")
+                    await asyncio.sleep(0.05)
+        rss0 = sum(_tier_rss_mb(p.pid) for p in tier_procs)
 
-        channel = aio.insecure_channel(
-            f"127.0.0.1:{tier_port}",
-            options=[("grpc.max_receive_message_length", 64 << 20)],
-        )
-        muxes = [MuxWatch(channel) for _ in range(args.streams)]
+        channels = [
+            aio.insecure_channel(
+                f"127.0.0.1:{port}",
+                options=[("grpc.max_receive_message_length", 64 << 20)],
+            )
+            for port in tier_ports
+        ]
+        muxes = [
+            MuxWatch(channels[i % n_rep], replica=i % n_rep)
+            for i in range(args.streams)
+        ]
 
         # Create idle watches round-robin over the streams.
         t0 = time.perf_counter()
@@ -202,27 +256,97 @@ async def amain(args) -> dict:
             await m.wait_created(len(keys), timeout=240)
         create_s = time.perf_counter() - t0
 
-        # Active watches on the hot keys, on stream 0.
-        hot_first = next_id
+        # Active watches on the hot keys: slice r rides replica r (each
+        # hot key watched by exactly ONE stream on one replica).
         hot_keys = [HOT_PREFIX + b"lease-%05d" % i for i in range(args.active)]
-        await muxes[0].create(hot_keys, hot_first)
-        await muxes[0].wait_created(per + args.active, timeout=120)
+        hot_per = (args.active + n_rep - 1) // n_rep
+        hot_slices = []             # (mux, keys, first_id) per replica
+        for r in range(n_rep):
+            keys = hot_keys[r * hot_per : (r + 1) * hot_per]
+            if not keys:
+                continue
+            hot_slices.append((muxes[r], keys, next_id))
+            await muxes[r].create(keys, next_id)
+            next_id += len(keys)
+        for m, keys, _ in hot_slices:
+            base_idle = sum(
+                len(k) for mm, k, _ in creates if mm is m
+            )
+            await m.wait_created(base_idle + len(keys), timeout=120)
 
-        rss1 = _tier_rss_mb(tier_proc.pid)
+        rss1 = sum(_tier_rss_mb(p.pid) for p in tier_procs)
         store_watchers = store.stats()["watchers"]
 
-        # Live fan-out: write the hot keys while 100K idle watches sit
-        # attached; every write fans to exactly one active watch.
+        # Live fan-out: write the hot keys while the idle watches sit
+        # attached; every write fans to exactly one active watch.  With
+        # --kill-one, SIGKILL the last replica halfway and re-attach its
+        # hot watches to a survivor from the last delivered revision —
+        # the haproxy-pulls-a-dead-backend drill.
         t0 = time.perf_counter()
         written = 0
+        killed_at = None
+        lost_idle = 0
         base_delivered = sum(m.delivered for m in muxes)
         while written < args.writes:
-            n = min(2000, args.writes - written)
+            # Batch bounded by writes/4 so a --kill-one drill always
+            # lands MID-stream, even on small smoke runs.
+            n = min(2000, max(1, args.writes // 4), args.writes - written)
             await seed.put_batch([
                 (hot_keys[(written + i) % args.active], b"%d" % (written + i))
                 for i in range(n)
             ])
             written += n
+            if (
+                args.kill_one and n_rep > 1 and killed_at is None
+                and written >= args.writes // 2
+            ):
+                killed_at = written
+                victim = n_rep - 1
+                tier_procs[victim].kill()
+                tier_procs[victim].wait()
+                dead_muxes = [m for m in muxes if m.replica == victim]
+                # Join the dead streams' readers BEFORE reading their
+                # resume revisions: grpc may still hold buffered
+                # responses the reader task hasn't processed — a
+                # snapshot taken early would make the survivor replay
+                # revisions the dead stream then also counts
+                # (duplicates).
+                for dm in dead_muxes:
+                    await dm.close()
+                lost_idle = sum(
+                    len(k) for mm, k, _ in creates if mm in dead_muxes
+                )
+                # Re-attach the victim's hot watches to replica 0 from
+                # the last revision each dead stream delivered: replay
+                # from the survivor's history window, no gap.
+                for m, rkeys, first in hot_slices:
+                    if m.replica != victim:
+                        continue
+                    # PER-WATCH resume point: the watch's own last
+                    # delivered revision, or — when it never delivered
+                    # (deliveries lag writes on a loaded tier) — the
+                    # revision it was REGISTERED at: everything after
+                    # that is owed, and start_revision=1 would fall
+                    # below the survivor's replay window
+                    # (compact-cancel).  No loss, no duplicates.
+                    resume_from = [
+                        max(m.watch_rev.get(first + i, 0), m.create_rev)
+                        + 1
+                        for i in range(len(rkeys))
+                    ]
+                    resume = MuxWatch(channels[0], replica=0)
+                    await resume.create(
+                        rkeys, first, start_revision=resume_from
+                    )
+                    try:
+                        await resume.wait_created(len(rkeys), timeout=60)
+                    except TimeoutError as e:
+                        raise TimeoutError(
+                            f"{e}; canceled={resume.canceled} "
+                            f"resume_from={resume_from} "
+                            f"survivor_alive={tier_procs[0].poll() is None}"
+                        ) from None
+                    muxes.append(resume)
         # Wait for deliveries to drain.
         deadline = time.monotonic() + 120
         while (
@@ -235,23 +359,27 @@ async def amain(args) -> dict:
 
         for m in muxes:
             await m.close()
-        await channel.close()
+        for channel in channels:
+            await channel.close()
     finally:
-        tier_proc.terminate()
-        try:
-            tier_proc.wait(timeout=10)
-        except Exception:
-            tier_proc.kill()
+        for p in tier_procs:
+            p.terminate()
+        for p in tier_procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
         await seed.close()
         wf.close()
         store.close()
 
     total_watches = args.idle + args.active
-    return {
+    out = {
         "metric": "tier_concurrent_watches",
         "value": total_watches,
         "unit": "watches",
         "vs_baseline": round(total_watches / 18_000_000, 4),
+        "replicas": n_rep,
         "create_per_sec": round(args.idle / create_s, 1),
         "tier_rss_mb": round(rss1, 1),
         "kb_per_watch": round((rss1 - rss0) * 1024.0 / total_watches, 2),
@@ -260,6 +388,13 @@ async def amain(args) -> dict:
         "delivered_per_sec": round(delivered / window, 1),
         "canceled": sum(m.canceled for m in muxes),
     }
+    if killed_at is not None:
+        out["kill_one"] = {
+            "killed_after_writes": killed_at,
+            "lost_idle_watches": lost_idle,
+            "no_event_loss": delivered >= args.writes,
+        }
+    return out
 
 
 def main(argv=None):

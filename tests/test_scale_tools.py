@@ -85,3 +85,25 @@ def test_watch_scale_smoke_mux_and_fanout():
     assert out["delivered"] == writes
     assert out["canceled"] == 0
     assert out["create_per_sec"] > 0
+
+
+def test_watch_scale_replicas_kill_one_no_loss():
+    """Replicated tier drill: 3 caches over one store, hot watches
+    spread across replicas, the last replica SIGKILLed mid-fan-out, its
+    watches re-attached to a survivor from per-watch resume revisions —
+    every write still delivered exactly once (the haproxy
+    pulls-a-dead-backend contract, reference README.adoc:721-723)."""
+    idle, active, writes = 600, 90, 600
+    out = _run(
+        [
+            sys.executable, "-m", "k8s1m_tpu.tools.watch_scale",
+            "--idle", str(idle), "--active", str(active),
+            "--writes", str(writes), "--replicas", "3", "--kill-one",
+        ],
+        timeout=420,
+    )
+    assert out["replicas"] == 3
+    assert out["store_watchers"] == 6       # 3 replicas x 2 prefixes
+    assert out["delivered"] == writes       # no loss, no duplicates
+    assert out["kill_one"]["no_event_loss"] is True
+    assert out["kill_one"]["lost_idle_watches"] > 0

@@ -260,6 +260,9 @@ def main(argv=None):
     nodes_s = time.perf_counter() - t0
 
     cap = 1 << max(10, (args.nodes - 1).bit_length())
+    # The chunked scan needs chunk <= table rows (both powers of two
+    # here); the per-backend default assumes a big table.
+    args.chunk = min(args.chunk, cap)
     mesh = None
     if args.mesh:
         from k8s1m_tpu.parallel import make_mesh
@@ -330,6 +333,17 @@ def main(argv=None):
             woff += b
             write_wave(store, list(zip(ks, vs)))
             coord.run_until_idle()
+        # Over a remote target the warm pods' watch events may still be
+        # in flight when run_until_idle sees an empty queue — any warm
+        # pod binding INSIDE the measured window inflates binds/s.
+        # Drain until the whole warm population is accounted for.
+        warm_deadline = time.perf_counter() + 30.0
+        while (
+            sum(1 for k in coord._bound if k.startswith("warm2/")) < woff
+            and time.perf_counter() < warm_deadline
+        ):
+            coord.run_until_idle()
+            time.sleep(0.05)
         REGISTRY.get("coordinator_schedule_to_bind_seconds").reset()
         if args.stats:
             REGISTRY.get("coordinator_cycle_seconds").reset()
@@ -337,9 +351,10 @@ def main(argv=None):
 
         # Paced producer: emit pods on the offered-load schedule, step
         # the coordinator continuously, measure intake-to-bind latency.
-        # --churn deletes BOUND pods a fixed lag behind the emission
-        # point (config 5's sustained create+delete shape at a rate).
-        lag = 3 * coord.pod_spec.batch
+        # --churn deletes BOUND pods a lag behind the emission point
+        # (config 5's sustained create+delete shape at a rate); the lag
+        # is capped at a quarter of the run so short runs still delete.
+        lag = min(3 * coord.pod_spec.batch, max(args.pods // 4, 64))
         t0 = time.perf_counter()
         bound = 0
         emitted = 1

@@ -1,0 +1,336 @@
+"""Secured-tier churn soak: the week-long-watch scenario at bench scale.
+
+The reference's apiserver findings are about what survives TIME: 18M
+kubelet watches held for days over a control plane sustaining continuous
+create/bind/delete churn (reference README.adoc:410-416, 721-730).  This
+driver runs that shape end to end for ``--seconds`` (default 600):
+
+  native store server  <-TLS+bearer-  watch-cache tier  <-TLS+bearer-
+  { an idle watch population (mux streams, never written),
+    a hot canary watch set,
+    sched_bench --churn --rate  (create -> schedule -> CAS bind ->
+    delete, the full coordinator loop) }
+
+while sampling the tier's and the store server's RSS every
+``--sample-every`` seconds.  Pass criteria, printed as one JSON line and
+written (with the RSS series) to ``--out``:
+
+- ``rss_flat``: neither process's RSS trend grows more than
+  ``--max-growth-pct`` between the first and last thirds of the window
+  (no per-watch or per-event leak);
+- ``canceled == 0``: the idle population survives the whole soak (the
+  round-4 flow-control hardening exists precisely so long-lived streams
+  never stall out);
+- ``stalls == 0``: after the churn window every canary watch still
+  delivers a fresh write within ``--canary-timeout`` seconds — the
+  streams are live, not just uncanceled.
+
+    python -m k8s1m_tpu.tools.soak --seconds 600 --idle 5000 --rate 300
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+from grpc import aio
+
+IDLE_PREFIX = b"/registry/configmaps/soak/"
+CANARY_PREFIX = b"/registry/leases/soak/"
+
+
+def _rss_mb(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description="secured-tier churn soak")
+    ap.add_argument("--seconds", type=float, default=600.0,
+                    help="churn window length (the soak proper)")
+    ap.add_argument("--idle", type=int, default=5000,
+                    help="idle watch population held through the tier")
+    ap.add_argument("--canaries", type=int, default=32,
+                    help="hot watches probed for liveness at the end")
+    ap.add_argument("--rate", type=int, default=300,
+                    help="offered churn load (pods/s) for sched_bench")
+    ap.add_argument("--nodes", type=int, default=16384)
+    ap.add_argument("--sample-every", type=float, default=5.0)
+    ap.add_argument("--compact-every", type=float, default=60.0,
+                    help="periodic MVCC compaction interval (the "
+                    "apiserver's --etcd-compaction-interval role; "
+                    "without it sustained churn grows store history "
+                    "unboundedly by design)")
+    ap.add_argument("--max-growth-pct", type=float, default=10.0,
+                    help="max allowed RSS growth, first vs last third "
+                    "of the post-warmup series")
+    ap.add_argument("--warmup", type=float, default=180.0,
+                    help="seconds excluded from the RSS-flatness gate: "
+                    "watch history windows, MVCC steady-state population "
+                    "and allocator arenas legitimately fill during "
+                    "ramp-up; a LEAK keeps growing after it")
+    ap.add_argument("--canary-timeout", type=float, default=30.0)
+    ap.add_argument("--out", default="artifacts/soak_secured_tier.json")
+    args = ap.parse_args(argv)
+    if args.rate <= 0:
+        ap.error("--rate must be > 0 (the soak is a paced-churn shape; "
+                 "sched_bench's rate=0 branch reports different fields)")
+    return args
+
+
+async def _wait_port(port: int, proc, deadline_s: float) -> None:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(f"subprocess exited rc={proc.returncode}")
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+                return
+        except OSError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"port {port} never bound")
+            await asyncio.sleep(0.1)
+
+
+async def amain(args) -> dict:
+    from k8s1m_tpu.cluster.certs import provision
+    from k8s1m_tpu.cluster.harness import _free_port
+    from k8s1m_tpu.store.etcd_client import EtcdClient, secure_channel_for
+    from k8s1m_tpu.tools.watch_scale import MuxWatch
+
+    certs_dir = tempfile.mkdtemp(prefix="soak-certs-")
+    certs = provision(certs_dir)
+    token = "soak-bearer-token"
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+
+    store_port = _free_port()
+    wal_dir = tempfile.mkdtemp(prefix="soak-wal-")
+    store_proc = subprocess.Popen(
+        [sys.executable, "-m", "k8s1m_tpu.store.server_main",
+         "--port", str(store_port), "--host", "127.0.0.1",
+         "--metrics-port", "0", "--wal-dir", wal_dir, "--wire", "native"],
+        env=env,
+    )
+    tier_port = _free_port()
+    tier_proc = None
+    procs = [store_proc]
+    try:
+        await _wait_port(store_port, store_proc, 60)
+        # Seed the idle/canary objects BEFORE the tier primes.
+        seed = EtcdClient(f"127.0.0.1:{store_port}")
+        wave = []
+        for i in range(args.idle):
+            wave.append((IDLE_PREFIX + b"cm-%06d" % i, b'{"data":{}}'))
+            if len(wave) == 4096:
+                await seed.put_batch(wave)
+                wave.clear()
+        for i in range(args.canaries):
+            wave.append((CANARY_PREFIX + b"canary-%03d" % i, b"0"))
+        if wave:
+            await seed.put_batch(wave)
+
+        tier_proc = subprocess.Popen(
+            [sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+             "--upstream", f"127.0.0.1:{store_port}",
+             "--host", "127.0.0.1", "--port", str(tier_port),
+             "--prefix", "/registry/",
+             "--tls-cert", certs.cert_pem, "--tls-key", certs.key_pem,
+             "--auth-token", token],
+            env=env,
+        )
+        procs.append(tier_proc)
+        await _wait_port(tier_port, tier_proc, 120 + args.idle / 1000)
+
+        # Idle + canary populations through the SECURED tier.
+        channel = secure_channel_for(
+            f"127.0.0.1:{tier_port}", certs.ca_pem, token,
+            options=[("grpc.max_receive_message_length", 64 << 20)],
+        )
+        muxes = [MuxWatch(channel) for _ in range(4)]
+        per = (args.idle + len(muxes) - 1) // len(muxes)
+        next_id = 1
+        counts = []
+        for m in muxes:
+            lo = next_id - 1
+            keys = [IDLE_PREFIX + b"cm-%06d" % (lo + i)
+                    for i in range(max(0, min(per, args.idle - lo)))]
+            await m.create(keys, next_id)
+            counts.append(len(keys))
+            next_id += len(keys)
+        for m, n in zip(muxes, counts):
+            await m.wait_created(n, timeout=120 + args.idle / 500)
+        canary = MuxWatch(channel)
+        canary_keys = [CANARY_PREFIX + b"canary-%03d" % i
+                       for i in range(args.canaries)]
+        await canary.create(canary_keys, next_id)
+        await canary.wait_created(args.canaries, timeout=60)
+
+        # Churn through the tier: the full coordinator loop as a
+        # subprocess (create -> watch -> schedule -> CAS bind -> delete)
+        # at the offered rate for the whole window.
+        pods = max(1000, int(args.rate * args.seconds))
+        bench_proc = subprocess.Popen(
+            [sys.executable, "-m", "k8s1m_tpu.tools.sched_bench",
+             "--nodes", str(args.nodes), "--pods", str(pods),
+             "--rate", str(args.rate), "--score-pct", "5",
+             "--backend", "xla", "--churn",
+             "--target", f"127.0.0.1:{tier_port}",
+             "--ca-pem", certs.ca_pem, "--token", token],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+        procs.append(bench_proc)
+
+        # RSS sampler over the churn window, with periodic MVCC
+        # compaction (keep a revision margin so the tier's watch
+        # resume window stays usable).
+        series = []
+        t0 = time.monotonic()
+        next_compact = t0 + args.compact_every
+        while bench_proc.poll() is None:
+            if time.monotonic() >= next_compact:
+                next_compact = time.monotonic() + args.compact_every
+                try:
+                    st = await seed.status()
+                    target = st.header.revision - 5000
+                    if target > 1:
+                        await seed.compact(target)
+                except Exception:
+                    pass    # compaction is best-effort in the soak
+            series.append({
+                "t_s": round(time.monotonic() - t0, 1),
+                "tier_rss_mb": round(_rss_mb(tier_proc.pid), 1),
+                "store_rss_mb": round(_rss_mb(store_proc.pid), 1),
+                "idle_canceled": sum(m.canceled for m in muxes),
+            })
+            # Sleep in short slices so a finished bench is noticed
+            # within ~0.5s, not a full sample interval late.
+            slept = 0.0
+            while slept < args.sample_every and bench_proc.poll() is None:
+                await asyncio.sleep(0.5)
+                slept += 0.5
+            if time.monotonic() - t0 > args.seconds + 900:
+                bench_proc.kill()
+                raise TimeoutError("churn bench overran the window")
+        bench_out = bench_proc.stdout.read()
+        if bench_proc.returncode != 0 or not bench_out.strip():
+            raise RuntimeError(
+                f"churn bench rc={bench_proc.returncode}, "
+                f"stdout={bench_out!r}"
+            )
+        bench_line = json.loads(bench_out.strip().splitlines()[-1])
+        soak_s = time.monotonic() - t0
+
+        # Liveness probe: every canary stream must deliver a fresh write.
+        base = canary.delivered
+        for i, k in enumerate(canary_keys):
+            await seed.put(k, b"alive-%d" % i)
+        deadline = time.monotonic() + args.canary_timeout
+        while (
+            canary.delivered - base < args.canaries
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.1)
+        stalls = args.canaries - (canary.delivered - base)
+
+        canceled = sum(m.canceled for m in muxes) + canary.canceled
+
+        # RSS trend: mean of the first vs last third of the POST-WARMUP
+        # series (the ramp legitimately fills caches/arenas; a leak
+        # keeps growing after it).
+        # Short runs can't honor the full warmup; scale it down rather
+        # than silently gating on the startup ramp (which would fail a
+        # leak-free run).
+        horizon = series[-1]["t_s"] if series else 0.0
+        warmup = min(args.warmup, horizon / 3)
+
+        def trend(key):
+            vals = [
+                s[key] for s in series
+                if s[key] > 0 and s["t_s"] >= warmup
+            ]
+            if len(vals) < 6:
+                return 0.0, 0.0
+            third = len(vals) // 3
+            first = sum(vals[:third]) / third
+            last = sum(vals[-third:]) / third
+            return first, last
+
+        tier_first, tier_last = trend("tier_rss_mb")
+        store_first, store_last = trend("store_rss_mb")
+        growth = {
+            "tier_pct": round(100 * (tier_last - tier_first)
+                              / max(tier_first, 1e-9), 2),
+            "store_pct": round(100 * (store_last - store_first)
+                               / max(store_first, 1e-9), 2),
+        }
+        rss_flat = (
+            growth["tier_pct"] <= args.max_growth_pct
+            and growth["store_pct"] <= args.max_growth_pct
+        )
+
+        for m in muxes:
+            await m.close()
+        await canary.close()
+        await channel.close()
+        await seed.close()
+
+        result = {
+            "metric": "soak_secured_tier_seconds",
+            "value": round(soak_s, 1),
+            "unit": "s",
+            "vs_baseline": None,
+            "passed": bool(rss_flat and canceled == 0 and stalls == 0),
+            "rss_flat": rss_flat,
+            "rss_growth": growth,
+            "canceled": canceled,
+            "stalls": stalls,
+            "idle_watches": args.idle,
+            "churn": {
+                "rate": args.rate,
+                "bound": bench_line["detail"]["bound"],
+                "deleted": bench_line["detail"]["deleted"],
+                "binds_per_sec": bench_line["detail"]["binds_per_sec"],
+                "p50_ms": bench_line["detail"]["p50_ms"],
+            },
+            "samples": len(series),
+        }
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                json.dump({**result, "rss_series": series}, f, indent=1)
+        return result
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        import shutil
+
+        for d in (certs_dir, wal_dir):
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    print(json.dumps(asyncio.run(amain(args))))
+
+
+if __name__ == "__main__":
+    main()

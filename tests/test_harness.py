@@ -357,4 +357,4 @@ def test_log_aggregation_one_jsonl_per_run(tmp_path):
             assert {"ts", "src", "line"} <= set(rec)
             srcs.add(rec["src"])
     # Both subprocesses logged at least their startup line.
-    assert {"store", "tier"} <= srcs, srcs
+    assert {"store", "tier-0"} <= srcs, srcs   # tier sources are replica-indexed

@@ -242,3 +242,32 @@ def test_coordinator_mesh_constraints(store):
     coord.run_until_idle()
     after = int(np.asarray(coord.constraints.spread_zone).sum())
     assert after == before - 1
+
+
+def test_sharded_packed_pallas_backend_matches_xla():
+    """The mesh step's pallas path (what a v5e-8 run uses): interpreted
+    on the CPU mesh, bit-compared against the sharded XLA path — both
+    backends share the separable tie-break hash, so placements must be
+    IDENTICAL, not just equivalent (the single-device parity contract,
+    tests/test_pallas_topk.py, extended over shard_map)."""
+    host, packed = build(num_nodes=64, num_pods=16)
+    mesh = make_mesh(dp=2, sp=4)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P("sp"))
+    key = jax.random.key(7)
+    t_x, _, a_x, rows_x = schedule_batch_packed(
+        host.to_device(sharding), packed, key,
+        profile=PROFILE, chunk=8, k=4, backend="xla", mesh=mesh,
+    )
+    t_p, _, a_p, rows_p = schedule_batch_packed(
+        host.to_device(sharding), packed, key,
+        profile=PROFILE, chunk=8, k=4, backend="pallas", mesh=mesh,
+    )
+    np.testing.assert_array_equal(np.asarray(rows_x), np.asarray(rows_p))
+    np.testing.assert_array_equal(
+        np.asarray(a_x.score), np.asarray(a_p.score)
+    )
+    assert int(np.asarray(t_x.cpu_req).sum()) == int(
+        np.asarray(t_p.cpu_req).sum()
+    )

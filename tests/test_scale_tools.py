@@ -107,3 +107,25 @@ def test_watch_scale_replicas_kill_one_no_loss():
     assert out["delivered"] == writes       # no loss, no duplicates
     assert out["kill_one"]["no_event_loss"] is True
     assert out["kill_one"]["lost_idle_watches"] > 0
+
+
+def test_soak_smoke_secured_tier():
+    """Short secured-tier soak: idle watches + canaries + churn through
+    TLS+bearer, RSS sampled, zero cancels, zero stalls.  The committed
+    10-minute artifact (artifacts/soak_secured_tier.json) is the real
+    measurement; this pins the machinery."""
+    out = _run(
+        [
+            sys.executable, "-m", "k8s1m_tpu.tools.soak",
+            "--seconds", "12", "--idle", "150", "--rate", "80",
+            "--nodes", "4096", "--canaries", "8",
+            "--out", "",            # no artifact from the smoke
+        ],
+        timeout=420,
+    )
+    assert out["canceled"] == 0
+    assert out["stalls"] == 0
+    assert out["churn"]["bound"] > 0
+    assert out["churn"]["deleted"] > 0
+    assert out["samples"] >= 2
+    # rss_flat is NOT asserted: a 12s window is all startup transient.

@@ -128,6 +128,12 @@ def main():
         args.score_pct = 5
     if not 1 <= args.score_pct <= 100:
         ap.error("--score-pct must be in [1, 100]")
+    # Deadline discipline: a bench that might hang must NOT be wrapped in
+    # coreutils `timeout` — SIGTERM mid-TPU-op loses the axon grant and
+    # takes the pool down for minutes (observed round 5).  Run hang-prone
+    # configs via `python tools/with_deadline.py <s> bench.py ...`, which
+    # self-exits in-process (with a SIGKILL backstop only after the op is
+    # already presumed dead).
     _require_device()
     # Rotating sample window, the coordinator's exact rule (engine helpers).
     sample_rows = sample_rows_for(args.nodes, args.score_pct, args.chunk)

@@ -13,23 +13,48 @@
 # Round-5 deltas: set -o pipefail (round-4 advisor: `cmd | tail -1` took
 # tail's rc=0, so timed-out benches were recorded as silently-empty
 # entries); batch re-ordered most-valuable-first and extended with the
-# on-silicon pallas exactness suite (the kernel's topk/tie-break rewrite
-# has never executed compiled) and the 2K-20K latency-curve sweep.
+# on-silicon pallas exactness suite and the 2K-20K latency-curve sweep.
+#
+# HARD RULE (learned mid-round-5, the expensive way): NEVER put
+# coreutils `timeout` around a live TPU command.  SIGTERM mid-TPU-op
+# loses the axon grant and the pool refuses new clients for many
+# minutes — one timeout-killed bench knocked the pool over for the rest
+# of the batch.  Every item below self-deadlines IN-PROCESS via
+# tools/with_deadline.py (threading.Timer -> os._exit(4)), which the
+# relay tolerates.  Between items, a cheap probe re-checks the pool and
+# waits for it to come back rather than burning the remaining items on
+# rc=3 fast-fails.
 set -o pipefail
 cd /root/repo
 out=BENCH_RECOVERY.md
-while true; do
-  if python -u -c "
+
+probe() {
+  python -u -c "
 import threading, os
 t = threading.Timer(250.0, lambda: os._exit(3)); t.daemon = True; t.start()
 import jax
 print(jax.devices()[0], flush=True)
 os._exit(0)
-" > /tmp/tpu_probe5.out 2>&1; then
-    break
-  fi
-  sleep 150
-done
+" > /tmp/tpu_probe5.out 2>&1
+}
+
+wait_for_pool() {
+  until probe; do sleep 150; done
+}
+
+# Mid-batch variant: bounded (~1h).  If the pool stays down that long,
+# the batch must still TERMINATE — write the failure rows and the
+# closing fence rather than spinning forever with a malformed artifact.
+wait_for_pool_bounded() {
+  local tries=${1:-24}
+  for _ in $(seq 1 "$tries"); do
+    if probe; then return 0; fi
+    sleep 150
+  done
+  return 1
+}
+
+wait_for_pool
 
 date -u +%FT%TZ > /tmp/tpu_up
 {
@@ -39,29 +64,47 @@ date -u +%FT%TZ > /tmp/tpu_up
   echo '```'
 } > "$out"
 
-run() {  # run <label> <timeout> <cmd...>
+pool_lost=0
+run() {  # run <label> <deadline_s> <python-args...>
   local label=$1 to=$2; shift 2
   echo "## $label" >> "$out"
-  timeout "$to" "$@" 2>/tmp/recovery_err.log | tail -1 >> "$out" \
+  if [ "$pool_lost" = 1 ]; then
+    echo "(skipped — pool lost earlier in the batch)" >> "$out"
+    return
+  fi
+  python tools/with_deadline.py "$to" "$@" 2>/tmp/recovery_err.log \
+      | tail -1 >> "$out" \
     || echo "(rc=$? — see /tmp/recovery_err.log)" >> "$out"
+  # If that item lost the pool, wait (bounded) before the next one
+  # rather than burning the rest of the batch on rc=3 fast-fails.
+  if ! wait_for_pool_bounded; then
+    pool_lost=1
+    echo "(pool did not answer within ~1h after this item; remaining items skipped)" >> "$out"
+  fi
 }
 
 # Most-valuable-first: if the pool drops again mid-batch, the top
-# entries are the ones the round is judged on.
-run "headline pallas pct5 1M"       1800 python bench.py
-run "xla pct5 1M (post topk+hash)"  1800 python bench.py --backend xla
-run "constraints pallas 1M pct5"    2400 python bench.py --constraints --backend pallas --nodes 1048576
-run "pallas exactness on silicon"   2400 env K8S1M_TEST_REEXEC=1 \
-    python -m pytest tests/test_pallas_topk.py -x -q
-run "xla pct100 1M"                 1800 python bench.py --backend xla --score-pct 100
-run "pallas pct100 1M"              1800 python bench.py --score-pct 100
-run "affinity config 2"             1800 python bench.py --affinity --score-pct 100 --nodes 65536
-run "constraints xla 1M pct5"       2400 python bench.py --constraints --nodes 1048576
-run "e2e sched_bench 1M pct5"       3600 python -m k8s1m_tpu.tools.sched_bench \
+# entries are the ones the round is judged on.  The xla-1M rows sit at
+# the BOTTOM: the round-4 scan rewrite hangs >30min compiling at 1M on
+# the chip path (observed), and a hung item should cost the batch its
+# tail, not its head.
+run "headline pallas pct5 1M"       1800 bench.py
+run "constraints pallas 1M pct5"    2400 bench.py --constraints --backend pallas --nodes 1048576
+# K8S1M_TEST_REEXEC=1 keeps pytest on the real TPU backend (conftest
+# would otherwise re-exec it onto the virtual CPU mesh).
+K8S1M_TEST_REEXEC=1 \
+run "pallas exactness on silicon"   2400 -m pytest tests/test_pallas_topk.py -x -q
+run "pallas pct100 1M"              1800 bench.py --score-pct 100
+run "affinity config 2"             1800 bench.py --affinity --score-pct 100 --nodes 65536
+run "e2e sched_bench 1M pct5"       3600 -m k8s1m_tpu.tools.sched_bench \
     --nodes 1048576 --pods 200000 --score-pct 5 --stats
-run "e2e p50 at 10.5K/s"            3600 python -m k8s1m_tpu.tools.sched_bench \
+run "e2e p50 at 10.5K/s"            3600 -m k8s1m_tpu.tools.sched_bench \
     --nodes 1048576 --pods 150000 --score-pct 5 --rate 10500
-run "latency curve 2K-20K (chip)"   7200 python -m k8s1m_tpu.tools.latency_curve \
+run "latency curve 2K-20K (chip)"   7200 -m k8s1m_tpu.tools.latency_curve \
     --nodes 1048576 --backend pallas --out artifacts/latency_curve_tpu.jsonl
+run "xla pct5 256K (scan diag)"     1500 bench.py --backend xla --nodes 262144
+run "xla pct5 1M (post topk+hash)"  1800 bench.py --backend xla
+run "xla pct100 1M"                 1800 bench.py --backend xla --score-pct 100
+run "constraints xla 1M pct5"       2400 bench.py --constraints --nodes 1048576
 echo '```' >> "$out"
 date -u +%FT%TZ > /tmp/recovery_done

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Run a python module/script with an IN-PROCESS wall-clock deadline.
+
+    python tools/with_deadline.py 1800 bench.py --backend xla
+    python tools/with_deadline.py 2400 -m k8s1m_tpu.tools.sched_bench --nodes ...
+    python tools/with_deadline.py 2400 -m pytest tests/test_pallas_topk.py -x -q
+
+Why not ``timeout(1)``: SIGTERM-killing a process mid-TPU-op loses the
+axon grant and the pool refuses new clients for many minutes afterwards
+(observed round 5: one timeout-killed bench took the pool down for the
+rest of the batch).  The deadline comes from INSIDE the process —
+threading.Timer → os._exit(4) — which the relay tolerates.  Same rule as
+the recovery daemon's self-exiting probes (tools/recovery_daemon.sh).
+
+Backstop: the in-process timer thread needs the GIL to fire; a hung
+native call that holds the GIL would defeat it.  A forked watchdog child
+SIGKILLs this process at deadline + 120s — by then the op has been hung
+for two minutes past its budget, so the grant is presumed lost already
+and an OS-level kill costs nothing extra.  The watchdog exits on its own
+when the parent dies first (normal case).
+"""
+
+import os
+import runpy
+import signal
+import sys
+import threading
+import time
+
+KILL_SLACK_S = 120.0
+
+
+def _spawn_watchdog(deadline_s: float) -> None:
+    """Fork a child that SIGKILLs us if we outlive deadline + slack."""
+    parent = os.getpid()
+    pid = os.fork()
+    if pid != 0:
+        return  # parent continues into the payload
+    # Watchdog child: poll the parent; never touches jax/TPU.
+    end = time.monotonic() + deadline_s + KILL_SLACK_S
+    while time.monotonic() < end:
+        time.sleep(5.0)
+        try:
+            os.kill(parent, 0)
+        except OSError:
+            os._exit(0)  # parent already gone
+    try:
+        sys.stderr.write(
+            f"with_deadline: watchdog SIGKILL at deadline+{KILL_SLACK_S:.0f}s "
+            "(in-process timer never fired — GIL-holding hang)\n"
+        )
+        sys.stderr.flush()
+        os.kill(parent, signal.SIGKILL)
+    except OSError:
+        pass
+    os._exit(0)
+
+
+def main():
+    if len(sys.argv) < 3:
+        print(__doc__, file=sys.stderr)
+        raise SystemExit(2)
+    deadline = float(sys.argv[1])
+
+    def die():
+        print(
+            f"with_deadline: {deadline:.0f}s exceeded — self-exiting rc=4",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(4)
+
+    _spawn_watchdog(deadline)
+    t = threading.Timer(deadline, die)
+    t.daemon = True
+    t.start()
+
+    if sys.argv[2] == "-m":
+        mod = sys.argv[3]
+        sys.argv = [mod] + sys.argv[4:]
+        runpy.run_module(mod, run_name="__main__", alter_sys=True)
+    else:
+        path = sys.argv[2]
+        sys.argv = [path] + sys.argv[3:]
+        runpy.run_path(path, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -137,7 +137,7 @@ def _slice_table(table: NodeTable, start, chunk: int) -> NodeTable:
 
 def topk_by_argmax(prio, k: int):
     """``lax.top_k`` semantics (descending values, earlier index wins
-    ties) as k argmax knock-out passes.
+    ties) as k argmax knock-out passes — the CPU-backend form.
 
     The chunk scan only ever needs tiny k (4) over wide rows (the node
     chunk): a full TopK sort is the wrong primitive — XLA CPU's TopK
@@ -146,6 +146,15 @@ def topk_by_argmax(prio, k: int):
     already extracts its running top-k by repeated max for the same
     reason (ops/pallas_topk.py).  k linear passes beat one sort on both
     backends whenever k is small.
+
+    On TPU this form is the wrong one: XLA-TPU hung >30min compiling the
+    1M-node scan built on it (round-5 chip batch; the same program
+    compiles in 14.5s and runs fine on XLA CPU), while `lax.top_k` — a
+    native TPU primitive — compiled the identical scan in ~40s pre-round-4.
+    `chunk_topk` below picks per backend; both forms implement exactly
+    top_k's tie rule (descending, earlier index wins), so backend parity
+    (pallas vs xla bit-identical, tests/test_pallas_topk.py) is
+    unaffected by the switch.
 
     A grouped tournament variant (one max pass + per-extraction rescans
     of only the winning 128-wide group) measured 8x faster standalone
@@ -173,6 +182,24 @@ def topk_by_argmax(prio, k: int):
         jnp.concatenate(vals, axis=-1),
         jnp.concatenate(idxs, axis=-1),
     )
+
+
+def chunk_topk(prio, k: int):
+    """Per-backend top-k over the chunk axis (see topk_by_argmax doc).
+
+    CPU: k argmax knock-out passes (TopK custom-call is ~100x slower).
+    TPU/other: native ``lax.top_k`` (the knock-out form hangs XLA-TPU's
+    compiler at 1M-node scan sizes).  Identical semantics either way
+    PROVIDED the input never contains int32 min — the knock-out's own
+    sentinel; ``pack_hashed`` emits {-1} ∪ [0, int32max], so the packed
+    -priority domain satisfies this (asserted by
+    test_topk_by_argmax_matches_lax_top_k).  The backend choice is
+    trace-time static, so this costs nothing inside jit.
+    """
+    if jax.default_backend() == "cpu":
+        return topk_by_argmax(prio, k)
+    top, idx = lax.top_k(prio, k)
+    return top, idx.astype(jnp.int32)
 
 
 def merge_topk(a: Candidates, b: Candidates, k: int) -> Candidates:
@@ -246,7 +273,7 @@ def filter_score_topk(
             lax.broadcasted_iota(jnp.int32, (1, chunk), 1) + start
         )
         prio = pack_hashed(score, seed, mask, pod_rows, node_cols)
-        top_prio, idx = topk_by_argmax(prio, k)                 # [B, k]
+        top_prio, idx = chunk_topk(prio, k)                     # [B, k]
         free_cpu, free_mem, free_pods = tchunk.free()
         local = Candidates(
             idx=(idx + start + row_offset).astype(jnp.int32),

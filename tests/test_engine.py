@@ -140,3 +140,38 @@ def test_sampled_window_with_constraints_matches_full():
     np.testing.assert_array_equal(outs[0][0], outs[1][0])
     np.testing.assert_array_equal(outs[0][1], outs[1][1])
     assert (outs[0][0] >= 0).sum() == 24
+
+
+def test_topk_by_argmax_matches_lax_top_k():
+    """chunk_topk's two forms must stay interchangeable.
+
+    chunk_topk dispatches per backend (knock-out argmax on CPU,
+    lax.top_k on TPU), so the CPU suite would otherwise never assert the
+    equivalence the dispatch relies on.  lax.top_k runs on CPU too:
+    compare the forms directly on duplicate-heavy int32 inputs,
+    including all-equal rows and sentinel-min priorities.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from k8s1m_tpu.engine.cycle import topk_by_argmax
+
+    # Domain note: pack_hashed emits {-1 (INFEASIBLE)} ∪ [0, int32max] —
+    # int32 min never occurs, which matters: the knock-out's sentinel IS
+    # int32 min, so rows containing it would diverge in index order
+    # (values still agree).  Test over the real domain, duplicates and
+    # all-infeasible rows included.
+    rng = np.random.default_rng(7)
+    cases = [
+        rng.integers(-1, 7, size=(16, 97)).astype(np.int32),    # dup-heavy
+        np.zeros((4, 33), np.int32),                            # all-equal
+        np.full((3, 17), -1, np.int32),                         # all-infeasible
+        rng.integers(-1, np.iinfo(np.int32).max,
+                     size=(8, 64)).astype(np.int32),            # full range
+    ]
+    for prio in cases:
+        for k in (1, 4, 8):
+            a_v, a_i = topk_by_argmax(jnp.asarray(prio), k)
+            t_v, t_i = lax.top_k(jnp.asarray(prio), k)
+            np.testing.assert_array_equal(np.asarray(a_v), np.asarray(t_v))
+            np.testing.assert_array_equal(np.asarray(a_i), np.asarray(t_i))

@@ -45,16 +45,32 @@ def parse_args(argv=None):
 
 def run_point(args, rate: int) -> dict | None:
     pods = max(args.min_pods, int(rate * args.seconds))
+    # The point self-deadlines IN-PROCESS (tools/with_deadline.py): a
+    # subprocess.run(timeout=) kill mid-TPU-op would lose the axon grant
+    # and take the pool down for every later point.  The outer timeout
+    # stays as a last resort, with slack so it should never fire first.
+    import pathlib
+    wrapper = str(
+        pathlib.Path(__file__).resolve().parents[2] / "tools" / "with_deadline.py"
+    )
     cmd = [
-        sys.executable, "-m", "k8s1m_tpu.tools.sched_bench",
+        sys.executable, wrapper, str(args.timeout),
+        "-m", "k8s1m_tpu.tools.sched_bench",
         "--nodes", str(args.nodes), "--pods", str(pods),
         "--rate", str(rate), "--score-pct", str(args.score_pct),
         "--backend", args.backend,
     ]
     t0 = time.perf_counter()
-    proc = subprocess.run(
-        cmd, stdout=subprocess.PIPE, text=True, timeout=args.timeout
-    )
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, text=True, timeout=args.timeout + 300
+        )
+    except subprocess.TimeoutExpired:
+        # Should never fire (the in-process deadline + watchdog act
+        # first); if it does, record the point as failed but keep the
+        # sweep going — the remaining rates still produce a curve.
+        print(f"# rate={rate}: outer timeout", file=sys.stderr)
+        return None
     if proc.returncode != 0:
         print(f"# rate={rate}: rc={proc.returncode}", file=sys.stderr)
         return None

@@ -36,10 +36,24 @@ def _spawn_watchdog(deadline_s: float) -> None:
     pid = os.fork()
     if pid != 0:
         return  # parent continues into the payload
+    # Drop inherited stdin/stdout immediately: a reader waiting for pipe
+    # EOF (latency_curve's subprocess.PIPE, the daemon's `| tail -1`)
+    # would otherwise stall up to one 5s poll after the payload exits.
+    # Keep stderr for the SIGKILL diagnostic.
+    try:
+        os.close(0)
+        os.close(1)
+    except OSError:
+        pass
     # Watchdog child: poll the parent; never touches jax/TPU.
+    # os.kill(pid, 0) succeeds on a ZOMBIE parent (exited, unreaped), so
+    # also watch getppid(): as the payload's direct child we're reparented
+    # the moment it exits, reaped or not.
     end = time.monotonic() + deadline_s + KILL_SLACK_S
     while time.monotonic() < end:
         time.sleep(5.0)
+        if os.getppid() != parent:
+            os._exit(0)  # parent exited (possibly zombie)
         try:
             os.kill(parent, 0)
         except OSError:
@@ -74,13 +88,21 @@ def main():
     t.daemon = True
     t.start()
 
+    # sys.path[0] is THIS script's directory (tools/); restore the path
+    # semantics the payload would see natively: `python -m mod` prepends
+    # the cwd, `python script.py` prepends the script's directory.
+    # (Under -P/PYTHONSAFEPATH no script dir was prepended — don't pop.)
+    if sys.path and sys.path[0] == os.path.dirname(os.path.abspath(__file__)):
+        sys.path.pop(0)
     if sys.argv[2] == "-m":
         mod = sys.argv[3]
         sys.argv = [mod] + sys.argv[4:]
+        sys.path.insert(0, os.getcwd())
         runpy.run_module(mod, run_name="__main__", alter_sys=True)
     else:
         path = sys.argv[2]
         sys.argv = [path] + sys.argv[3:]
+        sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
         runpy.run_path(path, run_name="__main__")
 
 

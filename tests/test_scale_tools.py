@@ -129,3 +129,40 @@ def test_soak_smoke_secured_tier():
     assert out["churn"]["deleted"] > 0
     assert out["samples"] >= 2
     # rss_flat is NOT asserted: a 12s window is all startup transient.
+
+
+def test_with_deadline_wrapper_semantics():
+    """tools/with_deadline.py is the ONLY sanctioned way to bound a
+    TPU-touching command (an external `timeout` kill mid-op loses the
+    axon grant — round 5).  Pin its three contracts: module payloads
+    resolve against the cwd (not the wrapper's dir), script payloads run
+    with their own dir on sys.path, and a hung payload self-exits rc=4
+    in-process, watchdog included."""
+    env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+    wrapper = os.path.join(REPO, "tools", "with_deadline.py")
+
+    # -m payload imports k8s1m_tpu from the cwd like native `python -m`.
+    proc = subprocess.run(
+        [sys.executable, wrapper, "60", "-m", "k8s1m_tpu.tools.verify_cluster",
+         "--help"],
+        cwd=REPO, env=env, timeout=90,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # Script payload; and the deadline fires in-process with rc=4.
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        hang = os.path.join(d, "hang.py")
+        with open(hang, "w") as f:
+            f.write("import time\nprint('up', flush=True)\ntime.sleep(300)\n")
+        t0 = __import__("time").monotonic()
+        proc = subprocess.run(
+            [sys.executable, wrapper, "2", hang],
+            cwd=REPO, env=env, timeout=60,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        assert proc.returncode == 4, (proc.returncode, proc.stderr[-500:])
+        assert "up" in proc.stdout
+        # In-process exit, not the +120s SIGKILL backstop.
+        assert __import__("time").monotonic() - t0 < 30

@@ -16,7 +16,7 @@ import pathlib
 import re
 import sys
 
-DEFAULT_DOCS = ["README.md", "PARITY.md", "SURVEY.md", "BASELINE.md"]
+DEFAULT_DOCS = ["README.md", "MIGRATION.md", "PARITY.md", "SURVEY.md", "BASELINE.md"]
 
 _STYLE = """
 body { max-width: 60rem; margin: 2rem auto; padding: 0 1rem;

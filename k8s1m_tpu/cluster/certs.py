@@ -52,9 +52,18 @@ def provision(
     """Write ca.pem / cert.pem / key.pem under ``cert_dir``.
 
     CA-signed (not bare self-signed) so clients exercise real chain
-    verification, like the reference's terraform chain.
+    verification, like the reference's terraform chain.  Uses the
+    ``cryptography`` package when importable, else shells out to the
+    ``openssl`` CLI (same chain shape) so minimal containers can still
+    run the secured-tier drills.
     """
-    from cryptography import x509
+    try:
+        from cryptography import x509
+    except ImportError:
+        return _provision_openssl(
+            cert_dir, common_name=common_name, hostnames=hostnames,
+            ips=ips, days=days,
+        )
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.x509.oid import NameOID
@@ -116,4 +125,80 @@ def provision(
                 serialization.NoEncryption(),
             )
         )
+    return paths
+
+
+def _provision_openssl(
+    cert_dir: str,
+    *,
+    common_name: str,
+    hostnames: tuple[str, ...],
+    ips: tuple[str, ...],
+    days: int,
+) -> CertPaths:
+    """The same CA -> server-cert chain via the openssl CLI (P-256 keys,
+    SHA-256, SANs, PKCS8 server key — byte-compatible consumers)."""
+    import subprocess
+
+    os.makedirs(cert_dir, exist_ok=True)
+    paths = CertPaths(
+        ca_pem=os.path.join(cert_dir, "ca.pem"),
+        cert_pem=os.path.join(cert_dir, "cert.pem"),
+        key_pem=os.path.join(cert_dir, "key.pem"),
+    )
+    ca_key = os.path.join(cert_dir, "ca_key.pem")
+    raw_key = os.path.join(cert_dir, "key_ec.pem")
+    csr = os.path.join(cert_dir, "csr.pem")
+    ext = os.path.join(cert_dir, "ext.cnf")
+    ca_cnf = os.path.join(cert_dir, "ca.cnf")
+    san = ",".join(
+        [f"DNS:{h}" for h in hostnames] + [f"IP:{i}" for i in ips]
+    )
+    with open(ext, "w") as f:
+        f.write(
+            f"subjectAltName={san}\n"
+            "basicConstraints=critical,CA:FALSE\n"
+            "subjectKeyIdentifier=hash\n"
+            "authorityKeyIdentifier=keyid\n"
+        )
+    # Explicit config: the system default req config ALSO appends
+    # basicConstraints, and a duplicated extension fails verification.
+    with open(ca_cnf, "w") as f:
+        f.write(
+            "[req]\ndistinguished_name=dn\nx509_extensions=v3_ca\n"
+            "prompt=no\n[dn]\nCN=k8s1m-rig-ca\n[v3_ca]\n"
+            "basicConstraints=critical,CA:TRUE,pathlen:0\n"
+            "subjectKeyIdentifier=hash\n"
+        )
+
+    def run(*cmd: str) -> None:
+        subprocess.run(
+            cmd, check=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+
+    try:
+        run("openssl", "ecparam", "-name", "prime256v1", "-genkey",
+            "-noout", "-out", ca_key)
+        run("openssl", "req", "-x509", "-new", "-key", ca_key,
+            "-config", ca_cnf, "-days", str(days), "-sha256",
+            "-out", paths.ca_pem)
+        run("openssl", "ecparam", "-name", "prime256v1", "-genkey",
+            "-noout", "-out", raw_key)
+        run("openssl", "pkcs8", "-topk8", "-nocrypt", "-in", raw_key,
+            "-out", paths.key_pem)
+        run("openssl", "req", "-new", "-key", paths.key_pem,
+            "-subj", f"/CN={common_name}", "-out", csr)
+        run("openssl", "x509", "-req", "-in", csr, "-CA", paths.ca_pem,
+            "-CAkey", ca_key, "-CAcreateserial", "-days", str(days),
+            "-sha256", "-extfile", ext, "-out", paths.cert_pem)
+    finally:
+        # Scrub even when an openssl step fails: ca_key in particular —
+        # the cryptography path keeps the CA key in memory only, and a
+        # CA key left readable in cert_dir would let anything that can
+        # read it mint trusted certs (the .srl serial file rides along).
+        srl = os.path.splitext(paths.ca_pem)[0] + ".srl"
+        for scratch in (raw_key, csr, ext, ca_cnf, ca_key, srl):
+            if os.path.exists(scratch):
+                os.unlink(scratch)
     return paths

@@ -25,6 +25,7 @@ from __future__ import annotations
 import atexit
 import dataclasses
 import json
+import os
 import socket
 import subprocess
 import sys
@@ -96,6 +97,12 @@ class ClusterSpec:
     # consumers behind the tier connect with the CA + token.  Requires
     # watch_cache=True.
     tier_tls: bool = False
+    # Deterministic fault injection (k8s1m_tpu/faultline): a FaultPlan
+    # (or its JSON/dict form) installed process-wide for the in-process
+    # components (coordinators, shard members, RemoteStore clients) and
+    # inherited by the tier subprocesses via K8S1M_FAULT_PLAN — the
+    # tfvars-level switch that turns a cluster shape into a drill.
+    fault_plan: "object | None" = None
     table: TableSpec | None = None
     pod_batch: int = 256
     profile: Profile = dataclasses.field(
@@ -162,6 +169,22 @@ class Cluster:
     def __init__(self, spec: ClusterSpec, *, wal_dir: str | None = None):
         self.spec = spec
         self.wal_dir = wal_dir or tempfile.mkdtemp(prefix="k8s1m-wal-")
+        # Fault plan: installed for in-process components, exported to
+        # every subprocess this harness spawns (tier replicas read it at
+        # their first injection hook).
+        self.fault_plan = None
+        self._sub_env = None
+        if spec.fault_plan is not None:
+            from k8s1m_tpu.faultline import FaultPlan, install_plan
+
+            fp = spec.fault_plan
+            if not isinstance(fp, FaultPlan):
+                fp = FaultPlan.from_json(fp)
+            self.fault_plan = fp
+            install_plan(fp)
+            self._sub_env = {
+                **os.environ, "K8S1M_FAULT_PLAN": fp.to_json()
+            }
         # Everything shutdown() touches exists before anything can fail,
         # so a partial-init crash still tears the subprocess down cleanly
         # at exit.
@@ -185,7 +208,9 @@ class Cluster:
         ]
         for p in spec.no_write_prefixes:
             cmd += ["--wal-no-write-prefix", p]
-        self._server = subprocess.Popen(cmd, stderr=self._ship("store"))
+        self._server = subprocess.Popen(
+            cmd, stderr=self._ship("store"), env=self._sub_env
+        )
         self._tier = None
         self.tier_port: int | None = None
         atexit.register(self.shutdown)
@@ -225,7 +250,8 @@ class Cluster:
                         "--auth-token", self.tier_token,
                     ]
                 self._tiers.append(subprocess.Popen(
-                    tier_cmd, stderr=self._ship(f"tier-{i}")
+                    tier_cmd, stderr=self._ship(f"tier-{i}"),
+                    env=self._sub_env,
                 ))
                 self.tier_ports.append(port)
             self._tier = self._tiers[0]
@@ -494,7 +520,7 @@ class Cluster:
         cmd = self._server.args
         self._stop_server()
         self._server = subprocess.Popen(
-            cmd, stderr=self._ship("store")
+            cmd, stderr=self._ship("store"), env=self._sub_env
         )
         # WAL-skipped prefixes (leases) lower the replayed revision below
         # the pre-crash counter; a stale compaction target would then be
@@ -522,6 +548,13 @@ class Cluster:
     def shutdown(self) -> None:
         if self._server is None:
             return
+        if self.fault_plan is not None:
+            # The injector is process-global: without this reset the
+            # faulted cluster's plan would keep firing into whatever
+            # cluster (or test) runs next in this process.
+            from k8s1m_tpu.faultline import install_plan
+
+            install_plan(None)
         if self.webhook is not None:
             self.webhook.stop()
         for ha in self.coordinators:

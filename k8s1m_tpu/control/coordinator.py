@@ -25,18 +25,27 @@ drives the whole cluster:
   CAS-guarded, so a concurrent pod update between intake and bind loses
   nothing: the CAS fails and the newer pod revision re-enters via watch.
 
-A pod whose bind CAS fails or that finds no feasible node is retried up to
-``max_attempts`` times (the reference admits first-attempt failures are
-not reliably retried, reference RUNNING.adoc:206 — this does better).
+A pod whose bind CAS fails or that finds no feasible node is re-queued
+under the ``coordinator.bind`` RetryPolicy (k8s1m_tpu/faultline/policy.py):
+capped exponential backoff with jitter, then parked as unschedulable
+after ``max_attempts`` tries (the reference admits first-attempt failures
+are not reliably retried, reference RUNNING.adoc:206 — this does better).
+Backoff means a CAS-conflict storm surfaces as queue backpressure (pods
+waiting out their delay) instead of the same pods tight-looping through
+every consecutive wave.  The bind and watch-drain paths are faultline
+injection hooks (components ``coordinator.bind`` / ``coordinator.watch``),
+so conflict storms and watch loss are reproducible by seed.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import heapq
 import json
 import logging
 import os
+import random
 import threading
 import time
 import weakref
@@ -45,7 +54,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from k8s1m_tpu import faultline
 from k8s1m_tpu.config import DEFAULT_SCHEDULER, PodSpec, TableSpec
+from k8s1m_tpu.faultline import RetryPolicy, note_give_up, note_retry, policy_for
 from k8s1m_tpu.control.objects import (
     decode_node,
     decode_pod,
@@ -100,12 +111,20 @@ _CYCLE_TIME = Histogram(
     "coordinator_cycle_seconds", "Scheduling cycle latency by stage", ("stage",)
 )
 _QUEUE_DEPTH = Gauge("coordinator_queue_depth", "Pending pods queued", ())
+_BACKOFF_DEPTH = Gauge(
+    "coordinator_backoff_depth",
+    "Pods waiting out a retry backoff (conflict-storm backpressure)", (),
+)
+_RESYNCS = Counter(
+    "coordinator_resyncs_total", "Full relist+rewatch recoveries", ()
+)
 _NODE_COUNT = Gauge("coordinator_node_count", "Nodes in the snapshot", ())
 # All live coordinators in this process; gauges aggregate over them so a
 # discarded instance neither pins memory nor clobbers the live one's stats.
 _LIVE: weakref.WeakSet = weakref.WeakSet()
 _NODE_COUNT.set_function(lambda: sum(c.host.num_nodes for c in _LIVE))
 _QUEUE_DEPTH.set_function(lambda: sum(len(c.queue) for c in _LIVE))
+_BACKOFF_DEPTH.set_function(lambda: sum(len(c._backoff) for c in _LIVE))
 
 _BIND_LATENCY = Histogram(
     "coordinator_schedule_to_bind_seconds",
@@ -143,6 +162,9 @@ class PendingPod:
     # Store key bytes, captured at intake so the bind wave never
     # re-formats /registry/pods/<ns>/<name> per pod.
     key_bytes: bytes = b""
+    # Earliest perf_counter() time this pod may re-enter a batch after a
+    # retry (RetryPolicy backoff; 0 = immediately eligible).
+    not_before: float = 0.0
 
     def ensure_pod(self) -> PodInfo:
         if self.pod is None:
@@ -187,6 +209,7 @@ class Coordinator:
         k: int = 4,
         with_constraints: bool = True,
         max_attempts: int = 5,
+        retry_policy: RetryPolicy | None = None,
         scheduler_name: str = DEFAULT_SCHEDULER,
         seed: int = 0,
         flight_recorder: FlightRecorder | None = None,
@@ -209,6 +232,13 @@ class Coordinator:
         self.profile = profile
         self.chunk = chunk
         self.k = k
+        # One resilience policy for the bind/requeue path; max_attempts
+        # stays the constructor-level knob (it predates the policy and
+        # every harness passes it), overriding the default's budget.
+        self.retry_policy = dataclasses.replace(
+            retry_policy or policy_for("coordinator.bind"),
+            max_attempts=max_attempts,
+        )
         self.max_attempts = max_attempts
         self.scheduler_name = scheduler_name
         self.flight = flight_recorder
@@ -319,6 +349,14 @@ class Coordinator:
 
         self.queue: collections.deque[PendingPod] = collections.deque()
         self._queued_keys: set[str] = set()
+        # Retrying pods waiting out their backoff: (not_before, seq, pod)
+        # min-heap, released into the queue by _release_backoff.  Their
+        # keys stay in _queued_keys so watch echoes don't re-add them.
+        self._backoff: list[tuple[float, int, PendingPod]] = []
+        self._backoff_seq = 0
+        # Seeded jitter stream so a replayed fault plan replays the same
+        # backoff schedule (determinism-by-seed, faultline contract).
+        self._retry_rng = random.Random(seed ^ 0xFA017)
         self._sched_bytes = scheduler_name.encode()
         self._name_bytes: list[bytes] = []
         # Per-namespace tracker matches for the EMPTY label set, keyed by
@@ -521,6 +559,11 @@ class Coordinator:
         forever.  Detect it and relist, the same way a kube reflector
         handles 410 Gone.
         """
+        if self._watch_fault():
+            # Injected watch loss (disconnect / drop / stale_revision):
+            # the graceful-degradation contract is relist from current
+            # state — exactly the overflow response below.
+            return self.resync()
         if self._nodes_watch.dropped or self._pods_watch.dropped:
             log.warning(
                 "watch overflow (nodes dropped=%d pods dropped=%d); resyncing",
@@ -543,6 +586,23 @@ class Coordinator:
         n = self._drain_node_events(max_events)
         n += self._drain_pod_events(max_events)
         return n
+
+    @staticmethod
+    def _watch_fault() -> bool:
+        """Faultline hook on the intake watch drain (component
+        ``coordinator.watch``, op ``poll``).  ``delay`` sleeps; any
+        failure kind means the watch tier is gone from this consumer's
+        perspective — True tells the caller to resync (relist from
+        current store state + rewatch), which recovers every lost event
+        by construction."""
+        d = faultline.decide("coordinator.watch", "poll")
+        if d is None:
+            return False
+        if d.kind == "delay":
+            time.sleep(d.delay_s)
+            return False
+        log.warning("injected %s on watch drain; resyncing", d.kind)
+        return True
 
     def _drain_node_events(self, max_events: int = 10000) -> int:
         """Apply node deltas.  MUTATES the row->node mapping (upsert can
@@ -750,6 +810,7 @@ class Coordinator:
     def resync(self) -> int:
         """Full relist after watch overflow: reconcile host state against
         the store and restart both watches from the list revisions."""
+        _RESYNCS.inc()
         with _CYCLE_TIME.time(stage="resync"):
             self._nodes_watch.cancel()
             self._pods_watch.cancel()
@@ -914,9 +975,27 @@ class Coordinator:
             self._encoders[b] = enc
         return enc
 
+    def _release_backoff(self) -> None:
+        """Move retrying pods whose backoff has expired into the queue."""
+        if not self._backoff:
+            return
+        now = time.perf_counter()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, p = heapq.heappop(self._backoff)
+            self.queue.append(p)
+
+    def backoff_wait_s(self) -> float | None:
+        """Seconds until the earliest parked retry is due (None when no
+        pod is backing off) — drivers idle-wait on this instead of
+        spinning cycles against an empty queue."""
+        if not self._backoff:
+            return None
+        return max(0.0, self._backoff[0][0] - time.perf_counter())
+
     def _take_batch(self):
         """Pop and encode up to one batch of pending pods; (None, None)
         when the queue is empty."""
+        self._release_backoff()
         if not self.queue:
             return None, None
         batch_pods: list[PendingPod] = []
@@ -1025,9 +1104,23 @@ class Coordinator:
             wave_j: list[int] = []
             entries: list[tuple[bytes, int, bytes]] = []
             native = bind_batch is not None
+            # Hot path stays injection-free unless a plan is installed.
+            inj_active = bool(faultline.active_injector().plan.faults)
             for j, i in enumerate(bound_l):
                 p = batch_pods[i]
                 if native and p.mod_revision is not None:
+                    # One fault decision per CAS attempt: native-wave
+                    # records are checked here (their CAS runs inside
+                    # bind_batch); slow-path pods are checked inside
+                    # _bind so they never consume two draws per attempt.
+                    if inj_active and self._bind_fault():
+                        # Forced conflict: identical accounting to the
+                        # real CAS-conflict branch below.
+                        name = nbytes[ids_l[j]].decode()
+                        self._dirty_rows.add(host.row_of(name))
+                        failed[i] = True
+                        self._retry(p)
+                        continue
                     wave_j.append(j)
                     entries.append((p.key_bytes, p.mod_revision, nbytes[ids_l[j]]))
                     continue
@@ -1169,6 +1262,11 @@ class Coordinator:
                 self._nodes_watch.dropped, self._pods_watch.dropped,
             )
             self.resync()
+        elif self._watch_fault():
+            # Injected watch loss: quiesce the pipeline (resync mutates
+            # the row->node mapping) and relist, same as an overflow.
+            done += self.flush()
+            self.resync()
         self._drain_external()
         self._drain_pod_events()
         batch_pods, batch = self._take_batch()
@@ -1214,6 +1312,8 @@ class Coordinator:
 
     def _bind(self, p: PendingPod, node_name: str) -> bool:
         """CAS spec.nodeName into the pod object; False on conflict."""
+        if self._bind_fault():
+            return False
         key = p.key_bytes
         if p.mod_revision is not None and p.raw is not None:
             # Fast path: splice nodeName into the intake-revision bytes.
@@ -1265,13 +1365,36 @@ class Coordinator:
         _PODS_SCHEDULED.inc(outcome="bound")
         return True
 
+    @staticmethod
+    def _bind_fault() -> bool:
+        """Faultline hook on the bind CAS (component ``coordinator.bind``,
+        op ``cas``).  ``delay`` sleeps; every failure kind maps to a
+        forced CAS conflict — the one failure this path owns (wire-level
+        failures are the store.wire hooks' domain) — which drives the pod
+        through the same conflict/requeue machinery a concurrent writer
+        would.  Returns True when the bind must report conflict."""
+        d = faultline.decide("coordinator.bind", "cas")
+        if d is None:
+            return False
+        if d.kind == "delay":
+            time.sleep(d.delay_s)
+            return False
+        _PODS_SCHEDULED.inc(outcome="conflict")
+        return True
+
     def _retry(self, p: PendingPod) -> None:
         p.attempts += 1
-        if p.attempts >= self.max_attempts:
+        pol = self.retry_policy
+        if p.attempts >= pol.max_attempts:
+            # Give-up degrades gracefully: the pod is parked (the
+            # reference reports unschedulable the same way), never
+            # tight-looped.
             _PODS_SCHEDULED.inc(outcome="unschedulable")
+            note_give_up("coordinator.bind")
             self.unschedulable[p.key_str] = p.ensure_pod()
             return
         _PODS_SCHEDULED.inc(outcome="retry")
+        note_retry("coordinator.bind")
         # Re-read AND re-decode: the CAS may have failed because an external
         # writer bound the pod (retrying would overwrite their bind and
         # double-account) or changed its spec (retrying with stale
@@ -1292,7 +1415,15 @@ class Coordinator:
         # reverting whatever spec change made the first CAS fail.
         p.raw = cur.value
         self._queued_keys.add(p.key_str)
-        self.queue.append(p)
+        # Backoff requeue (RetryPolicy): the pod sits out a jittered,
+        # attempt-scaled delay instead of re-entering the very next wave
+        # — a conflict storm becomes visible backpressure
+        # (coordinator_backoff_depth) rather than a tight loop.
+        p.not_before = time.perf_counter() + pol.delay_for(
+            p.attempts, self._retry_rng
+        )
+        self._backoff_seq += 1
+        heapq.heappush(self._backoff, (p.not_before, self._backoff_seq, p))
 
     def close(self) -> None:
         """Cancel store watches (native watchers are registered until
@@ -1311,6 +1442,13 @@ class Coordinator:
             n = self.step()
             total += n
             if not self.queue and not self._inflights:
+                if self._backoff:
+                    # Retrying pods are parked on a timer, not idle:
+                    # wait out the earliest backoff instead of burning
+                    # empty cycles (or worse, exiting with work pending).
+                    time.sleep(min(self.backoff_wait_s() or 0.0, 0.05))
+                    idle = 0
+                    continue
                 idle += 1
                 if idle > 1 and self.drain_watches() == 0 and not self._external:
                     break

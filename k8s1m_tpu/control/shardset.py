@@ -49,10 +49,13 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import time
 
 import numpy as np
 
+from k8s1m_tpu import faultline
 from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.faultline import GiveUp, policy_for
 from k8s1m_tpu.obs.metrics import Counter, Gauge
 from k8s1m_tpu.store.native import drain_events_light, prefix_end
 
@@ -373,7 +376,28 @@ class ShardMember:
     # ---- status heartbeat ----------------------------------------------
 
     def heartbeat(self, now: float) -> None:
-        """Publish liveness + load; the rebalancer reads these."""
+        """Publish liveness + load; the rebalancer reads these.
+
+        Faultline hook (``shardset.lease``, op ``heartbeat/<shard>``):
+        a dropped heartbeat is simply skipped — exactly a renewal the
+        process never got to send — so the rebalancer's dead-shard
+        evacuation fires after ``dead_after``, the same recovery a real
+        silent shard gets.  Real write failures retry under the
+        shardset.lease policy; give-up also skips (the next tick's
+        heartbeat is the retry that matters — liveness is level-based,
+        not edge-based)."""
+        d = faultline.decide(
+            "shardset.lease", f"heartbeat/{self.shard_idx}"
+        )
+        if d is not None:
+            if d.kind == "delay":
+                time.sleep(d.delay_s)
+            else:
+                log.warning(
+                    "shard %d heartbeat suppressed (injected %s)",
+                    self.shard_idx, d.kind,
+                )
+                return
         owned = (
             int(self.coordinator._row_mask_np.sum())
             if self.coordinator._row_mask_np is not None
@@ -387,9 +411,14 @@ class ShardMember:
                 "ownedNodes": owned,
             }
         ).encode()
-        self._status_rev = self.store.put(
-            STATUS_PREFIX + str(self.shard_idx).encode(), body
-        )
+        key = STATUS_PREFIX + str(self.shard_idx).encode()
+        try:
+            self._status_rev = policy_for("shardset.lease").call(
+                lambda: self.store.put(key, body), op="heartbeat"
+            )
+        except GiveUp as e:
+            log.warning("shard %d heartbeat failed: %s", self.shard_idx, e)
+            return
         self._last_beat = now
 
     # ---- cycle ---------------------------------------------------------
@@ -479,6 +508,17 @@ class Rebalancer:
         if not force and now - self._last_run < self.min_interval:
             return False
         self._last_run = now
+        # Faultline hook (``shardset.lease``, op ``rebalance``): a failed
+        # round is skipped whole — the interval timer ran, so the NEXT
+        # round is the retry (the reference's leader activity has the
+        # same shape: best-effort per round, durable across rounds).
+        d = faultline.decide("shardset.lease", "rebalance")
+        if d is not None:
+            if d.kind == "delay":
+                time.sleep(d.delay_s)
+            else:
+                log.warning("rebalance round skipped (injected %s)", d.kind)
+                return False
         cur = init_assignment(self.store, self.num_shards)
         alive = self.alive_shards(now)
         if not alive:

@@ -28,6 +28,7 @@ class WatchBatch:
     compact_revision: int = 0
     created: bool = False
     canceled: bool = False
+    cancel_reason: str = ""
 
 
 def secure_channel_for(
@@ -301,4 +302,5 @@ class WatchSession:
             compact_revision=resp.compact_revision,
             created=resp.created,
             canceled=resp.canceled,
+            cancel_reason=resp.cancel_reason,
         )

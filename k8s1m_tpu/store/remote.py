@@ -13,6 +13,13 @@ is set when the stream errors or the server cancels (compaction) — the
 coordinator reacts with a relist+rewatch, exactly its response to an
 in-process overflow (control/coordinator.py resync), which also covers
 whatever events the broken stream lost.
+
+Resilience: every unary RPC runs under the ``store.wire`` RetryPolicy
+(k8s1m_tpu/faultline/policy.py — capped exponential backoff + jitter +
+deadline budget; transient gRPC errors and injected faults retry,
+semantic errors like CompactedError propagate), and every call site is
+a faultline injection hook (component ``store.wire``), so the client's
+recovery behavior is testable by seed instead of by kill drill.
 """
 
 from __future__ import annotations
@@ -21,9 +28,12 @@ import collections
 import logging
 import queue
 import threading
+import time
 
 import grpc
 
+from k8s1m_tpu import faultline
+from k8s1m_tpu.faultline import InjectedFault, RetryPolicy, policy_for
 from k8s1m_tpu.store.native import (
     CompactedError,
     FutureRevError,
@@ -38,6 +48,21 @@ from k8s1m_tpu.store.proto import batch_pb2, mvcc_pb2, rpc_pb2
 log = logging.getLogger("k8s1m.remote_store")
 
 _M = "etcdserverpb"
+
+
+def _check_unary(op: str, expressible: tuple = ()):
+    """Faultline hook for a unary RPC.  ``delay`` was already applied;
+    kinds in ``expressible`` are returned for the call site to apply
+    (range's stale_revision, the batch writes' partial_write).  Every
+    OTHER kind — ``drop``, which has no safe unary meaning short of
+    silent write loss, and any kind this op cannot express — fails like
+    a dropped request on the wire (the client can't tell the
+    difference), so a counted injection is never a silent no-op and the
+    evidence JSON never overstates coverage."""
+    d = faultline.check("store.wire", op)
+    if d is None or d.kind == "delay" or d.kind in expressible:
+        return d
+    raise InjectedFault(d)
 
 
 def _kv(pb) -> KeyValue:
@@ -103,6 +128,24 @@ class RemoteWatcher:
         ended_clean = False
         try:
             for resp in self._call:
+                d = faultline.decide("store.wire", "watch.recv")
+                if d is not None:
+                    if d.kind == "delay":
+                        time.sleep(d.delay_s)
+                    elif d.kind == "drop":
+                        # This batch's events are thrown away — never
+                        # silently: dropped goes positive and the owner
+                        # relists, which recovers the gap.
+                        self._dropped += 1
+                        continue
+                    else:
+                        # disconnect / err5xx / stale_revision: the
+                        # stream is dead from the consumer's side; same
+                        # contract as a broken stream below.
+                        log.warning("watch stream: injected %s", d.kind)
+                        self._dropped += 1
+                        ended_clean = True
+                        break
                 if resp.compact_revision:
                     raise CompactedError(resp.compact_revision)
                 if resp.canceled:
@@ -214,8 +257,10 @@ class RemoteStore:
         *,
         ca_pem: str | None = None,
         token: str | None = None,
+        retry_policy: RetryPolicy | None = None,
     ):
         self.target = target
+        self.retry_policy = retry_policy or policy_for("store.wire")
         options = [
             # Match the servers' 64MB caps (etcd_server/watch_cache);
             # the default 4MB rejects a ~12K-object list response.
@@ -278,14 +323,29 @@ class RemoteStore:
     def __exit__(self, *exc):
         self.close()
 
+    def _invoke(self, op: str, fn):
+        """Run one wire attempt (injection hook + RPC) under the shared
+        store.wire RetryPolicy.  ``fn`` must be safe to repeat: every op
+        below is a read, an idempotent put, or a CAS whose retry can only
+        observe its own prior success as a conflict — the same at-least-
+        once contract etcd clients live with."""
+        return self.retry_policy.call(fn, op=op)
+
     # ---- writes --------------------------------------------------------
 
     def put(self, key: bytes, value: bytes, lease: int = 0) -> int:
-        resp = self._put(rpc_pb2.PutRequest(key=key, value=value, lease=lease))
-        return resp.header.revision
+        def once():
+            _check_unary("put")
+            return self._put(
+                rpc_pb2.PutRequest(key=key, value=value, lease=lease)
+            )
+        return self._invoke("put", once).header.revision
 
     def delete(self, key: bytes) -> tuple[int, bool]:
-        resp = self._delete_rpc(rpc_pb2.DeleteRangeRequest(key=key))
+        def once():
+            _check_unary("delete")
+            return self._delete_rpc(rpc_pb2.DeleteRangeRequest(key=key))
+        resp = self._invoke("delete", once)
         if resp.deleted:
             return resp.header.revision, True
         return 0, False
@@ -297,22 +357,59 @@ class RemoteStore:
         equivalent of MemStore.put_batch (one FFI call server-side).
         Only works against our server; a real etcd would return
         UNIMPLEMENTED, and the caller should fall back to per-item puts."""
-        resp = self._put_frame(
-            batch_pb2.PutFrameRequest(
-                frame=pack_put_frame(items), count=len(items), lease=lease
+        def once():
+            d = _check_unary("put_batch", ("partial_write",))
+            if d is not None and d.kind == "partial_write" and len(items) <= 1:
+                # A 1-item batch has no expressible prefix: nothing
+                # lands and the connection dies — a plain wire failure.
+                raise InjectedFault(d)
+            if d is not None and d.kind == "partial_write":
+                # The fault the WAL/crash literature actually produces: a
+                # prefix of the batch lands, then the connection dies.
+                # Retrying the WHOLE batch is safe — puts are idempotent
+                # (the repeated prefix just bumps revisions).
+                half = items[: len(items) // 2]
+                self._put_frame(
+                    batch_pb2.PutFrameRequest(
+                        frame=pack_put_frame(half), count=len(half),
+                        lease=lease,
+                    )
+                )
+                raise InjectedFault(d)
+            resp = self._put_frame(
+                batch_pb2.PutFrameRequest(
+                    frame=pack_put_frame(items), count=len(items), lease=lease
+                )
             )
-        )
-        return resp.revision
+            return resp.revision
+        return self._invoke("put_batch", once)
 
     def bind_batch(self, binds: list[tuple[bytes, int, bytes]]) -> list[int]:
         """Bind wave over one BatchKV.BindFrame RPC — the wire equivalent
         of MemStore.bind_batch (same per-record result codes)."""
-        resp = self._bind_frame(
-            batch_pb2.BindFrameRequest(
-                frame=pack_bind_frame(binds), count=len(binds)
+        def once():
+            d = _check_unary("bind_batch", ("partial_write",))
+            if d is not None and d.kind == "partial_write" and len(binds) <= 1:
+                raise InjectedFault(d)
+            if d is not None and d.kind == "partial_write":
+                # Prefix of the wave binds, then the stream dies.  The
+                # retried full wave is CAS-guarded: already-bound records
+                # come back as conflicts and the coordinator's conflict
+                # path re-reads them (sees its own bind, drops the pod).
+                half = binds[: len(binds) // 2]
+                self._bind_frame(
+                    batch_pb2.BindFrameRequest(
+                        frame=pack_bind_frame(half), count=len(half)
+                    )
+                )
+                raise InjectedFault(d)
+            resp = self._bind_frame(
+                batch_pb2.BindFrameRequest(
+                    frame=pack_bind_frame(binds), count=len(binds)
+                )
             )
-        )
-        return list(resp.revisions)
+            return list(resp.revisions)
+        return self._invoke("bind_batch", once)
 
     def cas(
         self,
@@ -348,9 +445,13 @@ class RemoteStore:
             op.request_put.lease = lease
         fail = rpc_pb2.RequestOp()
         fail.request_range.key = key
-        resp = self._txn(
-            rpc_pb2.TxnRequest(compare=[cmp], success=[op], failure=[fail])
-        )
+
+        def once():
+            _check_unary("txn")
+            return self._txn(
+                rpc_pb2.TxnRequest(compare=[cmp], success=[op], failure=[fail])
+            )
+        resp = self._invoke("txn", once)
         if resp.succeeded:
             return True, resp.header.revision, None
         cur = None
@@ -372,24 +473,32 @@ class RemoteStore:
         count_only: bool = False,
         keys_only: bool = False,
     ) -> RangeResult:
-        try:
-            resp = self._range(
-                rpc_pb2.RangeRequest(
-                    key=start,
-                    range_end=end or b"",
-                    revision=revision,
-                    limit=limit,
-                    count_only=count_only,
-                    keys_only=keys_only,
+        def once():
+            d = _check_unary("range", ("stale_revision",))
+            if d is not None and d.kind == "stale_revision":
+                # The read observes a compacted snapshot — the signal
+                # consumers already recover from (list_prefix restarts
+                # the pinned scan; watch owners relist).
+                raise CompactedError("injected stale revision")
+            try:
+                return self._range(
+                    rpc_pb2.RangeRequest(
+                        key=start,
+                        range_end=end or b"",
+                        revision=revision,
+                        limit=limit,
+                        count_only=count_only,
+                        keys_only=keys_only,
+                    )
                 )
-            )
-        except grpc.RpcError as e:
-            detail = e.details() or ""
-            if "compacted" in detail:
-                raise CompactedError(detail) from None
-            if "future revision" in detail or "required revision" in detail:
-                raise FutureRevError(detail) from None
-            raise
+            except grpc.RpcError as e:
+                detail = e.details() or ""
+                if "compacted" in detail:
+                    raise CompactedError(detail) from None
+                if "future revision" in detail or "required revision" in detail:
+                    raise FutureRevError(detail) from None
+                raise
+        resp = self._invoke("range", once)
         return RangeResult(
             revision=resp.header.revision,
             count=resp.count,
@@ -424,8 +533,16 @@ class RemoteStore:
     # ---- maintenance ---------------------------------------------------
 
     def compact(self, revision: int) -> None:
-        self._compact_rpc(rpc_pb2.CompactionRequest(revision=revision))
+        def once():
+            _check_unary("compact")
+            return self._compact_rpc(
+                rpc_pb2.CompactionRequest(revision=revision)
+            )
+        self._invoke("compact", once)
 
     @property
     def current_revision(self) -> int:
-        return self._status(rpc_pb2.StatusRequest()).header.revision
+        def once():
+            _check_unary("status")
+            return self._status(rpc_pb2.StatusRequest())
+        return self._invoke("status", once).header.revision

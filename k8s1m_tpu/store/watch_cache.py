@@ -43,6 +43,8 @@ import logging
 import grpc
 from grpc import aio
 
+from k8s1m_tpu import faultline
+from k8s1m_tpu.faultline import InjectedFault, policy_for
 from k8s1m_tpu.obs.metrics import Counter, Gauge
 from k8s1m_tpu.store.etcd_client import EtcdClient
 from k8s1m_tpu.store.native import prefix_end
@@ -57,6 +59,10 @@ _EVENTS_OUT = Counter(
     "watchcache_events_delivered_total", "events delivered to client watches", ()
 )
 _WATCHERS = Gauge("watchcache_watchers", "active client watches", ())
+_INVALIDATIONS = Counter(
+    "watchcache_invalidations_total",
+    "upstream watch breaks that canceled every client for relist", ()
+)
 
 _DEFAULT_WINDOW = 65536
 
@@ -174,6 +180,12 @@ class WatchCache:
         and the history window would silently gap).  Cancel every client
         watch so each one relists — the same contract as a store-watcher
         overflow — and reset state for re-priming."""
+        n = sum(len(p) for p in self._exact.values()) + len(self._ranges)
+        log.warning(
+            "cache invalidated at revision %d: canceling %d client "
+            "watches for relist", self.last_revision, n,
+        )
+        _INVALIDATIONS.inc()
         for peers in self._exact.values():
             for w in peers:
                 w.overflowed = True
@@ -322,8 +334,18 @@ async def run_upstream(
 
     ``handle`` tracks the live session and progress responses for the
     consistent-read gate (event-less batches on a revision-ordered
-    stream are progress notifications)."""
+    stream are progress notifications).
+
+    Relist pacing comes from the shared ``watch.tier`` RetryPolicy
+    (capped exponential backoff + jitter, effectively retrying forever —
+    the tier's job is to outlive store outages), reset after every
+    successful prime.  The event pump is a faultline hook (component
+    ``watch.tier``, op ``upstream.recv``): an injected failure breaks
+    the stream exactly like a real one — invalidate + relist — so cache
+    consistency under upstream loss is reproducible by seed."""
     end = prefix_end(prefix)
+    policy = policy_for("watch.tier")
+    failures = 0
     primed_once = False
     while True:
         try:
@@ -348,6 +370,7 @@ async def run_upstream(
                 kvs.extend(page.kvs)
             cache.prime(kvs, rev)
             primed_once = True
+            failures = 0
             if primed is not None:
                 primed.set()
             async with client.watch(
@@ -361,7 +384,24 @@ async def run_upstream(
                 try:
                     while True:
                         batch = await session.next()
+                        d = faultline.decide("watch.tier", "upstream.recv")
+                        if d is not None:
+                            if d.kind == "delay":
+                                await asyncio.sleep(d.delay_s)
+                            else:
+                                # Any failure kind = the upstream stream
+                                # is gone.  A latest-only cache cannot
+                                # "drop" a batch silently — skipping it
+                                # would gap the history window — so every
+                                # kind takes the honest path: invalidate,
+                                # cancel the clients, relist.
+                                raise InjectedFault(d)
                         if batch.canceled:
+                            log.warning(
+                                "upstream watch for %r canceled by store "
+                                "(%s); relisting", prefix,
+                                batch.cancel_reason or "no reason",
+                            )
                             break   # server-side cancel -> relist
                         for ev in batch.events:
                             cache.apply(
@@ -380,8 +420,13 @@ async def run_upstream(
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            log.warning("upstream watch for %r broke (%s); relisting", prefix, e)
-            await asyncio.sleep(0.2)
+            failures += 1
+            delay = policy.delay_for(failures)
+            log.warning(
+                "upstream watch for %r broke (%s); relisting in %.2fs",
+                prefix, e, delay,
+            )
+            await asyncio.sleep(delay)
 
 
 class UpstreamHandle:
@@ -1017,7 +1062,12 @@ def main(argv=None) -> None:
     ap.add_argument("--auth-token", default=None,
                     help="require 'authorization: Bearer <token>' on "
                     "every RPC (the apiserver client-auth role)")
+    ap.add_argument("--fault-plan", default=None,
+                    help="faultline plan: inline JSON or @path "
+                    "(k8s1m_tpu/faultline; also honors K8S1M_FAULT_PLAN)")
     args = ap.parse_args(argv)
+    if args.fault_plan:
+        faultline.install_plan(faultline.FaultPlan.from_arg(args.fault_plan))
     prefixes = [p.encode() for p in (args.prefix or ["/registry/"])]
     tls = None
     if bool(args.tls_cert) != bool(args.tls_key):

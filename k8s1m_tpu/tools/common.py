@@ -9,9 +9,12 @@ pool over one or more gRPC channels with a periodic rate reporter.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import sys
 import time
 
+from k8s1m_tpu import faultline
+from k8s1m_tpu.faultline import GiveUp, policy_for
 from k8s1m_tpu.store.etcd_client import EtcdClient
 
 
@@ -62,13 +65,19 @@ async def run_sharded(
     pool; ``clients`` separate channels spread HTTP/2 stream contention
     the way the reference uses multiple clientsets.
 
-    A failing item is retried ``retries`` times, then counted in
-    ``reporter.errors`` and skipped — one transient gRPC error must not
-    abort an hours-long load run.  ``max_errors`` (default: 1% of total,
-    at least 100) aborts runs where the target is actually down.
+    A failing item is retried under the shared ``tools.loadgen``
+    RetryPolicy (k8s1m_tpu/faultline/policy.py — jittered backoff, not
+    the old zero-sleep hammer; ``retries`` overrides its attempt count),
+    then counted in ``reporter.errors`` and skipped — one transient gRPC
+    error must not abort an hours-long load run.  ``max_errors``
+    (default: 1% of total, at least 100) aborts runs where the target is
+    actually down.
     """
     if max_errors is None:
         max_errors = max(100, total // 100)
+    policy = dataclasses.replace(
+        policy_for("tools.loadgen"), max_attempts=retries + 1
+    )
     pool = [make_client() for _ in range(max(1, clients))]
     queue: asyncio.Queue = asyncio.Queue()
     for i in range(total):
@@ -83,26 +92,26 @@ async def run_sharded(
                 i = queue.get_nowait()
             except asyncio.QueueEmpty:
                 return
-            for attempt in range(retries + 1):
-                try:
-                    done = await work(client, i)
-                    if reporter:
-                        # A work item that returns an int covers that many
-                        # logical ops (e.g. one batched RPC of N puts).
-                        reporter.add(done if isinstance(done, int) else 1)
-                    break
-                except Exception as e:
-                    if attempt == retries:
-                        errors += 1
-                        if reporter:
-                            reporter.errors += 1
-                        print(
-                            f"work item {i} failed after {retries + 1} "
-                            f"attempts: {e!r}",
-                            file=sys.stderr,
-                        )
-                        if errors > max_errors:
-                            raise
+            try:
+                done = await policy.acall(
+                    lambda: work(client, i), op="work",
+                    retryable=lambda e: True,
+                )
+                if reporter:
+                    # A work item that returns an int covers that many
+                    # logical ops (e.g. one batched RPC of N puts).
+                    reporter.add(done if isinstance(done, int) else 1)
+            except GiveUp as e:
+                errors += 1
+                if reporter:
+                    reporter.errors += 1
+                print(
+                    f"work item {i} failed after {e.attempts} "
+                    f"attempts: {e.cause!r}",
+                    file=sys.stderr,
+                )
+                if errors > max_errors:
+                    raise
             if errors > max_errors:
                 return
 
@@ -124,6 +133,17 @@ def add_common_args(ap):
                     help="TLS: trust this CA for --target (rig chain)")
     ap.add_argument("--token", default=None,
                     help="bearer token sent as authorization metadata")
+    ap.add_argument("--fault-plan", default=None,
+                    help="faultline plan: inline JSON or @path "
+                    "(k8s1m_tpu/faultline — deterministic fault "
+                    "injection for the run)")
+
+
+def apply_fault_plan(args) -> None:
+    """Install the --fault-plan (if any) as the process's injector."""
+    fp = getattr(args, "fault_plan", None)
+    if fp:
+        faultline.install_plan(faultline.FaultPlan.from_arg(fp))
 
 
 def client_factory(args):

@@ -43,18 +43,41 @@ def parse_args(argv=None):
     return ap.parse_args(argv)
 
 
+def _deadline_wrapper() -> str | None:
+    """Locate tools/with_deadline.py: K8S1M_WITH_DEADLINE wins (how an
+    installed/wheel deployment points at it), else the repo-checkout
+    layout (this file's ``parents[2]/tools/``).  None = not found; the
+    caller warns and runs the point unwrapped rather than failing the
+    sweep — an unwrapped point merely loses the in-process deadline."""
+    import os
+    import pathlib
+
+    env = os.environ.get("K8S1M_WITH_DEADLINE")
+    if env:
+        if pathlib.Path(env).is_file():
+            return env
+        print(f"# K8S1M_WITH_DEADLINE={env!r} does not exist",
+              file=sys.stderr)
+        return None
+    p = pathlib.Path(__file__).resolve().parents[2] / "tools" / "with_deadline.py"
+    return str(p) if p.is_file() else None
+
+
 def run_point(args, rate: int) -> dict | None:
     pods = max(args.min_pods, int(rate * args.seconds))
     # The point self-deadlines IN-PROCESS (tools/with_deadline.py): a
     # subprocess.run(timeout=) kill mid-TPU-op would lose the axon grant
     # and take the pool down for every later point.  The outer timeout
     # stays as a last resort, with slack so it should never fire first.
-    import pathlib
-    wrapper = str(
-        pathlib.Path(__file__).resolve().parents[2] / "tools" / "with_deadline.py"
-    )
-    cmd = [
-        sys.executable, wrapper, str(args.timeout),
+    wrapper = _deadline_wrapper()
+    if wrapper is None:
+        print("# with_deadline.py not found (set K8S1M_WITH_DEADLINE); "
+              "running unwrapped — only the outer timeout guards this "
+              "point", file=sys.stderr)
+        head = [sys.executable]
+    else:
+        head = [sys.executable, wrapper, str(args.timeout)]
+    cmd = head + [
         "-m", "k8s1m_tpu.tools.sched_bench",
         "--nodes", str(args.nodes), "--pods", str(pods),
         "--rate", str(rate), "--score-pct", str(args.score_pct),

@@ -116,7 +116,30 @@ def parse_args(argv=None):
         "the collapsed-stack artifact to PATH, and print the self-time "
         "top table to stderr (the pprof/Parca role)",
     )
+    ap.add_argument(
+        "--fault-plan", default=None,
+        help="faultline plan: inline JSON or @path "
+        "(k8s1m_tpu/faultline — deterministic drop/delay/disconnect/"
+        "conflict injection across the store wire and the coordinator; "
+        "injected-fault and retry counts land in the output detail)",
+    )
     return ap.parse_args(argv)
+
+
+def _resilience_detail() -> dict:
+    """Injected-fault + retry evidence for the output JSON (empty when
+    no fault plan is active)."""
+    from k8s1m_tpu import faultline
+
+    fired = faultline.active_injector().fire_counts()
+    if not fired:
+        return {}
+    return {
+        "faults_injected": fired,
+        "retry_attempts": faultline.retry_counts(),
+        "give_ups": faultline.give_up_counts(),
+        "recovery": faultline.recovery_stats(),
+    }
 
 
 def write_wave(store, items) -> None:
@@ -236,6 +259,10 @@ def main(argv=None):
         args.chunk = (1 << 12) if args.backend == "pallas" else (1 << 14)
     if args.stress_watchers and not args.target:
         raise SystemExit("--stress-watchers requires --target (wire store)")
+    from k8s1m_tpu import faultline
+
+    if args.fault_plan:
+        faultline.install_plan(faultline.FaultPlan.from_arg(args.fault_plan))
 
     if args.target:
         from k8s1m_tpu.store.remote import RemoteStore
@@ -361,7 +388,10 @@ def main(argv=None):
         churn = _ChurnFrontier(coord, key_strs)
         deleted = 0
         with _bench_window(args, coord, store):
-            while emitted < args.pods or coord.queue or coord._inflights:
+            while (
+                emitted < args.pods or coord.queue or coord._inflights
+                or coord._backoff
+            ):
                 due = min(
                     args.pods, 1 + int(args.rate * (time.perf_counter() - t0))
                 )
@@ -386,6 +416,7 @@ def main(argv=None):
                     emitted >= args.pods
                     and not coord.queue
                     and not coord._inflights
+                    and not coord._backoff
                 ):
                     bound += coord.run_until_idle()
                     if args.churn:
@@ -418,6 +449,7 @@ def main(argv=None):
                 "p50_ms": round(lat.quantile(0.5) * 1e3, 2),
                 "p95_ms": round(lat.quantile(0.95) * 1e3, 2),
                 "p99_ms": round(lat.quantile(0.99) * 1e3, 2),
+                **_resilience_detail(),
             },
         }), flush=True)
         return
@@ -460,7 +492,7 @@ def main(argv=None):
                 if dels:
                     write_wave(store, [(keys[i], None) for i in dels])
                     deleted += len(dels)
-                if not coord.queue and not coord._inflights:
+                if not coord.queue and not coord._inflights and not coord._backoff:
                     idle += 1
                     if idle > 1 and coord.drain_watches() == 0:
                         break
@@ -497,6 +529,7 @@ def main(argv=None):
             "schedule_s": round(sched_s, 2),
             "stress_watchers": args.stress_watchers,
             "p50_bind_ms": p50_ms,
+            **_resilience_detail(),
         },
     }), flush=True)
 

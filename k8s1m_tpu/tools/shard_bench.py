@@ -137,7 +137,10 @@ def run_worker(args) -> None:
     ended = False
     while idle_ticks < 40:
         n = member.tick(time.monotonic())
-        if n == 0 and not coord.queue and not coord._inflights:
+        if (
+            n == 0 and not coord.queue and not coord._inflights
+            and not coord._backoff
+        ):
             # Only start counting down once the producer declared done —
             # a rate-paced load has idle gaps longer than the countdown.
             if ended or (ended := store.get(END_KEY) is not None):

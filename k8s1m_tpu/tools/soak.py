@@ -23,9 +23,34 @@ written (with the RSS series) to ``--out``:
   never stall out);
 - ``stalls == 0``: after the churn window every canary watch still
   delivers a fresh write within ``--canary-timeout`` seconds — the
-  streams are live, not just uncanceled.
+  streams are live, not just uncanceled;
+- ``event_loss == 0``: every canary write issued during the soak is
+  delivered exactly once, counted across any mid-soak failover (the
+  watch-event-loss ledger).
 
     python -m k8s1m_tpu.tools.soak --seconds 600 --idle 5000 --rate 300
+
+**Faultline mode** (the hour-scale robustness drill, ISSUE 1): run the
+same shape under an active deterministic fault plan
+(k8s1m_tpu/faultline) with a mid-soak tier-replica SIGKILL, WAL fsync
+on, and a forced compaction right behind the kill:
+
+    python -m k8s1m_tpu.tools.soak --seconds 3600 --rate 300 \
+        --fault-plan default --tier-replicas 2 --kill-tier-at 1800 \
+        --wal-mode fsync --out artifacts/soak_faultline.json
+
+The canary population rides the victim replica; at ``--kill-tier-at``
+the driver SIGKILLs it, then resumes every canary on the survivor from
+its last delivered revision (the haproxy-pulls-a-dead-backend contract,
+test_tier_replicas.py) and measures recovery time until the ledger is
+caught up.  The fault plan itself reaches the churn bench via
+``K8S1M_FAULT_PLAN`` — injected wire faults are retried by the shared
+RetryPolicy and surface in the output as ``resilience`` (injected-fault
+counts, retry totals, p50/p99 recovery per fault class).  Note: a
+``watch.tier`` upstream fault cancels that replica's clients BY
+CONTRACT (the cache cannot re-serve lost events), so the canned default
+plan exercises the client-side classes and leaves tier failure to the
+harsher SIGKILL drill.
 """
 
 from __future__ import annotations
@@ -44,6 +69,29 @@ from grpc import aio
 
 IDLE_PREFIX = b"/registry/configmaps/soak/"
 CANARY_PREFIX = b"/registry/leases/soak/"
+
+# The canned --fault-plan=default drill: every client-side fault class
+# at rates an hour of churn turns into hundreds of firings, plus a
+# schedule-driven coordinator watch loss.  Deterministic by seed.
+DEFAULT_FAULT_PLAN = {
+    "seed": 42,
+    "faults": [
+        {"component": "store.wire", "op": "put", "kind": "disconnect",
+         "probability": 0.002},
+        {"component": "store.wire", "op": "put_batch",
+         "kind": "partial_write", "probability": 0.01},
+        {"component": "store.wire", "op": "bind_batch",
+         "kind": "disconnect", "probability": 0.005},
+        {"component": "store.wire", "op": "range", "kind": "delay",
+         "probability": 0.005, "delay_s": 0.02},
+        {"component": "store.wire", "op": "watch.recv",
+         "kind": "disconnect", "probability": 0.0005},
+        {"component": "coordinator.bind", "op": "cas",
+         "kind": "stale_revision", "probability": 0.002},
+        {"component": "coordinator.watch", "op": "poll",
+         "kind": "disconnect", "after": 10_000, "every_n": 200_000},
+    ],
+}
 
 
 def _rss_mb(pid: int) -> float:
@@ -83,12 +131,113 @@ def parse_args(argv=None):
                     "and allocator arenas legitimately fill during "
                     "ramp-up; a LEAK keeps growing after it")
     ap.add_argument("--canary-timeout", type=float, default=30.0)
-    ap.add_argument("--out", default="artifacts/soak_secured_tier.json")
+    ap.add_argument("--out", default=None,
+                    help="result path (default: artifacts/soak_secured_"
+                    "tier.json, or artifacts/soak_faultline.json when a "
+                    "fault plan is active)")
+    ap.add_argument("--fault-plan", default=None,
+                    help="faultline plan: inline JSON, @path, or "
+                    "'default' for the canned client-side drill "
+                    "(k8s1m_tpu/faultline; exported to the churn bench "
+                    "via K8S1M_FAULT_PLAN)")
+    ap.add_argument("--tier-replicas", type=int, default=1,
+                    help="watch-cache tier replicas (>= 2 enables the "
+                    "kill drill: canaries ride the last replica)")
+    ap.add_argument("--kill-tier-at", type=float, default=0.0,
+                    help="SIGKILL the last tier replica this many "
+                    "seconds into the churn window (0 = no kill; "
+                    "requires --tier-replicas >= 2)")
+    ap.add_argument("--wal-mode", default="buffered",
+                    choices=["none", "buffered", "fsync"],
+                    help="store WAL durability for the soak (the "
+                    "faultline drill runs fsync)")
     args = ap.parse_args(argv)
     if args.rate <= 0:
         ap.error("--rate must be > 0 (the soak is a paced-churn shape; "
                  "sched_bench's rate=0 branch reports different fields)")
+    if args.kill_tier_at and args.tier_replicas < 2:
+        ap.error("--kill-tier-at requires --tier-replicas >= 2 (the "
+                 "bench and idle population need a survivor)")
+    if args.kill_tier_at and args.kill_tier_at >= args.seconds:
+        ap.error("--kill-tier-at must fall inside the churn window")
+    if args.out is None:
+        args.out = ("artifacts/soak_faultline.json" if args.fault_plan
+                    else "artifacts/soak_secured_tier.json")
     return args
+
+
+async def _kill_and_resume(
+    args, tier_procs, canary_keys, canary_muxes, canary_delivered,
+    canary_written, survivor_channel, seed,
+) -> dict:
+    """The mid-soak failover drill: SIGKILL the tier replica the
+    canaries ride, resume every canary on the survivor from its own
+    last-delivered revision (per-watch — the stream-level max would skip
+    events for a lagged watch; test_tier_replicas.py contract), force a
+    compaction right behind the kill (failover and history-trim
+    interacting is the case single-fault drills never see), and measure
+    recovery: wall time from SIGKILL until the event ledger is caught
+    up again.
+
+    Never fatal: an hour of soak evidence must not be destroyed by the
+    drill itself, so a failed resume is REPORTED (``caught_up: false``
+    plus ``error``, which fails the run's gate) instead of raised."""
+    from k8s1m_tpu.tools.watch_scale import MuxWatch
+
+    victim_proc = tier_procs[-1]
+    victim = canary_muxes[0]
+    t_kill = time.monotonic()
+    victim_proc.kill()                      # SIGKILL, not terminate
+    # Let the broken stream drain: events the victim already handed to
+    # the client library still land in `delivered`/`watch_rev`; reading
+    # the resume points too early would replay them as duplicates.
+    await asyncio.sleep(0.5)
+    resume = MuxWatch(survivor_channel)
+    starts = [
+        victim.watch_rev.get(1 + i, victim.create_rev) + 1
+        for i in range(len(canary_keys))
+    ]
+    try:
+        await resume.create(canary_keys, 1, start_revision=starts)
+        # Generous create window: the survivor shares one event loop
+        # with its full watch fan-out, and on a small host every other
+        # soak process competes for the same cores.
+        await resume.wait_created(
+            len(canary_keys), timeout=max(120.0, 4 * args.canary_timeout)
+        )
+    except Exception as e:
+        print(f"# tier kill drill: resume FAILED: {e!r}", file=sys.stderr)
+        canary_muxes.append(resume)      # count whatever it delivers
+        return {
+            "at_s": round(args.kill_tier_at, 1),
+            "recovery_s": None,
+            "caught_up": False,
+            "error": repr(e),
+        }
+    canary_muxes.append(resume)
+    try:
+        st = await seed.status()
+        if st.header.revision - 2000 > 1:
+            await seed.compact(st.header.revision - 2000)
+    except Exception:
+        pass
+    deadline = time.monotonic() + args.canary_timeout
+    while (
+        canary_delivered() < canary_written()
+        and time.monotonic() < deadline
+    ):
+        await asyncio.sleep(0.05)
+    recovery_s = time.monotonic() - t_kill
+    caught_up = canary_delivered() >= canary_written()
+    print(
+        f"# tier kill drill: recovery_s={recovery_s:.2f} "
+        f"caught_up={caught_up}", file=sys.stderr,
+    )
+    return {
+        "at_s": round(args.kill_tier_at, 1),
+        "recovery_s": round(recovery_s, 3),
+        "caught_up": caught_up,
+    }
 
 
 async def _wait_port(port: int, proc, deadline_s: float) -> None:
@@ -116,16 +265,28 @@ async def amain(args) -> dict:
     token = "soak-bearer-token"
     env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
 
+    plan = None
+    fault_env = env
+    if args.fault_plan:
+        from k8s1m_tpu.faultline import FaultPlan
+
+        if args.fault_plan == "default":
+            plan = FaultPlan.from_json(DEFAULT_FAULT_PLAN)
+        else:
+            plan = FaultPlan.from_arg(args.fault_plan)
+        # The hooks live in the CLIENTS (bench coordinator + RemoteStore,
+        # tier upstream pumps); the soak's own ledger writes stay clean.
+        fault_env = {**env, "K8S1M_FAULT_PLAN": plan.to_json()}
+
     store_port = _free_port()
     wal_dir = tempfile.mkdtemp(prefix="soak-wal-")
     store_proc = subprocess.Popen(
         [sys.executable, "-m", "k8s1m_tpu.store.server_main",
          "--port", str(store_port), "--host", "127.0.0.1",
-         "--metrics-port", "0", "--wal-dir", wal_dir, "--wire", "native"],
+         "--metrics-port", "0", "--wal-dir", wal_dir,
+         "--wal-default", args.wal_mode, "--wire", "native"],
         env=env,
     )
-    tier_port = _free_port()
-    tier_proc = None
     procs = [store_proc]
     try:
         await _wait_port(store_port, store_proc, 60)
@@ -142,17 +303,26 @@ async def amain(args) -> dict:
         if wave:
             await seed.put_batch(wave)
 
-        tier_proc = subprocess.Popen(
-            [sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
-             "--upstream", f"127.0.0.1:{store_port}",
-             "--host", "127.0.0.1", "--port", str(tier_port),
-             "--prefix", "/registry/",
-             "--tls-cert", certs.cert_pem, "--tls-key", certs.key_pem,
-             "--auth-token", token],
-            env=env,
-        )
-        procs.append(tier_proc)
-        await _wait_port(tier_port, tier_proc, 120 + args.idle / 1000)
+        tier_ports = [_free_port() for _ in range(args.tier_replicas)]
+        tier_procs = []
+        for port in tier_ports:
+            p = subprocess.Popen(
+                [sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+                 "--upstream", f"127.0.0.1:{store_port}",
+                 "--host", "127.0.0.1", "--port", str(port),
+                 "--prefix", "/registry/",
+                 "--tls-cert", certs.cert_pem, "--tls-key", certs.key_pem,
+                 "--auth-token", token],
+                env=fault_env,
+            )
+            tier_procs.append(p)
+            procs.append(p)
+        for port, p in zip(tier_ports, tier_procs):
+            await _wait_port(port, p, 120 + args.idle / 1000)
+        tier_proc = tier_procs[0]       # survivor: RSS trend + bench target
+        tier_port = tier_ports[0]
+        # Canaries ride the LAST replica — the kill drill's victim.
+        canary_port = tier_ports[-1]
 
         # Idle + canary populations through the SECURED tier.
         channel = secure_channel_for(
@@ -172,11 +342,19 @@ async def amain(args) -> dict:
             next_id += len(keys)
         for m, n in zip(muxes, counts):
             await m.wait_created(n, timeout=120 + args.idle / 500)
-        canary = MuxWatch(channel)
+        canary_channel = secure_channel_for(
+            f"127.0.0.1:{canary_port}", certs.ca_pem, token,
+            options=[("grpc.max_receive_message_length", 64 << 20)],
+        )
+        canary = MuxWatch(canary_channel)
         canary_keys = [CANARY_PREFIX + b"canary-%03d" % i
                        for i in range(args.canaries)]
-        await canary.create(canary_keys, next_id)
+        await canary.create(canary_keys, 1)
         await canary.wait_created(args.canaries, timeout=60)
+        canary_muxes = [canary]         # victim stream [+ survivor resume]
+
+        def canary_delivered() -> int:
+            return sum(m.delivered for m in canary_muxes)
 
         # Churn through the tier: the full coordinator loop as a
         # subprocess (create -> watch -> schedule -> CAS bind -> delete)
@@ -189,14 +367,20 @@ async def amain(args) -> dict:
              "--backend", "xla", "--churn",
              "--target", f"127.0.0.1:{tier_port}",
              "--ca-pem", certs.ca_pem, "--token", token],
-            env=env, stdout=subprocess.PIPE, text=True,
+            env=fault_env, stdout=subprocess.PIPE, text=True,
         )
         procs.append(bench_proc)
 
         # RSS sampler over the churn window, with periodic MVCC
         # compaction (keep a revision margin so the tier's watch
-        # resume window stays usable).
+        # resume window stays usable).  Every sample tick also writes
+        # one ledger value per canary key: `canary_written` vs
+        # `canary_delivered()` is the exactly-once watch-event ledger
+        # the `event_loss == 0` gate settles on.
         series = []
+        canary_written = 0
+        tick = 0
+        kill_info = None
         t0 = time.monotonic()
         next_compact = t0 + args.compact_every
         while bench_proc.poll() is None:
@@ -209,6 +393,26 @@ async def amain(args) -> dict:
                         await seed.compact(target)
                 except Exception:
                     pass    # compaction is best-effort in the soak
+            tick += 1
+            try:
+                for k in canary_keys:
+                    await seed.put(k, b"tick-%06d" % tick)
+                    # Counted per put, not per tick: a loop that dies
+                    # after 3 of N puts DID write 3 events — counting 0
+                    # would turn them into phantom negative event_loss.
+                    canary_written += 1
+            except Exception:
+                pass        # ledger writes pause while the store restarts
+            if (
+                args.kill_tier_at
+                and kill_info is None
+                and time.monotonic() - t0 >= args.kill_tier_at
+            ):
+                kill_info = await _kill_and_resume(
+                    args, tier_procs, canary_keys, canary_muxes,
+                    canary_delivered, lambda: canary_written,
+                    channel, seed,
+                )
             series.append({
                 "t_s": round(time.monotonic() - t0, 1),
                 "tier_rss_mb": round(_rss_mb(tier_proc.pid), 1),
@@ -234,18 +438,34 @@ async def amain(args) -> dict:
         soak_s = time.monotonic() - t0
 
         # Liveness probe: every canary stream must deliver a fresh write.
-        base = canary.delivered
+        base = canary_delivered()
         for i, k in enumerate(canary_keys):
             await seed.put(k, b"alive-%d" % i)
+        canary_written += args.canaries
         deadline = time.monotonic() + args.canary_timeout
         while (
-            canary.delivered - base < args.canaries
+            canary_delivered() - base < args.canaries
             and time.monotonic() < deadline
         ):
             await asyncio.sleep(0.1)
-        stalls = args.canaries - (canary.delivered - base)
+        stalls = args.canaries - (canary_delivered() - base)
 
-        canceled = sum(m.canceled for m in muxes) + canary.canceled
+        # Event-loss ledger: every canary write issued after watch
+        # registration must have been delivered exactly once, counted
+        # across the victim stream and any failover resume.  Positive =
+        # lost events; negative = duplicates (a resume that replayed).
+        deadline = time.monotonic() + args.canary_timeout
+        while (
+            canary_delivered() < canary_written
+            and time.monotonic() < deadline
+        ):
+            await asyncio.sleep(0.1)
+        event_loss = canary_written - canary_delivered()
+
+        canceled = (
+            sum(m.canceled for m in muxes)
+            + sum(m.canceled for m in canary_muxes)
+        )
 
         # RSS trend: mean of the first vs last third of the POST-WARMUP
         # series (the ramp legitimately fills caches/arenas; a leak
@@ -283,27 +503,52 @@ async def amain(args) -> dict:
 
         for m in muxes:
             await m.close()
-        await canary.close()
+        for m in canary_muxes:
+            await m.close()
+        await canary_channel.close()
         await channel.close()
         await seed.close()
 
+        detail = bench_line["detail"]
         result = {
-            "metric": "soak_secured_tier_seconds",
+            "metric": ("soak_faultline_seconds" if plan
+                       else "soak_secured_tier_seconds"),
             "value": round(soak_s, 1),
             "unit": "s",
             "vs_baseline": None,
-            "passed": bool(rss_flat and canceled == 0 and stalls == 0),
+            "passed": bool(
+                rss_flat and canceled == 0 and stalls == 0
+                and event_loss == 0
+                and (kill_info is None or kill_info["caught_up"])
+            ),
             "rss_flat": rss_flat,
             "rss_growth": growth,
             "canceled": canceled,
             "stalls": stalls,
+            "event_loss": event_loss,
+            "canary_writes": canary_written,
             "idle_watches": args.idle,
+            "wal_mode": args.wal_mode,
+            "tier_replicas": args.tier_replicas,
+            "tier_kill": kill_info,
+            "fault_plan": (
+                {"seed": plan.seed, "specs": [f.to_obj() for f in plan.faults]}
+                if plan else None
+            ),
+            # The churn bench's injected-fault + retry evidence (it is
+            # the process the plan's client-side hooks fire in).
+            "resilience": {
+                k: detail[k]
+                for k in ("faults_injected", "retry_attempts",
+                          "give_ups", "recovery")
+                if k in detail
+            } or None,
             "churn": {
                 "rate": args.rate,
-                "bound": bench_line["detail"]["bound"],
-                "deleted": bench_line["detail"]["deleted"],
-                "binds_per_sec": bench_line["detail"]["binds_per_sec"],
-                "p50_ms": bench_line["detail"]["p50_ms"],
+                "bound": detail["bound"],
+                "deleted": detail["deleted"],
+                "binds_per_sec": detail["binds_per_sec"],
+                "p50_ms": detail["p50_ms"],
             },
             "samples": len(series),
         }

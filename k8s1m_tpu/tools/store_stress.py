@@ -12,10 +12,12 @@ import json
 import os
 import time
 
+from k8s1m_tpu import faultline
 from k8s1m_tpu.store.native import prefix_end
 from k8s1m_tpu.tools.common import (
     RateReporter,
     add_common_args,
+    apply_fault_plan,
     client_factory,
     run_sharded,
 )
@@ -44,6 +46,7 @@ def parse_args(argv=None):
 
 
 async def amain(args) -> dict:
+    apply_fault_plan(args)
     if args.native_client:
         from k8s1m_tpu.store.native import wire_stress_put
 
@@ -65,6 +68,10 @@ async def amain(args) -> dict:
     put_rep = RateReporter("puts", quiet=args.quiet)
 
     async def put_work(client, i):
+        # Faultline hook: the asyncio client's wire edge (the sync
+        # RemoteStore carries its own hooks; this one makes --fault-plan
+        # meaningful for the load generators too).
+        await faultline.acheck("store.wire", "put")
         await client.put(PREFIX + b"%012d" % i, value)
 
     t0 = time.perf_counter()
@@ -77,6 +84,7 @@ async def amain(args) -> dict:
     range_rep = RateReporter("ranges", quiet=args.quiet)
 
     async def range_work(client, i):
+        await faultline.acheck("store.wire", "range")
         start = PREFIX + b"%012d" % ((i * 37) % max(1, args.puts))
         await client.range(start, prefix_end(PREFIX), limit=args.range_limit)
 
@@ -87,12 +95,18 @@ async def amain(args) -> dict:
     )
     range_s = time.perf_counter() - t1
 
-    return {
+    out = {
         "puts": args.puts,
         "puts_per_sec": round(args.puts / put_s, 1),
+        "put_errors": put_rep.errors,
         "ranges": args.ranges,
         "ranges_per_sec": round(args.ranges / range_s, 1) if args.ranges else 0,
+        "range_errors": range_rep.errors,
     }
+    fired = faultline.active_injector().fire_counts()
+    if fired:
+        out["faults_injected"] = fired
+    return out
 
 
 def main(argv=None):

@@ -7,6 +7,7 @@ and pending pods, run coordinator cycles, assert on the *store* state
 """
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -110,6 +111,10 @@ def test_node_added_mid_run_via_watch(store):
         bound += c.step()
         if bound:
             break
+        # The infeasible attempt parked p0 on the retry-backoff heap
+        # (real-time delay); wait it out like the drivers do, or a
+        # warm-kernel run steps 5 times before the pod re-enters.
+        time.sleep(c.backoff_wait_s() or 0.001)
     assert bound == 1
     assert node_of(store, "default", "p0") == "n1"
 

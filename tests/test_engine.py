@@ -149,7 +149,13 @@ def test_topk_by_argmax_matches_lax_top_k():
     lax.top_k on TPU), so the CPU suite would otherwise never assert the
     equivalence the dispatch relies on.  lax.top_k runs on CPU too:
     compare the forms directly on duplicate-heavy int32 inputs,
-    including all-equal rows and sentinel-min priorities.
+    including all-equal rows and the -1 INFEASIBLE sentinel.
+
+    Tie semantics caveat: the earlier-index-wins tie-break this test
+    asserts is only verified on CPU (both forms here run on the CPU
+    backend); on silicon the same equivalence — including index order
+    under ties — is covered by the on-chip parity suite
+    (tests/test_pallas_topk.py via the recovery-daemon batch).
     """
     import jax.numpy as jnp
     from jax import lax

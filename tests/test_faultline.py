@@ -1,0 +1,397 @@
+"""faultline: deterministic injection + unified retry policy (tier-1).
+
+Three layers, all fast and CPU-only:
+
+1. The injector's decision core — same seed => same injected-fault
+   sequence against the same operation stream (THE faultline contract),
+   schedule semantics (after / every_n / max_fires), and per-spec PRNG
+   stream independence.
+2. RetryPolicy — capped jittered backoff under a deadline budget,
+   GiveUp carrying the cause, recovery-sample bookkeeping.
+3. The smoke drill (the never-rot gate): an in-process store -> watch ->
+   schedule -> bind loop under an active plan injecting disconnects into
+   the coordinator's watch drain, forced conflicts into the bind CAS and
+   delays into both — every pod still lands exactly once in the store
+   (zero event loss) with bounded retries.
+"""
+
+import json
+
+import pytest
+
+from k8s1m_tpu import faultline
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
+from k8s1m_tpu.faultline import (
+    FaultPlan,
+    FaultSpec,
+    GiveUp,
+    InjectedFault,
+    Injector,
+    RetryPolicy,
+    install_plan,
+)
+from k8s1m_tpu.faultline.policy import (
+    default_retryable,
+    policy_for,
+    recovery_stats,
+)
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.node_table import NodeInfo
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+from k8s1m_tpu.store.native import CompactedError, MemStore
+
+
+@pytest.fixture(autouse=True)
+def _reset_injector():
+    """Never leak a plan into (or out of) a test: the injector is
+    process-global by design."""
+    install_plan(None)
+    yield
+    install_plan(None)
+
+
+# ---- 1. deterministic decision core ---------------------------------
+
+
+def _drive(injector, ops=400):
+    out = []
+    for i in range(ops):
+        op = ("put", "range", "txn")[i % 3]
+        d = injector.decide("store.wire", op)
+        if d is not None:
+            out.append((op, d.kind, i))
+    return out
+
+
+def test_same_seed_same_fault_sequence():
+    """The acceptance-criteria assertion: identical plan + identical op
+    stream => identical injected-fault sequence, every run."""
+    plan = FaultPlan(
+        [
+            FaultSpec("store.wire", "put", kind="disconnect",
+                      probability=0.15),
+            FaultSpec("store.wire", "*", kind="delay", probability=0.05,
+                      delay_s=0.001),
+        ],
+        seed=1234,
+    )
+    runs = [_drive(Injector(plan)) for _ in range(3)]
+    assert runs[0] == runs[1] == runs[2]
+    assert len(runs[0]) > 5          # the plan actually fires
+    # ...and the sequence is seed-keyed, not incidental: a different
+    # seed draws a different stream.
+    other = FaultPlan.from_json(plan.to_json())
+    other.seed = 99
+    assert _drive(Injector(other)) != runs[0]
+
+
+def test_fired_log_matches_between_runs():
+    plan = FaultPlan(
+        [FaultSpec("store.wire", "*", kind="err5xx", probability=0.2)],
+        seed=7,
+    )
+    i1, i2 = Injector(plan), Injector(plan)
+    _drive(i1), _drive(i2)
+    assert i1.fired_log == i2.fired_log
+
+
+def test_schedule_after_every_n_max_fires():
+    spec = FaultSpec("c", "op", kind="disconnect", after=3, every_n=2,
+                     max_fires=2)
+    inj = Injector(FaultPlan([spec]))
+    fired = [inj.decide("c", "op") is not None for _ in range(12)]
+    # Ops 1-3 skipped; then every 2nd matching op (5th, 7th), capped at 2.
+    assert fired == [False] * 4 + [True, False, True] + [False] * 5
+
+
+def test_spec_streams_are_independent():
+    """Adding a second spec must not perturb the first spec's draws —
+    each spec owns a (seed, index)-keyed PRNG stream."""
+    a = FaultSpec("store.wire", "put", kind="disconnect", probability=0.2)
+    b = FaultSpec("watch.tier", "*", kind="drop", probability=0.5)
+    solo = Injector(FaultPlan([a], seed=5))
+    both = Injector(FaultPlan([a, b], seed=5))
+    seq_solo = [solo.decide("store.wire", "put") is not None
+                for _ in range(300)]
+    seq_both = []
+    for i in range(300):
+        if i % 2:
+            both.decide("watch.tier", "upstream.recv")  # traffic on b
+        seq_both.append(both.decide("store.wire", "put") is not None)
+    assert seq_solo == seq_both
+
+
+def test_wildcards_and_json_roundtrip(tmp_path):
+    plan = FaultPlan(
+        [FaultSpec("*", "*", kind="delay", every_n=1, delay_s=0.5)],
+        seed=3,
+    )
+    again = FaultPlan.from_json(plan.to_json())
+    assert again.seed == 3
+    assert again.faults == plan.faults
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    assert FaultPlan.from_arg(f"@{p}").faults == plan.faults
+    assert Injector(again).decide("anything", "at-all") is not None
+
+
+def test_spec_validation_rejects_garbage():
+    with pytest.raises(ValueError):
+        FaultSpec("c", kind="meteor-strike", probability=0.1)
+    with pytest.raises(ValueError):
+        FaultSpec("c", kind="drop", probability=1.5)
+    with pytest.raises(ValueError):
+        FaultSpec("c", kind="drop")          # never fires
+    with pytest.raises(ValueError):
+        FaultSpec.from_obj({"component": "c", "probability": 0.1,
+                            "tyop": True})
+
+
+def test_check_raises_on_failure_kinds_and_counts():
+    inj = Injector(FaultPlan(
+        [FaultSpec("c", "op", kind="disconnect", every_n=1)]
+    ))
+    with pytest.raises(InjectedFault):
+        inj.check("c", "op")
+    assert inj.fire_counts() == {"disconnect": 1}
+
+
+def test_env_plan_inheritance(monkeypatch):
+    """Subprocess topologies inherit the plan via K8S1M_FAULT_PLAN,
+    read on first use."""
+    import k8s1m_tpu.faultline.plan as planmod
+
+    plan = FaultPlan([FaultSpec("c", "op", kind="drop", every_n=1)], seed=9)
+    monkeypatch.setenv("K8S1M_FAULT_PLAN", plan.to_json())
+    monkeypatch.setattr(planmod, "_env_loaded", False)
+    monkeypatch.setattr(planmod, "_active", planmod._NOOP)
+    assert faultline.decide("c", "op") is not None
+
+
+# ---- 2. RetryPolicy --------------------------------------------------
+
+
+def test_backoff_grows_and_caps():
+    pol = RetryPolicy("t", base_delay_s=0.1, max_delay_s=0.4,
+                      multiplier=2.0, jitter=0.0)
+    delays = [pol.delay_for(a) for a in (1, 2, 3, 4, 5)]
+    assert delays == [0.1, 0.2, 0.4, 0.4, 0.4]
+
+
+def test_deadline_budget_bounds_total_sleep():
+    pol = RetryPolicy("t", max_attempts=100, base_delay_s=1.0,
+                      max_delay_s=1.0, jitter=0.0, deadline_s=2.5)
+    slept = []
+    with pytest.raises(GiveUp) as ei:
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                 sleep=slept.append)
+    assert sum(slept) <= 2.5 + 1e-9
+    assert isinstance(ei.value.cause, ConnectionError)
+
+
+def test_call_retries_then_succeeds_and_records_recovery():
+    pol = RetryPolicy("t", max_attempts=5, base_delay_s=0.0,
+                      jitter=0.0)
+    n = [0]
+
+    def flaky():
+        n[0] += 1
+        if n[0] < 3:
+            raise TimeoutError("blip")
+        return "ok"
+
+    assert pol.call(flaky, sleep=lambda s: None) == "ok"
+    assert n[0] == 3
+    assert recovery_stats()["t"]["count"] >= 1
+
+
+def test_non_retryable_propagates_immediately():
+    pol = RetryPolicy("t", max_attempts=5)
+    n = [0]
+
+    def bad():
+        n[0] += 1
+        raise CompactedError("semantic, not transient")
+
+    with pytest.raises(CompactedError):
+        pol.call(bad, sleep=lambda s: None)
+    assert n[0] == 1
+
+
+def test_default_retryable_classification():
+    d = faultline.FaultDecision("c", "op", "disconnect", 0.0, 0, 1)
+    assert default_retryable(InjectedFault(d))
+    assert default_retryable(ConnectionError())
+    assert default_retryable(TimeoutError())
+    assert not default_retryable(CompactedError("compacted"))
+    assert not default_retryable(ValueError("bad request"))
+
+
+def test_delay_for_never_overflows_at_retry_forever_counts():
+    """watch.tier retries effectively forever; after ~1024 consecutive
+    failures a naive `multiplier ** attempt` raises OverflowError and
+    would kill the upstream pump mid-outage."""
+    pol = policy_for("watch.tier")
+    for attempt in (1, 100, 1025, 10_000_000):
+        assert 0.0 <= pol.delay_for(attempt) <= pol.max_delay_s
+
+
+def test_unary_hook_never_silently_no_ops_a_counted_fire():
+    """A fired (counted) injection must have an effect: kinds a unary op
+    cannot express fail like a dropped request instead of silently
+    inflating the evidence JSON's injected-fault counts."""
+    from k8s1m_tpu.store.remote import _check_unary
+
+    install_plan(FaultPlan(
+        [FaultSpec("store.wire", "put", kind="stale_revision", every_n=1)]
+    ))
+    with pytest.raises(InjectedFault):
+        _check_unary("put")
+    # The same kind is returned, not raised, where the op expresses it.
+    install_plan(FaultPlan(
+        [FaultSpec("store.wire", "range", kind="stale_revision", every_n=1)]
+    ))
+    d = _check_unary("range", ("stale_revision",))
+    assert d is not None and d.kind == "stale_revision"
+
+
+def test_max_attempts_one_never_retries():
+    pol = RetryPolicy("t", max_attempts=1)
+    with pytest.raises(GiveUp) as ei:
+        pol.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                 sleep=lambda s: None)
+    assert ei.value.attempts == 1
+
+
+# ---- 3. the smoke drill (never-rot gate) -----------------------------
+
+
+PROFILE = Profile(topology_spread=0, interpod_affinity=0)
+SPEC = TableSpec(max_nodes=128, max_zones=16, max_regions=8)
+PODS = PodSpec(batch=32)
+N_PODS = 60
+
+
+def _seed_cluster(store):
+    for i in range(8):
+        store.put(
+            node_key(f"n{i}"),
+            encode_node(NodeInfo(
+                name=f"n{i}", cpu_milli=4000, mem_kib=8 << 20, pods=16,
+                labels={"topology.kubernetes.io/zone": f"z{i % 4}"},
+            )),
+        )
+    for i in range(N_PODS):
+        store.put(
+            pod_key("default", f"p{i}"),
+            encode_pod(PodInfo(name=f"p{i}", namespace="default",
+                               cpu_milli=100, mem_kib=200 << 10)),
+        )
+
+
+class _FakeClock:
+    """Virtual time for the drill: sleeps advance the clock instead of
+    blocking, so backoff schedules replay identically run to run (and
+    the drill finishes in milliseconds).  Stands in for the coordinator
+    module's ``time``."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def perf_counter(self):
+        return self.t
+
+    def monotonic(self):
+        return self.t
+
+    def time(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _run_drill(seed: int):
+    """store -> watch -> schedule -> bind under disconnect+delay+conflict
+    injection on a virtual clock; returns
+    (binds, bound nodeName map, unschedulable, injector, retries)."""
+    import k8s1m_tpu.control.coordinator as coordmod
+
+    plan = FaultPlan(
+        [
+            # Watch loss: the drain sees a disconnect and must resync
+            # (relist recovers every lost event by construction).
+            FaultSpec("coordinator.watch", "poll", kind="disconnect",
+                      after=2, every_n=5, max_fires=3),
+            FaultSpec("coordinator.watch", "poll", kind="delay",
+                      probability=0.2, delay_s=0.0005),
+            # Forced CAS conflicts: the bind path requeues with backoff.
+            FaultSpec("coordinator.bind", "cas", kind="stale_revision",
+                      probability=0.25),
+            FaultSpec("coordinator.bind", "cas", kind="delay",
+                      probability=0.1, delay_s=0.0005),
+        ],
+        seed=seed,
+    )
+    inj = install_plan(plan)
+    retries_before = faultline.retry_counts().get("coordinator.bind", 0)
+    real_time = coordmod.time
+    coordmod.time = _FakeClock()
+    try:
+        with MemStore() as store:
+            _seed_cluster(store)
+            coord = Coordinator(
+                store, SPEC, PODS, PROFILE, chunk=64, k=4,
+                with_constraints=False, max_attempts=50, seed=seed,
+            )
+            coord.bootstrap()
+            total = coord.run_until_idle(max_cycles=100000)
+            bound = {}
+            for i in range(N_PODS):
+                kv = store.get(pod_key("default", f"p{i}"))
+                bound[f"p{i}"] = json.loads(kv.value)["spec"].get("nodeName")
+            unsched = dict(coord.unschedulable)
+            coord.close()
+    finally:
+        coordmod.time = real_time
+    retries = faultline.retry_counts().get("coordinator.bind", 0) \
+        - retries_before
+    return total, bound, unsched, inj, retries
+
+
+def test_smoke_zero_event_loss_and_bounded_retries():
+    total, bound, unsched, inj, retries = _run_drill(seed=21)
+    fired = inj.fire_counts()
+    # The plan actually bit: watch loss AND forced conflicts fired.
+    assert fired.get("disconnect", 0) >= 1
+    assert fired.get("stale_revision", 0) >= 5
+    # Zero event loss: every pod is bound in the STORE exactly once,
+    # none lost to an injected watch break or conflict, none parked —
+    # and each successful bind counted once (no double binds from the
+    # requeue path).
+    assert unsched == {}
+    assert sum(1 for v in bound.values() if v) == N_PODS
+    assert total == N_PODS
+    # Bounded retries: one backoff requeue per forced conflict (plus at
+    # most a few transient infeasible-in-wave requeues), not a tight
+    # loop burning attempts until the cycle cap.
+    assert fired["stale_revision"] <= retries
+    assert retries <= fired["stale_revision"] + 2 * N_PODS
+
+
+def test_smoke_is_deterministic_by_seed():
+    """Same seed => same injected sequence => same recovery outcome —
+    the end-to-end half of the determinism contract (the decision-layer
+    half is test_same_seed_same_fault_sequence).  Only holds because the
+    drill runs on a virtual clock: the injected sequence is a pure
+    function of (seed, op stream), and virtual time pins the op
+    stream."""
+    r1 = _run_drill(seed=33)
+    install_plan(None)
+    r2 = _run_drill(seed=33)
+    assert r1[3].fired_log == r2[3].fired_log
+    assert r1[3].fire_counts() == r2[3].fire_counts()
+    assert r1[1] == r2[1]            # identical store end-state
+    assert r1[4] == r2[4]            # identical retry totals

@@ -9,6 +9,7 @@ cmd/dist-scheduler/leader_activities.go:227-343).
 """
 
 import json
+import time
 
 import numpy as np
 import pytest
@@ -77,11 +78,18 @@ def run_until_idle(members, max_ticks=200):
         for m in members:
             progressed += m.tick(now)
         bound += progressed
-        if progressed == 0 and all(
-            not m.coordinator.queue and not m.coordinator._inflights
-            for m in members
-        ):
-            break
+        if progressed == 0:
+            if any(m.coordinator._backoff for m in members):
+                # Retried pods park on a REAL-time backoff heap; the
+                # virtual tick clock spins past it, so wait it out
+                # instead of declaring idle with work still pending.
+                time.sleep(0.005)
+                continue
+            if all(
+                not m.coordinator.queue and not m.coordinator._inflights
+                for m in members
+            ):
+                break
     return bound
 
 

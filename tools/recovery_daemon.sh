@@ -26,7 +26,12 @@
 # rc=3 fast-fails.
 set -o pipefail
 cd /root/repo
-out=BENCH_RECOVERY.md
+# Timestamped output: the daemon used to truncate the committed
+# BENCH_RECOVERY.md headline the moment the NEXT pool window opened, so
+# a short window could destroy an already-judged artifact (the 87,660
+# binds/s headline).  Each batch now gets its own file; promote a batch
+# to BENCH_RECOVERY.md by hand after reading it.
+out=BENCH_RECOVERY_$(date -u +%Y%m%dT%H%M%SZ).md
 
 probe() {
   python -u -c "
@@ -45,8 +50,11 @@ wait_for_pool() {
 # Mid-batch variant: bounded (~1h).  If the pool stays down that long,
 # the batch must still TERMINATE — write the failure rows and the
 # closing fence rather than spinning forever with a malformed artifact.
+# Each try costs up to 400s (250s probe self-deadline + 150s sleep), so
+# 9 tries bounds the wait at ~1h; the old default of 24 was ~2.7h worst
+# case while the comment claimed one hour.
 wait_for_pool_bounded() {
-  local tries=${1:-24}
+  local tries=${1:-9}
   for _ in $(seq 1 "$tries"); do
     if probe; then return 0; fi
     sleep 150

@@ -35,6 +35,17 @@ waiting out their delay) instead of the same pods tight-looping through
 every consecutive wave.  The bind and watch-drain paths are faultline
 injection hooks (components ``coordinator.bind`` / ``coordinator.watch``),
 so conflict storms and watch loss are reproducible by seed.
+
+**Overload control** (k8s1m_tpu/loadshed, opt-in via the ``loadshed`` /
+``breaker`` constructor args): a HealthController ticked once per cycle
+turns queue/backoff depth, conflict rate, cycle latency and resyncs
+into HEALTHY/DEGRADED/SHEDDING; DEGRADED shrinks the score window and
+drops constraint *scoring* (filtering always stays) and widens batch
+windows, SHEDDING additionally makes ``submit_external`` reject
+lowest-priority pods first; a CircuitBreaker around device dispatch
+falls back to the host-side oracle scheduler while open, so scheduling
+never fully stops (see tools/overload_drill.py for the drill that
+proves all of it).
 """
 
 from __future__ import annotations
@@ -73,9 +84,14 @@ from k8s1m_tpu.engine.cycle import (
     sample_rows_for,
     schedule_batch_packed,
 )
+from k8s1m_tpu.loadshed import CircuitBreaker, HealthController, Signals
+from k8s1m_tpu.loadshed import CLOSED as BREAKER_CLOSED
+from k8s1m_tpu.loadshed.breaker import FALLBACK_BINDS
 from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram
 from k8s1m_tpu.obs.trace import FlightRecorder
-from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.ops.priority import pod_priority_of
+from k8s1m_tpu.oracle import oracle_feasible, oracle_score
+from k8s1m_tpu.plugins.registry import Profile, degraded_profile
 from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
 from k8s1m_tpu.snapshot.node_table import NodeTableHost
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
@@ -225,6 +241,14 @@ class Coordinator:
         score_pct: int = 100,
         intake_filter=None,
         mesh=None,
+        # Overload control (k8s1m_tpu/loadshed): a HealthController makes
+        # submit_external shed past its watermarks and degrades the cycle
+        # (smaller score window, filter-only constraint plugins, widened
+        # batch windows) while pressure lasts; a CircuitBreaker guards
+        # device dispatch and falls back to the host-side oracle
+        # scheduler while open.  None (the default) = none of that runs.
+        loadshed: HealthController | None = None,
+        breaker: CircuitBreaker | None = None,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -288,6 +312,33 @@ class Coordinator:
             self._window_nodes, score_pct, chunk
         )
         self._window_i = 0
+        # Overload control: degraded-mode knobs are precomputed so the
+        # mode switch is a cached-executable swap, never a reconfigure
+        # (warm both modes before a latency-sensitive window — each is
+        # its own compiled step).
+        self.loadshed = loadshed
+        self.breaker = breaker
+        if loadshed is not None:
+            self._sample_rows_degraded = sample_rows_for(
+                self._window_nodes,
+                min(score_pct, loadshed.config.degraded_score_pct),
+                chunk,
+            )
+            self._profile_degraded = degraded_profile(profile)
+        else:
+            self._sample_rows_degraded = self._sample_rows
+            self._profile_degraded = profile
+        self._last_cycle_s = 0.0
+        # Signal baselines for the per-cycle controller tick.  The
+        # counters are process-global: with several live coordinators the
+        # deltas mix their traffic, which only ever over-reports pressure
+        # (the conservative direction for an overload signal).
+        self._sig_conflicts = _PODS_SCHEDULED.value(outcome="conflict")
+        self._sig_resyncs = _RESYNCS.value()
+        # Breaker-open oracle fallback: decoded NodeInfo cache, generation-
+        # keyed on applied node events so node churn invalidates it.
+        self._fallback_cache: tuple[int, list] | None = None
+        self._node_gen = 0
 
         self.host = NodeTableHost(table_spec)
         self.tracker = ConstraintTracker(table_spec)
@@ -412,6 +463,10 @@ class Coordinator:
                 "mesh are different scale-out axes; compose them across "
                 "processes, not inside one coordinator"
             )
+        # The breaker-fallback node cache bakes the mask in: a rebalance
+        # must invalidate it or an open-breaker wave binds onto rows
+        # this shard no longer owns.
+        self._fallback_cache = None
         if mask is None:
             self._row_mask_np = None
             self._row_mask_dev = None
@@ -627,6 +682,7 @@ class Coordinator:
                     name = key[len(NODES_PREFIX):].decode()
                     if name in self.host._row_of:
                         self._dirty_rows.add(self.host.remove(name))
+        self._node_gen += n
         return n
 
     def _drain_pod_events(self, max_events: int = 10000) -> int:
@@ -811,6 +867,7 @@ class Coordinator:
         """Full relist after watch overflow: reconcile host state against
         the store and restart both watches from the list revisions."""
         _RESYNCS.inc()
+        self._node_gen += 1
         with _CYCLE_TIME.time(stage="resync"):
             self._nodes_watch.cancel()
             self._pods_watch.cancel()
@@ -917,12 +974,23 @@ class Coordinator:
                     jnp.asarray(mask_node), jnp.asarray(mask_dom), sign=sign,
                 )
 
-    def submit_external(self, obj: dict) -> None:
+    def submit_external(self, obj: dict, *, admitted: bool = False) -> None:
         """Thread-safe webhook-intake sink (control/webhook.py).
 
         The pod is staged and enters the queue at the next cycle; the
         store watch remains the fallback intake, deduplicated by key.
+
+        With a loadshed controller installed this is an admission point:
+        past the overload watermarks it raises ``loadshed.Overloaded``
+        (lowest ``spec.priority`` shed first, hard ``queue_cap`` bound).
+        ``admitted=True`` is the webhook's already-ran-admission marker
+        (it checks pre-response so it can answer 429) — one pod must
+        never draw, and count, two admission decisions.
         """
+        if not admitted and self.loadshed is not None:
+            self.loadshed.check_admit(
+                pod_priority_of(obj), point="coordinator"
+            )
         with self._external_lock:
             self._external.append(obj)
 
@@ -960,6 +1028,11 @@ class Coordinator:
         """Smallest power-of-two batch bucket holding n pods (clamped to
         pod_spec.batch, which need not be a power of two)."""
         if not self.adaptive_batch:
+            return self.encoder
+        if self.loadshed is not None and self.loadshed.degraded:
+            # Overload: widen the batch window.  Small buckets buy p50
+            # latency at the cost of waves-per-pod — exactly the wrong
+            # trade while the queue is the problem.
             return self.encoder
         b = self.min_batch
         while b < n:
@@ -1018,24 +1091,47 @@ class Coordinator:
                 )
         return batch_pods, batch
 
-    def _next_window(self) -> int:
+    def _next_window(self, rows: int) -> int:
         i = self._window_i
         self._window_i += 1
-        return sample_offset_for(i, self._window_nodes, self._sample_rows)
+        return sample_offset_for(i, self._window_nodes, rows)
+
+    def _active_knobs(self):
+        """(profile, sample_rows) for the next wave: the configured pair
+        when HEALTHY, the degraded pair (filter-only constraint plugins,
+        shrunken score window) while the controller reports pressure."""
+        if self.loadshed is not None and self.loadshed.degraded:
+            self.loadshed.note_degraded_cycle()
+            return self._profile_degraded, self._sample_rows_degraded
+        return self.profile, self._sample_rows
 
     def _launch(self, batch_pods, batch):
         """Enqueue the device step for an encoded batch (async — no
-        device→host transfer is forced)."""
+        device→host transfer is forced).  Faultline hook
+        ``coordinator.cycle``/``dispatch`` fires here: ``slow_cycle`` /
+        ``delay`` lengthen the cycle (feeding the loadshed latency
+        signal); every failure kind — ``stall`` is the canonical one —
+        raises before the device is touched, so the caller's breaker
+        accounting sees a clean dispatch failure with no state to roll
+        back."""
         t_start = time.perf_counter()
+        if faultline.active_injector().plan.faults:
+            d = faultline.decide("coordinator.cycle", "dispatch")
+            if d is not None:
+                if d.kind in ("delay", "slow_cycle"):
+                    time.sleep(d.delay_s)
+                else:
+                    raise faultline.InjectedFault(d)
+        profile, sample_rows = self._active_knobs()
         self.key, subkey = jax.random.split(self.key)
         with _CYCLE_TIME.time(stage="device"):
             self.table, self.constraints, asg, rows_dev = schedule_batch_packed(
                 self.table, batch, subkey,
-                profile=self.profile, constraints=self.constraints,
+                profile=profile, constraints=self.constraints,
                 chunk=self.chunk, k=self.k, backend=self.backend,
-                sample_rows=self._sample_rows,
+                sample_rows=sample_rows,
                 sample_offset=(
-                    self._next_window() if self._sample_rows else 0
+                    self._next_window(sample_rows) if sample_rows else 0
                 ),
                 row_mask=self._row_mask_dev,
                 mesh=self.mesh,
@@ -1050,18 +1146,136 @@ class Coordinator:
             pass
         return (batch_pods, batch, asg, rows_dev, t_start)
 
-    def _dispatch(self):
-        """Intake + device half of a cycle: drain deltas, encode a batch,
-        enqueue the device step.  Returns an in-flight record (or None if
-        nothing is pending) without forcing any device→host transfer."""
-        self._drain_external()
-        self.drain_watches()
-        self._sync_table()
-        self._process_adjusts()
-        batch_pods, batch = self._take_batch()
-        if batch_pods is None:
-            return None
-        return self._launch(batch_pods, batch)
+    def _loadshed_tick(self) -> None:
+        """Feed the health controller one cycle's signals (no-op without
+        a controller).  Runs after the intake drains so queue depth is
+        current, before _take_batch so this wave already schedules with
+        the state the signals imply."""
+        ls = self.loadshed
+        if ls is None:
+            return
+        conflicts = _PODS_SCHEDULED.value(outcome="conflict")
+        resyncs = _RESYNCS.value()
+        ls.tick(Signals(
+            queue_depth=len(self.queue) + len(self._external),
+            backoff_depth=len(self._backoff),
+            conflicts=int(conflicts - self._sig_conflicts),
+            resyncs=int(resyncs - self._sig_resyncs),
+            cycle_s=self._last_cycle_s,
+        ))
+        self._sig_conflicts = conflicts
+        self._sig_resyncs = resyncs
+
+    def _requeue_front(self, batch_pods) -> None:
+        """Put an un-launched batch back at the head of the queue (the
+        pods were popped by _take_batch but never reached a device wave,
+        so no accounting exists to undo)."""
+        for p in reversed(batch_pods):
+            self._queued_keys.add(p.key_str)
+            self.queue.appendleft(p)
+
+    def _take_pods(self, n: int) -> list[PendingPod]:
+        """Pop up to ``n`` pending pods WITHOUT encoding them — the
+        open-breaker fallback path never touches the device, so paying
+        a full-batch encode only to discard it would tax exactly the
+        cycles where the system is already struggling."""
+        self._release_backoff()
+        pods: list[PendingPod] = []
+        while self.queue and len(pods) < n:
+            p = self.queue.popleft()
+            self._queued_keys.discard(p.key_str)
+            pods.append(p)
+        return pods
+
+    def _fallback_nodes(self) -> list:
+        """Decoded ``(row, NodeInfo)`` candidates for the breaker-open
+        oracle fallback, ascending row (ties break earlier-row like the
+        device path's earlier-index rule).  Cached until a node event or
+        resync lands — the O(N) store decode is an emergency-path cost,
+        paid once per node-set generation, not per wave."""
+        if (
+            self._fallback_cache is not None
+            and self._fallback_cache[0] == self._node_gen
+        ):
+            return self._fallback_cache[1]
+        out = []
+        kvs, _ = list_prefix(self.store, NODES_PREFIX)
+        mask = self._row_mask_np
+        for kv in kvs:
+            try:
+                nd = decode_node(kv.value)
+            except Exception:
+                continue
+            row = self.host._row_of.get(nd.name)
+            if row is None:
+                continue
+            if mask is not None and not mask[row]:
+                continue
+            out.append((row, nd))
+        out.sort(key=lambda t: t[0])
+        self._fallback_cache = (self._node_gen, out)
+        return out
+
+    def _fallback_schedule(self, batch_pods) -> int:
+        """Breaker-open path: bind a small batch through the host-side
+        oracle scheduler (k8s1m_tpu/oracle) so scheduling never fully
+        stops while the device is wedged.  Greedy and sequential against
+        the live host usage — for a given snapshot the choices are a
+        pure function of the pod order (argmax oracle_score, earlier row
+        wins ties), which is what makes the drill's byte-identical
+        replay check possible.  Pods past ``fallback_batch`` go back to
+        the queue head; binds mark their row dirty so the device table
+        learns the usage at the next sync (the device never saw these
+        binds commit)."""
+        cap = (
+            self.breaker.config.fallback_batch
+            if self.breaker is not None else len(batch_pods)
+        )
+        take = batch_pods[:cap]
+        self._requeue_front(batch_pods[len(take):])
+        nodes = self._fallback_nodes()
+        host = self.host
+        weights = (
+            self.profile.least_allocated, self.profile.balanced_allocation,
+            self.profile.taint_toleration, self.profile.node_affinity,
+        )
+        nbound = 0
+        with _CYCLE_TIME.time(stage="fallback"):
+            for p in take:
+                pod = p.ensure_pod()
+                best_row, best_score, best_name = -1, -1, None
+                for row, nd in nodes:
+                    req = (
+                        int(host.cpu_req[row]), int(host.mem_req[row]),
+                        int(host.pods_req[row]),
+                    )
+                    if not oracle_feasible(nd, pod, req):
+                        continue
+                    s = oracle_score(
+                        nd, pod, req,
+                        taint_slots=self.table_spec.taint_slots,
+                        weights=weights,
+                    )
+                    if s > best_score:
+                        best_row, best_score, best_name = row, s, nd.name
+                if best_name is None or not self._bind(p, best_name):
+                    self._retry(p)
+                    continue
+                nbound += 1
+                FALLBACK_BINDS.inc()
+                _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
+                # The device table never committed this bind: dirty the
+                # row so the next sync re-uploads the host truth, and
+                # queue the constraint-count correction a device commit
+                # would have applied.
+                self._dirty_rows.add(best_row)
+                if self.constraints is not None:
+                    rec = self._bound.get(p.key_str)
+                    if rec is not None and rec[5] is not None:
+                        self._pending_adjusts.append(
+                            (rec[5], rec[0], rec[3], rec[4], 1)
+                        )
+        return nbound
 
     def _complete(self, inflight) -> int:
         """Bind half: sync the assignment to host, CAS the binds back,
@@ -1197,8 +1411,16 @@ class Coordinator:
                 asg.node_row, asg.zone, asg.region, m, m, sign=-1,
             )
 
+        cycle_s = time.perf_counter() - t_start
+        self._last_cycle_s = cycle_s
+        if self.breaker is not None:
+            # Success is a RETIRED wave — the device returned data — not
+            # an accepted dispatch (async dispatch accepts work a wedged
+            # runtime never finishes).  A half-open probe still resolves
+            # promptly: while the breaker is not CLOSED, step() quiesces
+            # the pipeline, which completes the probe right here.
+            self.breaker.record_success()
         if self.flight is not None:
-            cycle_s = time.perf_counter() - t_start
             self.flight.record(
                 "cycle",
                 cycle_s,
@@ -1240,8 +1462,39 @@ class Coordinator:
         ``run_until_idle``) to retire the tail.
         """
         if not self.pipeline:
-            disp = self._dispatch()
-            return self._complete(disp) if disp is not None else 0
+            self._drain_external()
+            self.drain_watches()
+            self._sync_table()
+            self._process_adjusts()
+            self._loadshed_tick()
+            if (
+                self.breaker is not None
+                and self.breaker.state != BREAKER_CLOSED
+            ):
+                self._release_backoff()
+                if not self.queue:
+                    return 0
+                if not self.breaker.allow():
+                    # Open: bind a small slice through the oracle —
+                    # popped WITHOUT encoding (the wave would only be
+                    # discarded).
+                    return self._fallback_schedule(self._take_pods(
+                        self.breaker.config.fallback_batch
+                    ))
+                # Half-open probe: fall through to a normal device wave.
+            batch_pods, batch = self._take_batch()
+            if batch_pods is None:
+                return 0
+            try:
+                inflight = self._launch(batch_pods, batch)
+            except Exception:
+                if self.breaker is None:
+                    raise
+                log.exception("cycle dispatch failed; breaker accounting")
+                self.breaker.record_failure()
+                self._requeue_front(batch_pods)
+                return 0
+            return self._complete(inflight)
         # Pipelined: up to ``depth`` waves in flight, so each wave's
         # device compute AND its result-fetch round trip overlap the host
         # work of later cycles (through a remote device relay the fetch
@@ -1269,6 +1522,27 @@ class Coordinator:
             self.resync()
         self._drain_external()
         self._drain_pod_events()
+        self._loadshed_tick()
+        if self.breaker is not None and self.breaker.state != BREAKER_CLOSED:
+            # A tripped breaker serializes the pipeline: quiesce so (a)
+            # no in-flight device wave can land placements computed
+            # against pre-fallback usage after the oracle binds
+            # host-side, and (b) the half-open probe resolves at its own
+            # dispatch instead of starving behind the depth gate.
+            done += self.flush()
+            self._drain_node_events()
+            self._sync_table()
+            self._process_adjusts()
+            self._release_backoff()
+            if not self.queue:
+                return done
+            if not self.breaker.allow():
+                done += self._fallback_schedule(self._take_pods(
+                    self.breaker.config.fallback_batch
+                ))
+                return done
+            # Half-open probe: launched below through the normal path
+            # (the pipeline is empty, so it dispatches this step).
         batch_pods, batch = self._take_batch()
         if len(self._inflights) >= (self.depth if batch_pods else 1):
             done += self._complete(self._inflights.pop(0))
@@ -1283,7 +1557,16 @@ class Coordinator:
             self._sync_table()
             self._process_adjusts()
         if batch_pods is not None:
-            self._inflights.append(self._launch(batch_pods, batch))
+            try:
+                inflight = self._launch(batch_pods, batch)
+            except Exception:
+                if self.breaker is None:
+                    raise
+                log.exception("cycle dispatch failed; breaker accounting")
+                self.breaker.record_failure()
+                self._requeue_front(batch_pods)
+                return done
+            self._inflights.append(inflight)
             if self.adaptive_batch and batch.batch < self.pod_spec.batch:
                 # Light load (partial bucket): pipelining buys no
                 # throughput — the queue is draining faster than it
@@ -1347,6 +1630,22 @@ class Coordinator:
             _PODS_SCHEDULED.inc(outcome="conflict")
             return False
         else:
+            # Intake revision still live but no raw bytes captured (the
+            # native fast lane keeps PendingPod compact): splice into
+            # the store's current bytes — same output as the raw-bytes
+            # fast path above, no JSON round trip.
+            value = splice_node_name(cur.value, node_name)
+            if value is not None:
+                ok, _, _ = self.store.cas(
+                    key, value, required_mod=p.mod_revision
+                )
+                if not ok:
+                    _PODS_SCHEDULED.inc(outcome="conflict")
+                    return False
+                self.host.add_pod(node_name, p.cpu_milli, p.mem_kib)
+                self._note_bound(p.ensure_pod(), node_name, external=False)
+                _PODS_SCHEDULED.inc(outcome="bound")
+                return True
             obj = json.loads(cur.value)
             required = p.mod_revision
         obj["spec"]["nodeName"] = node_name

@@ -207,12 +207,14 @@ class HACoordinator:
         self.make_coord = make_coord
         self.coord = None
 
-    def submit_external(self, obj: dict) -> None:
+    def submit_external(self, obj: dict, *, admitted: bool = False) -> None:
         """Reign-stable webhook sink: forwards to the current reign's
-        coordinator; safe to wire into a long-lived WebhookServer."""
+        coordinator; safe to wire into a long-lived WebhookServer.
+        ``admitted`` passes through the webhook's already-ran-admission
+        marker (see Coordinator.submit_external)."""
         coord = self.coord
         if coord is not None:
-            coord.submit_external(obj)
+            coord.submit_external(obj, admitted=admitted)
 
     def tick(self, now: float) -> int:
         """Run one election step and (if leading) one scheduling cycle.

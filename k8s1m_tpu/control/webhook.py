@@ -16,6 +16,23 @@ the current revision at bind time; the store-watch intake remains the
 fallback — a pod whose webhook delivery was lost still arrives via watch
 (intake is deduplicated by pod key).
 
+Two robustness layers on top (see k8s1m_tpu/loadshed):
+
+- **Admission control**: with a ``controller`` (loadshed
+  HealthController) installed, pods our scheduler would claim are
+  admission-checked *before* the response — past the overload
+  watermarks the answer is HTTP 429 with ``Retry-After``, lowest
+  ``spec.priority`` shed first.  This is the same contract
+  kube-apiserver priority-and-fairness gives webhook-fronted intake:
+  clients see explicit backpressure with a retry hint, never a
+  timeout.  "Always allow" still holds for everything the scheduler
+  does NOT claim (foreign schedulerName, already-bound pods) — a shed
+  scheduler must not veto unrelated admissions.
+- **Connection hygiene**: every accepted connection carries a socket
+  timeout (``request_timeout_s``), so a stalled client cannot pin a
+  ThreadingHTTPServer thread forever — an overload vector admission
+  control alone would leave open.
+
 TLS: the reference terminates TLS with terraform-provisioned certs
 (dist-scheduler.tf:713-740); pass ``ssl_context`` to match, or run plain
 HTTP behind a trusted boundary.
@@ -30,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from k8s1m_tpu.config import DEFAULT_SCHEDULER
 from k8s1m_tpu.obs.metrics import Counter
+from k8s1m_tpu.ops.priority import pod_priority_of
 
 log = logging.getLogger("k8s1m.webhook")
 
@@ -55,6 +73,9 @@ class WebhookServer:
     ``sink(pod_obj: dict)`` is called for every admitted pod with our
     schedulerName and no nodeName; it must be thread-safe (the
     coordinator's submit_external only appends to a locked queue).
+    With a ``controller`` the call becomes ``sink(obj, admitted=True)``
+    — admission already ran here, and the marker travels out-of-band so
+    the pod object itself stays canonical.
     """
 
     def __init__(
@@ -65,12 +86,24 @@ class WebhookServer:
         port: int = 0,
         scheduler_name: str = DEFAULT_SCHEDULER,
         ssl_context=None,
+        # Overload admission (k8s1m_tpu/loadshed.HealthController); None
+        # preserves the historical always-allow behavior.
+        controller=None,
+        # Per-connection socket timeout: a stalled client gets dropped
+        # instead of pinning a handler thread indefinitely.
+        request_timeout_s: float = 30.0,
     ):
         self.sink = sink
         self.scheduler_name = scheduler_name
+        self.controller = controller
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # StreamRequestHandler applies this to the connection in
+            # setup(); handle_one_request treats the resulting timeout
+            # as a dropped connection.
+            timeout = request_timeout_s
+
             def log_message(self, fmt, *args):  # route through logging
                 log.debug(fmt, *args)
 
@@ -89,7 +122,36 @@ class WebhookServer:
                     self.send_error(400)
                     _REQUESTS.inc(outcome="bad_request")
                     return
-                # Always allow — admission must never block the write path
+                spec = obj.get("spec", {})
+                claimed = (
+                    obj.get("kind") == "Pod"
+                    # Unset schedulerName = "default-scheduler" (upstream
+                    # semantics): only explicitly-marked pods are claimed,
+                    # matching the reference's intake filter
+                    # (webhook.go:102-125) and decode_pod_obj.
+                    and spec.get("schedulerName") == outer.scheduler_name
+                    and not spec.get("nodeName")
+                )
+                if (
+                    claimed
+                    and outer.controller is not None
+                    and not outer.controller.admit(
+                        pod_priority_of(obj), point="webhook"
+                    )
+                ):
+                    # Overload shed: explicit backpressure with a retry
+                    # hint (the kube-apiserver priority-and-fairness
+                    # answer), never a hang or a silent drop.
+                    _REQUESTS.inc(outcome="shed")
+                    self.send_response(429)
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, round(outer.controller.retry_after_s()))),
+                    )
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                # Allow — admission must never block the write path
                 # (the reference responds before even parsing the pod,
                 # webhook.go:102-125).
                 body = review_response(uid)
@@ -98,30 +160,62 @@ class WebhookServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-                spec = obj.get("spec", {})
-                if (
-                    obj.get("kind") == "Pod"
-                    # Unset schedulerName = "default-scheduler" (upstream
-                    # semantics): only explicitly-marked pods are claimed,
-                    # matching the reference's intake filter
-                    # (webhook.go:102-125) and decode_pod_obj.
-                    and spec.get("schedulerName") == outer.scheduler_name
-                    and not spec.get("nodeName")
-                ):
+                if claimed:
                     _REQUESTS.inc(outcome="enqueued")
                     try:
-                        outer.sink(obj)
+                        if outer.controller is not None:
+                            # This pod already passed admission here —
+                            # the sink must not draw (and count) a
+                            # second decision.  Out-of-band kwarg, never
+                            # a key smuggled into the pod object (a sink
+                            # that persists the object must store the
+                            # canonical bytes).
+                            outer.sink(obj, admitted=True)
+                        else:
+                            outer.sink(obj)
                     except Exception:
                         log.exception("webhook sink failed")
                 else:
                     _REQUESTS.inc(outcome="ignored")
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is None:
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
+        else:
+            # Wrap per-connection with the handshake deferred into the
+            # handler thread (same pattern as obs/http.py): wrapping the
+            # LISTENING socket runs the TLS handshake inside the
+            # serve_forever accept loop, so one client stalling
+            # mid-handshake would block every later admission — the
+            # exact thread-pinning vector request_timeout_s exists to
+            # close.  The pre-wrap settimeout bounds the handshake
+            # itself (Handler.timeout only applies after setup()).
+            class TLSServer(ThreadingHTTPServer):
+                def get_request(self):
+                    sock, addr = super().get_request()
+                    sock.settimeout(min(10.0, request_timeout_s))
+                    return (
+                        ssl_context.wrap_socket(
+                            sock, server_side=True,
+                            do_handshake_on_connect=False,
+                        ),
+                        addr,
+                    )
+
+                def finish_request(self, request, client_address):
+                    request.do_handshake()  # in the per-connection thread
+                    super().finish_request(request, client_address)
+
+                def handle_error(self, request, client_address):
+                    # Failed/stalled handshakes are the client's problem
+                    # (ssl.SSLError is an OSError subclass); anything
+                    # else is OUR bug and must not vanish.
+                    import sys
+
+                    if not isinstance(sys.exc_info()[1], OSError):
+                        super().handle_error(request, client_address)
+
+            self._httpd = TLSServer((host, port), Handler)
         self._httpd.daemon_threads = True
-        if ssl_context is not None:
-            self._httpd.socket = ssl_context.wrap_socket(
-                self._httpd.socket, server_side=True
-            )
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="webhook", daemon=True
         )

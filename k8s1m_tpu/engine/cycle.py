@@ -195,6 +195,13 @@ def chunk_topk(prio, k: int):
     -priority domain satisfies this (asserted by
     test_topk_by_argmax_matches_lax_top_k).  The backend choice is
     trace-time static, so this costs nothing inside jit.
+
+    Coverage caveat (round-5 advisory): the two forms' equivalence —
+    including the earlier-index-wins tie-break — is asserted by the CPU
+    tier-1 suite only, where BOTH forms run on the CPU backend.  The
+    TPU branch's tie semantics (``lax.top_k`` on silicon) are covered
+    exclusively by the on-chip parity suite (tests/test_pallas_topk.py
+    via the recovery-daemon batch), not by any CPU run.
     """
     if jax.default_backend() == "cpu":
         return topk_by_argmax(prio, k)

@@ -10,11 +10,13 @@ replaces both halves with a reusable subsystem:
 
 - **Injection** (`plan.py`): a seeded, deterministic ``FaultPlan`` —
   drop / delay / disconnect / err5xx / partial-write / stale-revision
-  faults keyed by component x operation, fired by probability or
-  schedule — with hooks threaded into the store wire client
-  (store/remote.py), the watch-cache event pump (store/watch_cache.py),
-  the coordinator's bind/CAS and watch-drain paths
-  (control/coordinator.py), and the shardset lease/rebalance loop
+  / stall / slow-cycle faults keyed by component x operation, fired by
+  probability or schedule — with hooks threaded into the store wire
+  client (store/remote.py), the watch-cache event pump
+  (store/watch_cache.py), the coordinator's bind/CAS, watch-drain and
+  cycle-dispatch paths (control/coordinator.py; the overload-shaped
+  ``stall`` / ``slow_cycle`` kinds drive the loadshed breaker and
+  latency signals), and the shardset lease/rebalance loop
   (control/shardset.py).  Enabled via ``ClusterSpec(fault_plan=...)``,
   a ``--fault-plan JSON`` flag on sched_bench / store_stress / soak, or
   the ``K8S1M_FAULT_PLAN`` env var (how subprocess topologies inherit

@@ -20,6 +20,9 @@ component             operations
 ``watch.tier``        ``upstream.recv`` (the cache tier's store-event pump)
 ``coordinator.bind``  ``cas`` (the bind CAS, native wave and slow path)
 ``coordinator.watch`` ``poll`` (the intake watch drain)
+``coordinator.cycle`` ``dispatch`` (the device-wave launch; ``stall``
+                      opens the circuit breaker, ``slow_cycle`` shapes
+                      overload latency)
 ``shardset.lease``    ``heartbeat/<shard>`` ``rebalance``
 ====================  =====================================================
 
@@ -41,6 +44,15 @@ Fault kinds and their contract at the hook sites:
                      (a read raises the compacted signal; a bind CAS is
                      forced into conflict) — the consumer's relist /
                      requeue path must absorb it.
+- ``stall``          the operation hangs past any useful deadline
+                     (raised as a retryable ``InjectedFault``, like a
+                     timed-out RPC).  At the cycle-dispatch hook this
+                     is what trips the circuit breaker
+                     (k8s1m_tpu/loadshed/breaker.py).
+- ``slow_cycle``     overload-shaped latency: the operation completes
+                     but takes ``delay_s`` longer — feeds the health
+                     controller's cycle-p99 signal without failing
+                     anything (k8s1m_tpu/loadshed/controller.py).
 
 The injector is process-global (``install_plan`` / ``active_injector``)
 so subsystems need no plumbing, and seeded per spec so determinism
@@ -64,7 +76,7 @@ log = logging.getLogger("k8s1m.faultline")
 
 FAULT_KINDS = (
     "drop", "delay", "disconnect", "err5xx", "partial_write",
-    "stale_revision",
+    "stale_revision", "stall", "slow_cycle",
 )
 
 _INJECTED = Counter(
@@ -248,10 +260,10 @@ class Injector:
         d = self.decide(component, op)
         if d is None:
             return None
-        if d.kind == "delay":
+        if d.kind in ("delay", "slow_cycle"):
             time.sleep(d.delay_s)
             return d
-        if d.kind in ("disconnect", "err5xx"):
+        if d.kind in ("disconnect", "err5xx", "stall"):
             raise InjectedFault(d)
         return d
 
@@ -261,12 +273,12 @@ class Injector:
         d = self.decide(component, op)
         if d is None:
             return None
-        if d.kind == "delay":
+        if d.kind in ("delay", "slow_cycle"):
             import asyncio
 
             await asyncio.sleep(d.delay_s)
             return d
-        if d.kind in ("disconnect", "err5xx", "drop"):
+        if d.kind in ("disconnect", "err5xx", "drop", "stall"):
             raise InjectedFault(d)
         return d
 

@@ -31,6 +31,8 @@ from k8s1m_tpu.obs.metrics import (
 # Row layout mirrors the reference dashboard's subsystem rows.
 ROWS = [
     ("Scheduler", ("coordinator_", "leader_", "webhook_")),
+    ("Overload control", ("loadshed_", "admission_", "breaker_",
+                          "degraded_")),
     ("Store (mem-etcd)", ("store_", "etcd_", "memstore_")),
     ("Watch cache (apiserver tier)", ("watchcache_",)),
     ("KWOK nodes", ("kwok_",)),
@@ -149,6 +151,7 @@ def main() -> None:
     import k8s1m_tpu.control.coordinator  # noqa: F401
     import k8s1m_tpu.control.leader  # noqa: F401
     import k8s1m_tpu.control.webhook  # noqa: F401
+    import k8s1m_tpu.loadshed  # noqa: F401
     import k8s1m_tpu.store.etcd_server  # noqa: F401
     import k8s1m_tpu.store.watch_cache  # noqa: F401
 

@@ -24,11 +24,17 @@ def start_metrics_server(
     extra=None,
     ssl_context=None,
     basic_auth: tuple[str, str] | None = None,
+    request_timeout_s: float = 30.0,
 ) -> ThreadingHTTPServer:
     """Serve REGISTRY (plus an optional extra text producer) on /metrics.
 
     Runs in a daemon thread; returns the server (``.server_port`` for
     port=0 auto-assignment, ``.shutdown()`` to stop).
+
+    Every connection carries ``request_timeout_s`` as a socket timeout
+    (both the plain and TLS paths): a scraper that connects and stalls
+    must not pin a ThreadingHTTPServer thread forever — threads are the
+    resource an overloaded host runs out of (see k8s1m_tpu/loadshed).
     """
     expected = None
     if basic_auth is not None:
@@ -37,6 +43,10 @@ def start_metrics_server(
         ).decode()
 
     class Handler(BaseHTTPRequestHandler):
+        # Applied to the connection by StreamRequestHandler.setup();
+        # a read timing out drops the connection instead of hanging.
+        timeout = request_timeout_s
+
         def do_GET(self):
             if expected is not None and not hmac.compare_digest(
                 self.headers.get("Authorization", ""), expected
@@ -70,7 +80,9 @@ def start_metrics_server(
         class TLSServer(ThreadingHTTPServer):
             def get_request(self):
                 sock, addr = super().get_request()
-                sock.settimeout(10.0)  # bound a stalled handshake/read
+                # Bound a stalled handshake (the handler's own timeout
+                # only applies after setup(), i.e. post-handshake).
+                sock.settimeout(min(10.0, request_timeout_s))
                 return (
                     ssl_context.wrap_socket(
                         sock, server_side=True,

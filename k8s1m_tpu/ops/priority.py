@@ -91,3 +91,19 @@ def pack_hashed(
 
 def unpack_score(prio: jax.Array) -> jax.Array:
     return jnp.where(prio >= 0, prio >> JITTER_BITS, -1)
+
+
+def pod_priority_of(obj: dict) -> int:
+    """``spec.priority`` of a pod API object dict (0 when unset/garbage).
+
+    The *pod* priority (PriorityClass semantics, not the packed node
+    priority above): the admission-shedding key — under overload the
+    loadshed controller rejects lowest-priority pods first, the same
+    ordering kube-apiserver priority-and-fairness applies to request
+    flows.  Priority never reaches the device; it is consumed entirely
+    at the admission points (control/webhook.py,
+    Coordinator.submit_external)."""
+    try:
+        return int((obj.get("spec") or {}).get("priority") or 0)
+    except (TypeError, ValueError, AttributeError):
+        return 0

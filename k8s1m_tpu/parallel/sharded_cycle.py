@@ -168,6 +168,13 @@ def make_sharded_packed_step(
       percentageOfNodesToScore works the same way per replica —
       dist-scheduler samples 5% of the nodes *it owns*).
 
+    Overload note: ``sample_rows`` and ``profile`` are cache keys, so a
+    coordinator flipping to its degraded mode (k8s1m_tpu/loadshed:
+    smaller window, filter-only constraint plugins) selects a DIFFERENT
+    cached executable here.  Warm both mode pairs before a
+    latency-sensitive window — the first degraded wave otherwise pays a
+    mid-overload compile, the worst possible moment for one.
+
     Returns step(table, ints, bools, key, offset[, constraints])
     -> (table, constraints|None, Assignment, rows i32[B]); table and
     constraint node tables sharded, everything else replicated.

@@ -46,6 +46,16 @@ def default_profile() -> Profile:
     return Profile()
 
 
+def degraded_profile(profile: Profile) -> Profile:
+    """``profile`` with the expensive constraint *scoring* dropped —
+    PodTopologySpread and InterPodAffinity become filter-only (their
+    hard constraints still mask; see topology.filter_and_score).  The
+    overload degraded mode's plugin set (k8s1m_tpu/loadshed): placement
+    quality is traded for cycle time, feasibility semantics never are.
+    """
+    return dataclasses.replace(profile, topology_spread=0, interpod_affinity=0)
+
+
 def score_and_filter(
     table: NodeTable,
     batch: PodBatch,

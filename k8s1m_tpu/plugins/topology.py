@@ -173,7 +173,15 @@ def filter_and_score(
     spread_weight: float,
     ipa_weight: float,
 ):
-    """(mask bool[B, N], score i32[B, N]) over one node chunk."""
+    """(mask bool[B, N], score i32[B, N]) over one node chunk.
+
+    A zero ``spread_weight`` / ``ipa_weight`` skips that plugin's
+    *scoring* arithmetic at trace time — the weights arrive as static
+    Python ints from the Profile — while the hard-constraint filtering
+    (spread maxSkew, required [anti-]affinity, the symmetry mask)
+    always runs: degraded overload modes (k8s1m_tpu/loadshed) trade
+    placement quality, never correctness.
+    """
     n = table.num_rows
 
     # ---- topology spread ----
@@ -182,7 +190,6 @@ def filter_and_score(
         batch.spread_cid, batch.spread_topo, table,
     )                                                                 # [B,S,N]
     min_c = _stat_for(stats.spread_min, batch.spread_cid, batch.spread_topo)
-    max_c = _stat_for(stats.spread_max, batch.spread_cid, batch.spread_topo)
     self_inc = batch.spread_self.astype(jnp.int32)
     skew_ok = (cnt + self_inc[:, :, None] - min_c[:, :, None]) <= (
         batch.spread_max_skew[:, :, None]
@@ -190,15 +197,20 @@ def filter_and_score(
     hard = batch.spread_valid & (batch.spread_mode == SPREAD_DO_NOT_SCHEDULE)
     spread_mask = (~hard[:, :, None] | (domain_ok & skew_ok)).all(axis=1)
 
-    # score: least-crowded domain 100, most-crowded 0, averaged over refs.
-    denom = jnp.maximum(max_c - min_c, 1)[:, :, None]
-    s_ref = 100.0 * (max_c[:, :, None] - cnt) / denom
-    s_ref = jnp.where(domain_ok, jnp.clip(s_ref, 0.0, 100.0), 0.0)
-    live = batch.spread_valid
-    num_refs = jnp.maximum(live.sum(axis=1), 1)
-    spread_score = (
-        (s_ref * live[:, :, None]).sum(axis=1) / num_refs[:, None]
-    )
+    spread_score = None
+    if spread_weight:
+        # score: least-crowded domain 100, most-crowded 0, avg over refs.
+        max_c = _stat_for(
+            stats.spread_max, batch.spread_cid, batch.spread_topo
+        )
+        denom = jnp.maximum(max_c - min_c, 1)[:, :, None]
+        s_ref = 100.0 * (max_c[:, :, None] - cnt) / denom
+        s_ref = jnp.where(domain_ok, jnp.clip(s_ref, 0.0, 100.0), 0.0)
+        live = batch.spread_valid
+        num_refs = jnp.maximum(live.sum(axis=1), 1)
+        spread_score = (
+            (s_ref * live[:, :, None]).sum(axis=1) / num_refs[:, None]
+        )
 
     # ---- inter-pod affinity: the pod's own terms ----
     tcnt, t_domain_ok = _counts_for(
@@ -225,25 +237,29 @@ def filter_and_score(
     sym_ok = (~batch.iinc_valid[:, :, None] | ~o_domain_ok | (ocnt == 0)).all(axis=1)
     ipa_mask = ipa_mask & sym_ok
 
-    # preferred terms: weight x count, rescaled by the batch-static bound.
-    pref = batch.ipa_valid & ~batch.ipa_required
-    sign = jnp.where(batch.ipa_anti, -1, 1) * batch.ipa_weight        # [B,A]
-    raw = (jnp.where(pref[:, :, None] & t_domain_ok, tcnt, 0)
-           * sign[:, :, None]).sum(axis=1)                            # [B,N]
-    bound = (
-        jnp.abs(batch.ipa_weight) * jnp.take(stats.tgt_max, batch.ipa_tid) * pref
-    ).sum(axis=1)                                                     # [B]
-    has_pref = pref.any(axis=1)
-    ipa_score = jnp.where(
-        has_pref[:, None],
-        50.0 + 50.0 * raw / jnp.maximum(bound, 1)[:, None],
-        0.0,
-    )
-    ipa_score = jnp.clip(ipa_score, 0.0, 100.0)
+    ipa_score = None
+    if ipa_weight:
+        # preferred terms: weight x count, rescaled by the static bound.
+        pref = batch.ipa_valid & ~batch.ipa_required
+        sign = jnp.where(batch.ipa_anti, -1, 1) * batch.ipa_weight    # [B,A]
+        raw = (jnp.where(pref[:, :, None] & t_domain_ok, tcnt, 0)
+               * sign[:, :, None]).sum(axis=1)                        # [B,N]
+        bound = (
+            jnp.abs(batch.ipa_weight)
+            * jnp.take(stats.tgt_max, batch.ipa_tid) * pref
+        ).sum(axis=1)                                                 # [B]
+        has_pref = pref.any(axis=1)
+        ipa_score = jnp.where(
+            has_pref[:, None],
+            50.0 + 50.0 * raw / jnp.maximum(bound, 1)[:, None],
+            0.0,
+        )
+        ipa_score = jnp.clip(ipa_score, 0.0, 100.0)
 
     mask = spread_mask & ipa_mask
-    score = (
-        jnp.floor(spread_score).astype(jnp.int32) * int(spread_weight)
-        + jnp.floor(ipa_score).astype(jnp.int32) * int(ipa_weight)
-    )
+    score = jnp.zeros(mask.shape, jnp.int32)
+    if spread_weight:
+        score += jnp.floor(spread_score).astype(jnp.int32) * int(spread_weight)
+    if ipa_weight:
+        score += jnp.floor(ipa_score).astype(jnp.int32) * int(ipa_weight)
     return mask, score

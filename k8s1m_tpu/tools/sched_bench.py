@@ -123,7 +123,36 @@ def parse_args(argv=None):
         "conflict injection across the store wire and the coordinator; "
         "injected-fault and retry counts land in the output detail)",
     )
-    return ap.parse_args(argv)
+    ap.add_argument(
+        "--overload-at", type=float, default=0.0,
+        help="seconds into the paced window to start an overload phase "
+        "(requires --rate; the producer jumps to rate x "
+        "--overload-factor for --overload-seconds, then drops back — "
+        "the shed-and-recover shape of tools/overload_drill.py at "
+        "wall-clock scale)",
+    )
+    ap.add_argument("--overload-seconds", type=float, default=300.0)
+    ap.add_argument("--overload-factor", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    if args.overload_at and not args.rate:
+        ap.error("--overload-at requires --rate (the paced producer)")
+    return args
+
+
+def offered_pods_at(args, t: float) -> float:
+    """Cumulative offered pods at ``t`` seconds into the paced window —
+    the integral of the (piecewise-constant) offered rate, so the
+    overload phase is a rate *step*, not a one-off burst."""
+    if not args.overload_at or args.overload_factor <= 1.0:
+        return args.rate * t
+    t1 = args.overload_at
+    t2 = t1 + args.overload_seconds
+    total = args.rate * min(t, t1)
+    if t > t1:
+        total += args.rate * args.overload_factor * (min(t, t2) - t1)
+    if t > t2:
+        total += args.rate * (t - t2)
+    return total
 
 
 def _resilience_detail() -> dict:
@@ -393,7 +422,8 @@ def main(argv=None):
                 or coord._backoff
             ):
                 due = min(
-                    args.pods, 1 + int(args.rate * (time.perf_counter() - t0))
+                    args.pods,
+                    1 + int(offered_pods_at(args, time.perf_counter() - t0)),
                 )
                 if due > emitted:
                     write_wave(
@@ -441,6 +471,12 @@ def main(argv=None):
                 "rate": args.rate,
                 "mesh": args.mesh,
                 "score_pct": args.score_pct,
+                "overload": (
+                    {"at_s": args.overload_at,
+                     "seconds": args.overload_seconds,
+                     "factor": args.overload_factor}
+                    if args.overload_at else None
+                ),
                 "binds_per_sec": round(e2e, 1),
                 "bound": bound,
                 "unbound": args.pods - 1 - bound,

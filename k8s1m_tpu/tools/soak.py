@@ -51,6 +51,14 @@ counts, retry totals, p50/p99 recovery per fault class).  Note: a
 CONTRACT (the cache cannot re-serve lost events), so the canned default
 plan exercises the client-side classes and leaves tier failure to the
 harsher SIGKILL drill.
+
+**Overload phase** (``--overload-at`` / ``--overload-factor`` /
+``--overload-seconds``): mid-soak the churn bench's offered rate steps
+to ``rate x factor`` for the window, then back — the hour-scale
+shed-and-recover counterpart of the deterministic tier-1
+``tools/overload_drill.py``.  Composes with ``--fault-plan`` and the
+tier SIGKILL, so one soak exercises faults, failover, and overload in
+the same run.
 """
 
 from __future__ import annotations
@@ -151,7 +159,21 @@ def parse_args(argv=None):
                     choices=["none", "buffered", "fsync"],
                     help="store WAL durability for the soak (the "
                     "faultline drill runs fsync)")
+    ap.add_argument("--overload-at", type=float, default=0.0,
+                    help="seconds into the churn window to start a "
+                    "sustained overload phase: the churn bench's "
+                    "offered rate jumps to rate x --overload-factor "
+                    "for --overload-seconds, then drops back — the "
+                    "hour-scale shed-and-recover counterpart of the "
+                    "tier-1 overload_drill (0 = off)")
+    ap.add_argument("--overload-seconds", type=float, default=300.0)
+    ap.add_argument("--overload-factor", type=float, default=5.0)
     args = ap.parse_args(argv)
+    if args.overload_at and (
+        args.overload_at + args.overload_seconds >= args.seconds
+    ):
+        ap.error("the overload phase must end inside the churn window "
+                 "(the recovery half of shed-and-recover needs runway)")
     if args.rate <= 0:
         ap.error("--rate must be > 0 (the soak is a paced-churn shape; "
                  "sched_bench's rate=0 branch reports different fields)")
@@ -360,14 +382,28 @@ async def amain(args) -> dict:
         # subprocess (create -> watch -> schedule -> CAS bind -> delete)
         # at the offered rate for the whole window.
         pods = max(1000, int(args.rate * args.seconds))
+        bench_cmd = [
+            sys.executable, "-m", "k8s1m_tpu.tools.sched_bench",
+            "--nodes", str(args.nodes), "--pods", str(pods),
+            "--rate", str(args.rate), "--score-pct", "5",
+            "--backend", "xla", "--churn",
+            "--target", f"127.0.0.1:{tier_port}",
+            "--ca-pem", certs.ca_pem, "--token", token,
+        ]
+        if args.overload_at:
+            # The overload phase offers extra pods; size --pods so the
+            # producer does not run dry before the window closes.
+            pods += int(
+                args.rate * (args.overload_factor - 1) * args.overload_seconds
+            )
+            bench_cmd[bench_cmd.index("--pods") + 1] = str(pods)
+            bench_cmd += [
+                "--overload-at", str(args.overload_at),
+                "--overload-seconds", str(args.overload_seconds),
+                "--overload-factor", str(args.overload_factor),
+            ]
         bench_proc = subprocess.Popen(
-            [sys.executable, "-m", "k8s1m_tpu.tools.sched_bench",
-             "--nodes", str(args.nodes), "--pods", str(pods),
-             "--rate", str(args.rate), "--score-pct", "5",
-             "--backend", "xla", "--churn",
-             "--target", f"127.0.0.1:{tier_port}",
-             "--ca-pem", certs.ca_pem, "--token", token],
-            env=fault_env, stdout=subprocess.PIPE, text=True,
+            bench_cmd, env=fault_env, stdout=subprocess.PIPE, text=True,
         )
         procs.append(bench_proc)
 
@@ -425,7 +461,13 @@ async def amain(args) -> dict:
             while slept < args.sample_every and bench_proc.poll() is None:
                 await asyncio.sleep(0.5)
                 slept += 0.5
-            if time.monotonic() - t0 > args.seconds + 900:
+            # Overload backlog legitimately drains past the window; give
+            # the bench the extra runway before calling it hung.
+            grace = 900 + (
+                args.overload_factor * args.overload_seconds
+                if args.overload_at else 0
+            )
+            if time.monotonic() - t0 > args.seconds + grace:
                 bench_proc.kill()
                 raise TimeoutError("churn bench overran the window")
         bench_out = bench_proc.stdout.read()
@@ -543,6 +585,12 @@ async def amain(args) -> dict:
                           "give_ups", "recovery")
                 if k in detail
             } or None,
+            "overload": (
+                {"at_s": args.overload_at,
+                 "seconds": args.overload_seconds,
+                 "factor": args.overload_factor}
+                if args.overload_at else None
+            ),
             "churn": {
                 "rate": args.rate,
                 "bound": detail["bound"],

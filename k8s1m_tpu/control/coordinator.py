@@ -46,11 +46,25 @@ lowest-priority pods first; a CircuitBreaker around device dispatch
 falls back to the host-side oracle scheduler while open, so scheduling
 never fully stops (see tools/overload_drill.py for the drill that
 proves all of it).
+
+**Snapshot epochs & quiesce-free pipelining**: node churn no longer
+retires the pipeline.  Node events classify at the row level
+(_drain_node_events): capacity-only updates scatter feature columns
+into the live device table between in-flight waves, structural adds
+append fresh rows, and removes tombstone their row into a wave-epoch
+quarantine (snapshot/node_table.py) so no in-flight wave can alias a
+reused row; a wave that retires onto a tombstoned row retries the pod
+like a CAS conflict.  The pipeline quiesces only for resync, a tripped
+breaker, adaptive partial buckets, or quarantine exhaustion —
+``pipeline_quiesce_total{reason}`` counts each, and under pure
+capacity churn the structural reason stays 0 (tier-1 asserted via
+``sched_bench --node-churn``).
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import heapq
 import json
@@ -76,6 +90,7 @@ from k8s1m_tpu.control.objects import (
     pod_key,
 )
 from k8s1m_tpu.engine.cycle import (
+    Wave,
     adjust_constraints,
     adjust_constraints_impl,
     commit_fields_np,
@@ -87,13 +102,19 @@ from k8s1m_tpu.engine.cycle import (
 from k8s1m_tpu.loadshed import CircuitBreaker, HealthController, Signals
 from k8s1m_tpu.loadshed import CLOSED as BREAKER_CLOSED
 from k8s1m_tpu.loadshed.breaker import FALLBACK_BINDS
-from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram
+from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram, LevelTimer
 from k8s1m_tpu.obs.trace import FlightRecorder
 from k8s1m_tpu.ops.priority import pod_priority_of
 from k8s1m_tpu.oracle import oracle_feasible, oracle_score
 from k8s1m_tpu.plugins.registry import Profile, degraded_profile
 from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
-from k8s1m_tpu.snapshot.node_table import NodeTableHost
+from k8s1m_tpu.snapshot.node_table import (
+    ALL_COLUMNS,
+    CAP_COLUMNS,
+    NodeTableHost,
+    RowsExhausted,
+    scatter_rows,
+)
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
 from k8s1m_tpu.store.native import (
     BIND_INVALID,
@@ -141,6 +162,26 @@ _LIVE: weakref.WeakSet = weakref.WeakSet()
 _NODE_COUNT.set_function(lambda: sum(c.host.num_nodes for c in _LIVE))
 _QUEUE_DEPTH.set_function(lambda: sum(len(c.queue) for c in _LIVE))
 _BACKOFF_DEPTH.set_function(lambda: sum(len(c._backoff) for c in _LIVE))
+
+_PIPE_QUIESCE = Counter(
+    "pipeline_quiesce_total",
+    "Forced full pipeline retires, by reason (capacity-only node churn "
+    "never quiesces; structural = free-row quarantine exhausted)",
+    ("reason",),
+)
+_PIPE_DEPTH = Gauge(
+    "pipeline_inflight_depth", "Device waves currently in flight", ()
+)
+_PIPE_DEPTH.set_function(lambda: sum(len(c._inflights) for c in _LIVE))
+_PIPE_OVERLAP = Counter(
+    "pipeline_stage_overlap_seconds_total",
+    "Host-stage seconds split by whether device waves were in flight "
+    "(inflight=yes means the stage's cost hid behind device work)",
+    ("stage", "inflight"),
+)
+# Stages instrumented with the overlap split (drives the bench's
+# overlap-ratio report; keep in sync with _stage call sites).
+_OVERLAP_STAGES = ("drain", "encode", "sync", "sync_out", "bind")
 
 _BIND_LATENCY = Histogram(
     "coordinator_schedule_to_bind_seconds",
@@ -376,9 +417,9 @@ class Coordinator:
             # Dirty-row scatters must not let the partitioner drift the
             # table off its row sharding (a replicated output here would
             # silently serialize every later wave).
-            self._scatter = jax.jit(
-                _scatter_rows_impl, out_shardings=self._table_sharding
-            )
+            from k8s1m_tpu.parallel.sharded_cycle import make_sharded_scatter
+
+            self._scatter = make_sharded_scatter(self._table_sharding)
             if self.constraints is not None:
                 from k8s1m_tpu.parallel.mesh import constraint_specs
 
@@ -432,7 +473,36 @@ class Coordinator:
         # Bound pods whose node is not in the snapshot yet (bootstrap
         # list/watch interleaving); accounted when the node arrives.
         self._orphan_bound: dict[str, PodInfo] = {}
+        # Two dirty classes (snapshot/node_table.py column split):
+        # _dirty_rows re-uploads the FULL row (host authoritative for
+        # request totals too: CAS rollbacks, external binds, deletes,
+        # tombstones, fresh/reused rows); _dirty_caps re-uploads only the
+        # capacity/feature columns — a node update for a row the table
+        # already holds — leaving the device's in-flight assume chain on
+        # the request columns intact, which is what makes capacity churn
+        # scatter-safe while waves are in flight.
         self._dirty_rows: set[int] = set()
+        self._dirty_caps: set[int] = set()
+        # Rows whose FULL scatter happened while waves were in flight:
+        # the upload erased those waves' device-side assumes, so each
+        # retiring wave re-dirties the rows it bound here (the host
+        # mirror, which just learned the binds, repairs the device).
+        # Cleared when the pipeline fully drains.
+        self._midflight_rows: set[int] = set()
+        # Whether the LAST node drain actually applied anything — the
+        # pending probe for watcher types without a cheap .pending.
+        self._last_node_drain = 0
+        # Time-weighted in-flight depth (obs/metrics.py LevelTimer):
+        # sched_bench reads this for the sustained-depth evidence.
+        self.depth_timer = LevelTimer()
+        # Binds retired by a flush OUTSIDE step()'s own accounting (the
+        # exhaustion quiesce inside _drain_node_events, a defensive
+        # resync flush): credited to the next step() so drivers summing
+        # its return value never lose them.
+        self._deferred_binds = 0
+        # Seconds of nested out-of-band work to subtract from the
+        # enclosing _stage observation (see _stage).
+        self._stage_excluded = 0.0
         self._nodes_watch: Watcher | None = None
         self._pods_watch: Watcher | None = None
         # True when the store's bind_batch can suppress our own watch
@@ -659,12 +729,68 @@ class Coordinator:
         log.warning("injected %s on watch drain; resyncing", d.kind)
         return True
 
+    @contextlib.contextmanager
+    def _stage(self, stage: str):
+        """Stage timer that also feeds the overlap split: host-stage
+        seconds labeled by whether device waves were in flight when the
+        stage ran (inflight=yes time is hidden behind device work).
+        Out-of-band work that runs nested inside a stage (the exhaustion
+        quiesce's flush mid-drain) adds its duration to _stage_excluded
+        so the same seconds are not counted into two stages; the inflight
+        label is latched at entry (a rare-path approximation)."""
+        inflight = "yes" if self._inflights else "no"
+        t0 = time.perf_counter()
+        excl0 = self._stage_excluded
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0 - (self._stage_excluded - excl0)
+            _CYCLE_TIME.observe(dt, stage=stage)
+            _PIPE_OVERLAP.inc(dt, stage=stage, inflight=inflight)
+
+    def _upsert_node(self, node) -> int:
+        """host.upsert with the one structural quiesce left: allocation
+        hitting a full table whose only free rows sit in the wave-epoch
+        quarantine retires the pipeline, releases them, and retries."""
+        try:
+            return self.host.upsert(node)
+        except RowsExhausted as e:
+            if not e.quarantined:
+                raise           # genuinely full; re-bucket TableSpec
+            if self._inflights:
+                _PIPE_QUIESCE.inc(reason="structural")
+                # Retiring releases the quarantine; credit the binds to
+                # the next step()/flush() return.  Plain assignment:
+                # flush() already folds prior deferred credit into its
+                # return (+= would re-add the stale loaded value).  The
+                # flush runs nested inside the drain stage timer, so its
+                # wall time is excluded from the drain observation (the
+                # retired waves' sync_out/bind stages record it).
+                t0 = time.perf_counter()
+                self._deferred_binds = self.flush()
+                self._stage_excluded += time.perf_counter() - t0
+            self.host.release_rows(None)
+            return self.host.upsert(node)
+
     def _drain_node_events(self, max_events: int = 10000) -> int:
-        """Apply node deltas.  MUTATES the row->node mapping (upsert can
-        reuse a freed row) — in the pipelined step this must only run
-        while no wave is in flight (see step())."""
+        """Apply node deltas — pipeline-safe.
+
+        Events classify at the row level: an update to a node the table
+        already holds (capacity, labels, taints, zone — same row, same
+        name) is capacity-only and lands in _dirty_caps, scattered into
+        the live device table while waves are in flight; a new node
+        allocates a fresh row past the high-water mark (or reuses a
+        quarantine-released one) and a remove tombstones its row into
+        the wave-epoch quarantine (node_table.py) — both structural
+        shapes that no longer need the pipeline quiesced.  Only
+        quarantine exhaustion (_upsert_node) still retires it."""
+        if not self._inflights:
+            # Idle pipeline: every launched wave has retired, so all
+            # quarantined rows are past their hazard window.
+            self.host.release_rows(None)
         n = 0
-        with _CYCLE_TIME.time(stage="drain"):
+        row_of = self.host._row_of
+        with self._stage("drain"):
             for etype, key, value, _mrev in drain_events_light(
                 self._nodes_watch, max_events
             ):
@@ -676,13 +802,17 @@ class Coordinator:
                         _DECODE_ERRORS.inc(kind="node")
                         log.exception("undecodable node object; skipping")
                         continue
-                    self._dirty_rows.add(self.host.upsert(node))
-                    self._adopt_orphans(node.name)
+                    if node.name in row_of:
+                        self._dirty_caps.add(self._upsert_node(node))
+                    else:
+                        self._dirty_rows.add(self._upsert_node(node))
+                        self._adopt_orphans(node.name)
                 else:
                     name = key[len(NODES_PREFIX):].decode()
-                    if name in self.host._row_of:
+                    if name in row_of:
                         self._dirty_rows.add(self.host.remove(name))
         self._node_gen += n
+        self._last_node_drain = n
         return n
 
     def _drain_pod_events(self, max_events: int = 10000) -> int:
@@ -702,7 +832,7 @@ class Coordinator:
         if getattr(self._pods_watch, "poll_pods", None) is not None:
             n = 0
             batch = min(max_events, 10000)
-            with _CYCLE_TIME.time(stage="drain"):
+            with self._stage("drain"):
                 while True:
                     evb = self._pods_watch.poll_pods(
                         batch, self._sched_bytes
@@ -713,7 +843,7 @@ class Coordinator:
                     if evb.n < batch or n >= 20 * max_events:
                         return n
         n = 0
-        with _CYCLE_TIME.time(stage="drain"):
+        with self._stage("drain"):
             for etype, key, value, mrev in drain_events_light(
                 self._pods_watch, max_events
             ):
@@ -868,6 +998,18 @@ class Coordinator:
         the store and restart both watches from the list revisions."""
         _RESYNCS.inc()
         self._node_gen += 1
+        if self._inflights:
+            # Call sites quiesce first; this is the defensive backstop
+            # (a driver calling drain_watches mid-flight) — the relist
+            # below rebuilds the row mapping, which no wave may straddle.
+            # Plain assignment — _quiesce's flush() already folds prior
+            # deferred credit into its return (+= would double-count it),
+            # and the inflights guard above means it really flushes.
+            self._deferred_binds = self._quiesce("resync")
+        # The pipeline is idle: the quarantine's hazard window is over,
+        # and the relist may need rows.
+        self.host.release_rows(None)
+        self._midflight_rows.clear()
         with _CYCLE_TIME.time(stage="resync"):
             self._nodes_watch.cancel()
             self._pods_watch.cancel()
@@ -904,43 +1046,63 @@ class Coordinator:
             )
         return len(listed) + len(seen)
 
-    def _sync_table(self) -> None:
-        """Scatter dirty host rows into the device table.
+    @staticmethod
+    def _pad_rows(rows: np.ndarray) -> np.ndarray:
+        """Sorted, power-of-two-padded scatter indices.  Sorted first:
+        np.fromiter over a set is arbitrary-order, which would make the
+        padded scatter input nondeterministic across runs (and hurt
+        gather locality); padding then repeats the last row — scattering
+        identical values to the same index is idempotent.  The pow2
+        bucket keeps jax.jit at a handful of shapes, not one trace per
+        distinct dirty-row count."""
+        rows.sort()
+        cap = 1 << max(0, int(rows.size - 1).bit_length())
+        if cap != rows.size:
+            rows = np.concatenate(
+                [rows, np.repeat(rows[-1:], cap - rows.size)]
+            )
+        return rows
 
-        Row-level apply_delta needs a full NodeTable delta; for host-side
-        simplicity the whole column set for the dirty rows is re-uploaded
-        (tens of bytes per row — cheap at any realistic delta rate).
+    def _sync_table(self) -> None:
+        """Scatter dirty host rows into the device table — safe to run
+        while waves are in flight.
+
+        The scatter consumes the latest table future, so it executes
+        on-stream after every dispatched wave (no host sync, no
+        quiesce).  Capacity-only rows (_dirty_caps) upload the feature
+        columns alone, leaving the device's in-flight request assumes
+        intact; full rows (_dirty_rows) upload everything — host
+        authoritative — and are noted in _midflight_rows so retiring
+        waves can repair the assumes the upload erased (see _complete).
         """
         if self.table is None:
             self.table = self.host.to_device(self._table_sharding)
             self._dirty_rows.clear()
+            self._dirty_caps.clear()
             return
-        if not self._dirty_rows:
+        if not self._dirty_rows and not self._dirty_caps:
             return
-        with _CYCLE_TIME.time(stage="sync"):
-            rows = np.fromiter(self._dirty_rows, np.int32)
-            self._dirty_rows.clear()
-            # Pad to a power-of-two bucket so jax.jit sees a handful of
-            # shapes, not one trace per distinct dirty-row count.  Padding
-            # repeats the last row: scattering identical values to the
-            # same index is idempotent.
-            cap = 1 << max(0, int(rows.size - 1).bit_length())
-            if cap != rows.size:
-                rows = np.concatenate(
-                    [rows, np.repeat(rows[-1:], cap - rows.size)]
+        h = self.host
+        with self._stage("sync"):
+            if self._dirty_rows:
+                # A row needing the full upload supersedes its
+                # capacity-only entry (the full delta includes CAP cols).
+                self._dirty_caps -= self._dirty_rows
+                if self._inflights:
+                    self._midflight_rows.update(self._dirty_rows)
+                rows = self._pad_rows(
+                    np.fromiter(self._dirty_rows, np.int32)
                 )
-            h = self.host
-            delta = {
-                "valid": h.valid[rows], "cpu_alloc": h.cpu_alloc[rows],
-                "mem_alloc": h.mem_alloc[rows], "pods_alloc": h.pods_alloc[rows],
-                "cpu_req": h.cpu_req[rows], "mem_req": h.mem_req[rows],
-                "pods_req": h.pods_req[rows], "label_key": h.label_key[rows],
-                "label_val": h.label_val[rows], "label_num": h.label_num[rows],
-                "taint_id": h.taint_id[rows], "taint_effect": h.taint_effect[rows],
-                "zone": h.zone[rows], "region": h.region[rows],
-                "name_id": h.name_id[rows],
-            }
-            self.table = self._scatter(self.table, rows, delta)
+                self._dirty_rows.clear()
+                delta = {c: getattr(h, c)[rows] for c in ALL_COLUMNS}
+                self.table = self._scatter(self.table, rows, delta)
+            if self._dirty_caps:
+                rows = self._pad_rows(
+                    np.fromiter(self._dirty_caps, np.int32)
+                )
+                self._dirty_caps.clear()
+                delta = {c: getattr(h, c)[rows] for c in CAP_COLUMNS}
+                self.table = self._scatter(self.table, rows, delta)
 
     # ---- the cycle -----------------------------------------------------
 
@@ -1076,7 +1238,7 @@ class Coordinator:
             batch_pods.append(self.queue.popleft())
         for p in batch_pods:
             self._queued_keys.discard(p.key_str)
-        with _CYCLE_TIME.time(stage="encode"):
+        with self._stage("encode"):
             enc = self._encoder_for(len(batch_pods))
             if all(p.pod is None for p in batch_pods):
                 # Native-intake fast lane: a wave of plain pods encodes
@@ -1144,7 +1306,12 @@ class Coordinator:
             rows_dev.copy_to_host_async()
         except Exception:
             pass
-        return (batch_pods, batch, asg, rows_dev, t_start)
+        # begin_wave stamps the snapshot epoch AFTER the dispatch above:
+        # rows removed from here on quarantine until this wave retires.
+        return Wave(
+            batch_pods, batch, asg, rows_dev, t_start,
+            epoch=self.host.begin_wave(),
+        )
 
     def _loadshed_tick(self) -> None:
         """Feed the health controller one cycle's signals (no-op without
@@ -1277,11 +1444,14 @@ class Coordinator:
                         )
         return nbound
 
-    def _complete(self, inflight) -> int:
+    def _complete(self, inflight: Wave) -> int:
         """Bind half: sync the assignment to host, CAS the binds back,
-        roll back conflicts."""
-        batch_pods, batch, asg, rows_dev, t_start = inflight
-        with _CYCLE_TIME.time(stage="sync_out"):
+        roll back conflicts (CAS losses, rows tombstoned mid-flight)."""
+        batch_pods, batch, asg, rows_dev, t_start = (
+            inflight.batch_pods, inflight.batch, inflight.asg,
+            inflight.rows_dev, inflight.t_start,
+        )
+        with self._stage("sync_out"):
             # ONE device_get per wave: through a remote relay each fetch
             # is a full round trip (~tens of ms), so the bind decision
             # comes back as a single packed i32[B] (-1 = unbound).
@@ -1291,7 +1461,7 @@ class Coordinator:
         failed = np.zeros(batch.batch, bool)
         bind_batch = getattr(self.store, "bind_batch", None)
         host = self.host
-        with _CYCLE_TIME.time(stage="bind"):
+        with self._stage("bind"):
             # One native call binds the whole wave: splice + CAS happen
             # inside the store against the bytes it already holds
             # (ms_bind_batch), so the per-pod Python cost collapses to
@@ -1305,6 +1475,20 @@ class Coordinator:
             for i in np.nonzero(rows < 0)[0].tolist():
                 self._retry(batch_pods[i])
             brows = rows[bound_idx]
+            # Rows tombstoned while this wave was in flight: the node is
+            # gone (quarantine guarantees no reuse before this retire, so
+            # an invalid row can't alias a new node) — treat like a CAS
+            # conflict: retry the pod, roll back the wave's optimistic
+            # constraint commit.  No dirty-marking: the tombstone scatter
+            # already uploaded the zeroed row.
+            if bound_idx.size:
+                alive = host.valid[brows]
+                if not alive.all():
+                    for i in bound_idx[~alive].tolist():
+                        failed[i] = True
+                        self._retry(batch_pods[i])
+                    bound_idx = bound_idx[alive]
+                    brows = brows[alive]
             nbytes = self._node_name_bytes()
             ids_l = host.name_id[brows].tolist()
             brows_l = brows.tolist()
@@ -1342,6 +1526,11 @@ class Coordinator:
                 if self._bind(p, name):
                     nbound += 1
                     _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
+                    if brows_l[j] in self._midflight_rows:
+                        # A mid-flight full scatter erased this wave's
+                        # device-side assume on the row; the host mirror
+                        # just learned the bind — re-upload repairs it.
+                        self._dirty_rows.add(brows_l[j])
                     continue
                 # CAS conflict: the device table already assumed this
                 # bind (commit_binds), but the host mirror — which is
@@ -1388,6 +1577,8 @@ class Coordinator:
                     if rev == BIND_INVALID and self._bind(p, name):
                         nbound += 1
                         _BIND_LATENCY.observe(now - p.enqueued_at)
+                        if brows_l[j] in self._midflight_rows:
+                            self._dirty_rows.add(brows_l[j])
                         continue
                     if rev != BIND_INVALID:
                         _PODS_SCHEDULED.inc(outcome="conflict")
@@ -1404,6 +1595,14 @@ class Coordinator:
                     nbound += len(ok_rows)
                     _PODS_SCHEDULED.inc(len(ok_rows), outcome="bound")
                     _BIND_LATENCY.observe_many(lats)
+                    if self._midflight_rows:
+                        # Same repair as the slow path: rows a mid-flight
+                        # full scatter clobbered get the host truth (now
+                        # including this wave's binds) re-uploaded.
+                        self._dirty_rows.update(
+                            rr for rr in ok_rows
+                            if rr in self._midflight_rows
+                        )
         if failed.any() and self.constraints is not None:
             m = jnp.asarray(failed)
             self.constraints = self._adjust(
@@ -1413,6 +1612,16 @@ class Coordinator:
 
         cycle_s = time.perf_counter() - t_start
         self._last_cycle_s = cycle_s
+        # This wave retired: rows removed at or before the oldest
+        # still-in-flight wave's launch are past their aliasing hazard.
+        if self._inflights:
+            self.host.release_rows(self._inflights[0].epoch)
+        else:
+            self.host.release_rows(None)
+            # Every wave a mid-flight scatter could have clobbered has
+            # now retired and repaired; stop tracking those rows.
+            self._midflight_rows.clear()
+        self.depth_timer.set_level(len(self._inflights))
         if self.breaker is not None:
             # Success is a RETIRED wave — the device returned data — not
             # an accepted dispatch (async dispatch accepts work a wedged
@@ -1454,12 +1663,14 @@ class Coordinator:
         With ``pipeline=True`` the returned count is the *previous*
         dispatch's binds: batch N's device work executes while the
         caller does its inter-step work (producers, kwok ticks), hiding
-        the device→host sync latency.  The in-flight batch is completed
-        BEFORE the next dispatch so its bind accounting lands in the
-        host mirror ahead of any dirty-row re-upload — dispatching first
-        would let _sync_table overwrite a device row with host values
-        that lack the in-flight batch's binds.  Call ``flush()`` (or
-        ``run_until_idle``) to retire the tail.
+        the device→host sync latency.  Snapshot churn no longer drains
+        the pipeline: capacity-only node deltas scatter on-stream while
+        waves are in flight, removes tombstone into the wave-epoch
+        quarantine, and the oldest wave is still completed BEFORE this
+        step's sync+dispatch so its bind accounting lands in the host
+        mirror ahead of the dirty-row re-upload the next launch
+        consumes.  Call ``flush()`` (or ``run_until_idle``) to retire
+        the tail.
         """
         if not self.pipeline:
             self._drain_external()
@@ -1498,18 +1709,24 @@ class Coordinator:
         # Pipelined: up to ``depth`` waves in flight, so each wave's
         # device compute AND its result-fetch round trip overlap the host
         # work of later cycles (through a remote device relay the fetch
-        # RTT alone is tens of ms).  Ordering constraints:
-        #  - node events, resync, and dirty-row uploads mutate the
-        #    row->node mapping or overwrite device rows, so they apply
-        #    only at a QUIESCE point — every launched wave retired;
-        #  - pod events touch capacity accounting only and are safe to
-        #    drain while waves are in flight;
+        # RTT alone is tens of ms).  The snapshot mutates WITHOUT
+        # retiring the pipeline (wave cadence decouples from watch
+        # cadence):
+        #  - pod events touch capacity accounting only;
+        #  - capacity-only node deltas scatter feature columns into the
+        #    live table (_dirty_caps), structural adds append past the
+        #    high-water mark, and removes tombstone into the wave-epoch
+        #    quarantine — all on-stream, no host sync (_drain_node_events);
         #  - _complete lands its bind accounting (and CAS-rollback dirty
         #    rows) in the host mirror before _sync_table re-uploads rows
         #    for the next launch.
-        done = 0
+        # Only resync (row mapping rebuilt), a tripped breaker, adaptive
+        # partial buckets, and quarantine exhaustion still retire it —
+        # each counted in pipeline_quiesce_total.
+        done = self._deferred_binds
+        self._deferred_binds = 0
         if self._nodes_watch.dropped or self._pods_watch.dropped:
-            done += self.flush()
+            done += self._quiesce("resync")
             log.warning(
                 "watch overflow (nodes dropped=%d pods dropped=%d); resyncing",
                 self._nodes_watch.dropped, self._pods_watch.dropped,
@@ -1518,10 +1735,11 @@ class Coordinator:
         elif self._watch_fault():
             # Injected watch loss: quiesce the pipeline (resync mutates
             # the row->node mapping) and relist, same as an overflow.
-            done += self.flush()
+            done += self._quiesce("resync")
             self.resync()
         self._drain_external()
         self._drain_pod_events()
+        self._drain_node_events()
         self._loadshed_tick()
         if self.breaker is not None and self.breaker.state != BREAKER_CLOSED:
             # A tripped breaker serializes the pipeline: quiesce so (a)
@@ -1529,8 +1747,7 @@ class Coordinator:
             # against pre-fallback usage after the oracle binds
             # host-side, and (b) the half-open probe resolves at its own
             # dispatch instead of starving behind the depth gate.
-            done += self.flush()
-            self._drain_node_events()
+            done += self._quiesce("breaker")
             self._sync_table()
             self._process_adjusts()
             self._release_backoff()
@@ -1546,16 +1763,11 @@ class Coordinator:
         batch_pods, batch = self._take_batch()
         if len(self._inflights) >= (self.depth if batch_pods else 1):
             done += self._complete(self._inflights.pop(0))
-        if self._inflights and (
-            self._dirty_rows or self._pending_adjusts or self._nodes_pending()
-        ):
-            # Something needs the quiesced table (node delta, CAS
-            # rollback, constraint correction): retire the pipeline now.
-            done += self.flush()
-        if not self._inflights:
-            self._drain_node_events()
-            self._sync_table()
-            self._process_adjusts()
+        # After the retire, before the launch: the retired wave's bind
+        # accounting and rollback rows are in the host mirror, so the
+        # scatter the next launch consumes carries them.
+        self._sync_table()
+        self._process_adjusts()
         if batch_pods is not None:
             try:
                 inflight = self._launch(batch_pods, batch)
@@ -1567,6 +1779,7 @@ class Coordinator:
                 self._requeue_front(batch_pods)
                 return done
             self._inflights.append(inflight)
+            self.depth_timer.set_level(len(self._inflights))
             if self.adaptive_batch and batch.batch < self.pod_spec.batch:
                 # Light load (partial bucket): pipelining buys no
                 # throughput — the queue is draining faster than it
@@ -1576,22 +1789,36 @@ class Coordinator:
                 # 3x the 82ms bucket-256 wave, not the wave itself.
                 # Retire immediately; full buckets keep the deep
                 # pipeline (saturation is where overlap pays).
-                done += self.flush()
+                done += self._quiesce("adaptive")
         return done
 
     def flush(self) -> int:
-        """Retire every in-flight pipelined batch."""
-        done = 0
+        """Retire every in-flight pipelined batch.  Also surfaces any
+        deferred bind credit (exhaustion/resync flushes) so a driver's
+        final flush never under-reports."""
+        done = self._deferred_binds
+        self._deferred_binds = 0
         while self._inflights:
             done += self._complete(self._inflights.pop(0))
         return done
 
+    def _quiesce(self, reason: str) -> int:
+        """Retire the whole pipeline for a structural/control event and
+        count it (no-op, uncounted, when nothing is in flight)."""
+        if not self._inflights:
+            return 0
+        _PIPE_QUIESCE.inc(reason=reason)
+        return self.flush()
+
     def _nodes_pending(self) -> int:
-        """Queued node events (forces a pipeline quiesce so they apply).
-        Watchers without a cheap pending probe report 1 — the pipeline
-        then quiesces every cycle, trading depth for safety."""
+        """Queued node events.  No longer a quiesce trigger (node deltas
+        apply while waves are in flight) — kept as the intake probe for
+        drivers and tests.  Watchers without a cheap pending probe
+        report whether the LAST drain actually applied anything, instead
+        of a permanent 1 (which, when this gated the quiesce, collapsed
+        the pipeline to depth-1 on every cycle)."""
         p = getattr(self._nodes_watch, "pending", None)
-        return 1 if p is None else p
+        return self._last_node_drain if p is None else p
 
     def _bind(self, p: PendingPod, node_name: str) -> bool:
         """CAS spec.nodeName into the pod object; False on conflict."""
@@ -1757,12 +1984,6 @@ class Coordinator:
         return total
 
 
-def _scatter_rows_impl(table, rows, delta: dict):
-    updates = {
-        name: getattr(table, name).at[rows].set(arr)
-        for name, arr in delta.items()
-    }
-    return table.replace(**updates)
-
-
-_scatter_rows = jax.jit(_scatter_rows_impl)
+# Single-device dirty-row scatter (snapshot/node_table.scatter_rows);
+# the mesh path swaps in parallel.sharded_cycle.make_sharded_scatter.
+_scatter_rows = jax.jit(scatter_rows)

@@ -21,11 +21,20 @@ pods can land in a way that exceeds maxSkew by the batch size in the worst
 case.  The reference has exactly the same window (256 shards bind
 optimistically and only capacity conflicts roll back, reference
 README.adoc:558-560); constraint counts are exact again at the next batch
-boundary.
+boundary.  The pipelined coordinator widens the same window across waves:
+capacity-only node deltas (allocatable, labels, taints, zone — same row,
+same name) scatter into the live table while earlier waves are still in
+flight, so a wave may score against capacity a heartbeat just changed.
+That is the identical optimism — every bind is still CAS-verified against
+the store, capacity conflicts still roll back through the dirty-row path,
+and a wave that retires onto a row tombstoned mid-flight retries the pod —
+so correctness is unchanged; only the staleness window is (bounded by
+pipeline depth) wider.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -44,6 +53,26 @@ from k8s1m_tpu.snapshot.constraints import (
 )
 from k8s1m_tpu.snapshot.node_table import NodeTable, commit_binds
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+@dataclasses.dataclass
+class Wave:
+    """One in-flight pipelined dispatch: everything the coordinator needs
+    to retire the wave later (CAS the binds back, roll back conflicts).
+
+    ``epoch`` is the snapshot wave-epoch stamped at launch
+    (NodeTableHost.begin_wave): a node row removed at epoch E stays
+    quarantined until every wave with ``epoch <= E`` has retired, which
+    is what makes structural removes safe to apply while this wave is
+    still in flight — no row the wave may still bind can be reused.
+    """
+
+    batch_pods: list
+    batch: object       # PackedPodBatch as dispatched
+    asg: "Assignment"   # device-resident; fetched only on rollback
+    rows_dev: jax.Array  # i32[B] bound row per pod (-1 = unbound)
+    t_start: float
+    epoch: int
 
 
 @struct.dataclass

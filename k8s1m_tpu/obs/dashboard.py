@@ -31,6 +31,9 @@ from k8s1m_tpu.obs.metrics import (
 # Row layout mirrors the reference dashboard's subsystem rows.
 ROWS = [
     ("Scheduler", ("coordinator_", "leader_", "webhook_")),
+    # Quiesce-free pipelining evidence: quiesce reasons, in-flight depth,
+    # and the host-stage overlap split (pipeline_* in control/coordinator).
+    ("Scheduling cycle", ("pipeline_",)),
     ("Overload control", ("loadshed_", "admission_", "breaker_",
                           "degraded_")),
     ("Store (mem-etcd)", ("store_", "etcd_", "memstore_")),

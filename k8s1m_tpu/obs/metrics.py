@@ -278,6 +278,55 @@ class AlertingHistogram(Histogram):
             log.warning("%s%s took %.1fms", self.name, labels or "", v * 1e3)
 
 
+class LevelTimer:
+    """Time-weighted occupancy of small integer levels.
+
+    Built for the scheduling pipeline's in-flight depth: the coordinator
+    calls ``set_level(len(inflights))`` whenever the pipeline grows or
+    shrinks, and ``seconds()`` reports how long each depth was held —
+    the evidence behind "sustained in-flight depth" in the churn bench
+    (a plain gauge only shows the instant of the scrape).  Not a Metric:
+    it has no labels and renders nowhere; consumers (sched_bench) read
+    it directly.
+    """
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._level = 0
+        # Start accumulating at level 0 immediately — deferring to the
+        # first set_level would silently drop the initial interval.
+        self._since: float = self._clock()
+        self._seconds: dict[int, float] = {}
+
+    def set_level(self, level: int) -> None:
+        now = self._clock()
+        self._seconds[self._level] = (
+            self._seconds.get(self._level, 0.0) + now - self._since
+        )
+        self._level = int(level)
+        self._since = now
+
+    def seconds(self) -> dict[int, float]:
+        """Seconds spent at each level so far (open interval included)."""
+        out = dict(self._seconds)
+        out[self._level] = (
+            out.get(self._level, 0.0) + self._clock() - self._since
+        )
+        return out
+
+    def share(self, level: int) -> float:
+        """Fraction of observed time spent at exactly ``level``."""
+        secs = self.seconds()
+        total = sum(secs.values())
+        return secs.get(int(level), 0.0) / total if total else 0.0
+
+    def reset(self) -> None:
+        """Drop history; the current level keeps accumulating from now
+        (benchmark windows only)."""
+        self._seconds.clear()
+        self._since = self._clock()
+
+
 class Registry:
     def __init__(self):
         self._metrics: dict[str, Metric] = {}

@@ -19,6 +19,15 @@ Dataflow per cycle (replacing reference SURVEY.md §3.2's process hops):
 
 Total ICI traffic per cycle is O(B * K) candidate records — independent
 of node count; the reference moves O(shards) gRPC messages per pod.
+
+Pipelined snapshot mutation: the coordinator's dirty-row scatters
+(make_sharded_scatter) consume the *latest* table future, so they are
+stream-ordered after every dispatched wave by data dependency — a
+capacity delta applied while waves are in flight lands between wave N
+and wave N+1 with no host sync and no quiesce.  The scatter is pinned to
+the table's row sharding (out_shardings) for the same reason the
+coordinator pins its single-device scatter: a replicated output here
+would silently serialize every later wave behind a reshard.
 """
 
 from __future__ import annotations
@@ -39,8 +48,17 @@ from k8s1m_tpu.engine.cycle import (
 from k8s1m_tpu.parallel.mesh import batch_specs, constraint_specs, table_specs
 from k8s1m_tpu.plugins.registry import Profile
 from k8s1m_tpu.snapshot.constraints import ConstraintState
-from k8s1m_tpu.snapshot.node_table import NodeTable
+from k8s1m_tpu.snapshot.node_table import NodeTable, scatter_rows
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+def make_sharded_scatter(table_sharding):
+    """Dirty-row scatter pinned to the table's row sharding — the mesh
+    form of the coordinator's jitted snapshot.node_table.scatter_rows.
+    Safe to enqueue while waves are in flight: it consumes the latest
+    table future, so it executes after every dispatched wave (see the
+    module doc's pipelined-mutation note)."""
+    return jax.jit(scatter_rows, out_shardings=table_sharding)
 
 
 def fold_mesh_key(key):

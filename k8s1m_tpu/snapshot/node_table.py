@@ -16,10 +16,26 @@ Mutation happens two ways, both jit-compatible scatters:
 - ``commit_binds``  — the engine folds its own bind decisions back into
   requested-resources before the next batch, the equivalent of the
   scheduler's assume/bind cache update.
+
+**Wave epochs & the free-row quarantine.**  A pipelined coordinator keeps
+several device waves in flight; a wave launched before a node removal may
+still hold the removed row in its candidate lists.  Freeing the row id
+immediately would let the next node allocation reuse it, and the in-flight
+wave's bind would silently land on the *new* node (row aliasing).  So row
+removal is two-phase: ``remove`` tombstones the row (``valid=0`` in the
+host mirror; the coordinator scatters it to the device the same cycle)
+and parks the row id in a quarantine stamped with the current
+``wave_epoch`` — the count of waves launched so far (``begin_wave``).
+``release_rows(before_epoch)`` returns quarantined rows to the free list
+once every wave launched at or before their removal epoch has retired.
+Fresh-row allocation appends past the high-water mark (or reuses a
+*released* row), so structural adds never need the pipeline quiesced;
+only quarantine exhaustion (``RowsExhausted`` with rows parked) does.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 
 import jax
@@ -33,6 +49,18 @@ from k8s1m_tpu.config import (
     TableSpec,
 )
 from k8s1m_tpu.snapshot.interning import Vocab, numeric_of
+
+class RowsExhausted(ValueError):
+    """No allocatable row: the table is at ``max_nodes`` and the free
+    list is empty.  ``quarantined`` says how many rows are parked in the
+    wave-epoch quarantine — nonzero means a pipeline quiesce (retire all
+    in-flight waves, then ``release_rows(None)``) recovers capacity;
+    zero means the table is genuinely full (re-bucket TableSpec)."""
+
+    def __init__(self, msg: str, quarantined: int = 0):
+        super().__init__(msg)
+        self.quarantined = quarantined
+
 
 UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"
 ZONE_LABEL = "topology.kubernetes.io/zone"
@@ -155,6 +183,18 @@ class NodeTableHost:
         self._row_of: dict[str, int] = {}
         self._free_rows: list[int] = []
         self._next_row = 0
+        # Pipelined-scheduler wave clock: bumped by begin_wave() at every
+        # device dispatch.  0 = no consumer pipelines waves, and removes
+        # free their row immediately (standalone/tool users of this
+        # class never quarantine).
+        self.wave_epoch = 0
+        # Removed rows awaiting release: (removal wave_epoch, row),
+        # epoch-ordered by construction (the clock is monotone).  A row
+        # here is tombstoned (valid=0, columns zeroed) but NOT reusable —
+        # a wave launched before the removal may still bind into it.
+        self._quarantine: collections.deque[tuple[int, int]] = (
+            collections.deque()
+        )
         # Bumped on every row->name mapping change (new node, removal,
         # row reuse) — consumers holding derived per-row state (the shard
         # set's ownership mask) refresh when this moves.
@@ -184,8 +224,14 @@ class NodeTableHost:
         else:
             row = self._next_row
             if row >= self.spec.max_nodes:
-                raise ValueError(
-                    f"node table full ({self.spec.max_nodes}); re-bucket TableSpec"
+                raise RowsExhausted(
+                    f"node table full ({self.spec.max_nodes}); re-bucket "
+                    "TableSpec" + (
+                        f" ({len(self._quarantine)} rows quarantined; a "
+                        "pipeline quiesce releases them)"
+                        if self._quarantine else ""
+                    ),
+                    quarantined=len(self._quarantine),
                 )
             self._next_row += 1
         self._row_of[name] = row
@@ -281,11 +327,45 @@ class NodeTableHost:
             self.taint_id, self.taint_effect,
         ):
             arr[row] = 0
-        self._free_rows.append(row)
+        if self.wave_epoch:
+            # Two-phase free: the row is tombstoned now (the caller
+            # scatters valid=0 immediately) but its id stays quarantined
+            # until every wave launched at or before this epoch retires
+            # (see release_rows) — the row-aliasing guard that lets a
+            # pipelined coordinator apply removes without a quiesce.
+            self._quarantine.append((self.wave_epoch, row))
+        else:
+            self._free_rows.append(row)
         self.epoch += 1
         if self._row_journal is not None:
             self._row_journal.append((name, row, False))
         return row
+
+    # ---- wave epochs ----------------------------------------------------
+
+    def begin_wave(self) -> int:
+        """Stamp one device-wave launch; returns the wave's epoch."""
+        self.wave_epoch += 1
+        return self.wave_epoch
+
+    def release_rows(self, before_epoch: int | None = None) -> int:
+        """Return quarantined rows to the free list.
+
+        ``before_epoch`` is the oldest still-in-flight wave's epoch: a
+        row removed at epoch E is only referenced by waves launched at
+        epoch <= E, so it is safe once ``E < before_epoch``.  ``None``
+        (no wave in flight) releases everything.  Returns the count.
+        """
+        n = 0
+        q = self._quarantine
+        while q and (before_epoch is None or q[0][0] < before_epoch):
+            self._free_rows.append(q.popleft()[1])
+            n += 1
+        return n
+
+    @property
+    def quarantined(self) -> int:
+        return len(self._quarantine)
 
     def add_pod(self, name: str, cpu_milli: int, mem_kib: int) -> None:
         """Account an already-bound pod (host mirror of commit_binds)."""
@@ -363,3 +443,28 @@ def apply_delta(table: NodeTable, rows: jax.Array, delta: NodeTable) -> NodeTabl
     revision-ordered informer stream.
     """
     return jax.tree.map(lambda t, d: t.at[rows].set(d), table, delta)
+
+
+# Column split for the coordinator's dirty-row scatters: capacity/feature
+# columns carry what the node object says (host always authoritative);
+# the request columns carry bind accounting, which in a pipelined
+# coordinator includes in-flight assumes the host mirror does not know
+# yet.  A capacity-only node update therefore scatters CAP_COLUMNS alone,
+# leaving the device's running request totals (the assume chain) intact.
+CAP_COLUMNS = (
+    "valid", "cpu_alloc", "mem_alloc", "pods_alloc",
+    "label_key", "label_val", "label_num",
+    "taint_id", "taint_effect", "zone", "region", "name_id",
+)
+REQ_COLUMNS = ("cpu_req", "mem_req", "pods_req")
+ALL_COLUMNS = CAP_COLUMNS + REQ_COLUMNS
+
+
+def scatter_rows(table: NodeTable, rows, delta: dict) -> NodeTable:
+    """Scatter per-column host values into ``rows`` of the device table
+    (the keys of ``delta`` select the columns — see CAP_COLUMNS)."""
+    updates = {
+        name: getattr(table, name).at[rows].set(arr)
+        for name, arr in delta.items()
+    }
+    return table.replace(**updates)

@@ -91,6 +91,19 @@ def parse_args(argv=None):
         "instead of a fill-up",
     )
     ap.add_argument(
+        "--node-churn", type=float, default=0.0, metavar="RATE",
+        help="steady capacity-only node-update traffic (updates/s) "
+        "during the measured window — KWOK heartbeats / capacity "
+        "updates at wall-clock rate.  The quiesce-free pipeline must "
+        "hold its depth through this (pipeline_quiesce_total "
+        "{reason=structural} stays 0; quiesce and sustained-depth "
+        "evidence lands in the report detail)",
+    )
+    ap.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="also write the report JSON to PATH (tier-1 smoke artifact)",
+    )
+    ap.add_argument(
         "--stress-watchers", type=int, default=0,
         help="run the apiserver-stress equivalent (tools/watch_stress) "
         "as a subprocess against the same --target for the whole "
@@ -169,6 +182,115 @@ def _resilience_detail() -> dict:
         "give_ups": faultline.give_up_counts(),
         "recovery": faultline.recovery_stats(),
     }
+
+
+class _NodeChurn:
+    """Paced capacity-only node updates (same name, same labels, wiggled
+    allocatable) — the steady heartbeat/capacity traffic the 1M full-
+    churn config never stops emitting.  Capacity-only by construction:
+    every update targets a node the table already holds, so the
+    pipelined coordinator scatters it mid-flight without a quiesce."""
+
+    def __init__(self, store, nodes: int, rate: float):
+        self._store = store
+        self._nodes = nodes
+        self._rate = rate
+        self.emitted = 0
+
+    def advance(self, elapsed_s: float) -> None:
+        due = int(self._rate * elapsed_s)
+        # Bound one burst so a long device wave can't turn catch-up into
+        # a giant synchronous write (which would itself stall the cycle).
+        due = min(due, self.emitted + 4096)
+        if due <= self.emitted:
+            return
+        items = []
+        for j in range(self.emitted, due):
+            i = j % self._nodes
+            items.append((
+                node_key(f"kwok-node-{i}"),
+                encode_node(build_node(
+                    i, cpu_milli=32000 + (j // self._nodes) % 16
+                )),
+            ))
+        write_wave(self._store, items)
+        self.emitted = due
+
+
+_QUIESCE_REASONS = ("structural", "resync", "breaker", "adaptive")
+
+
+def _quiesce_counts() -> dict:
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    q = REGISTRY.get("pipeline_quiesce_total")
+    return {r: q.value(reason=r) for r in _QUIESCE_REASONS}
+
+
+def _overlap_totals() -> tuple[float, float]:
+    """(hidden, exposed) host-stage seconds so far."""
+    from k8s1m_tpu.control.coordinator import _OVERLAP_STAGES
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    ov = REGISTRY.get("pipeline_stage_overlap_seconds_total")
+    return (
+        sum(ov.value(stage=s, inflight="yes") for s in _OVERLAP_STAGES),
+        sum(ov.value(stage=s, inflight="no") for s in _OVERLAP_STAGES),
+    )
+
+
+def _pipeline_detail(
+    coord, quiesce_base, overlap_base, depth_samples, churn
+) -> dict:
+    """Quiesce / in-flight-depth / overlap evidence for the report."""
+    import numpy as np
+
+    hid, exposed = _overlap_totals()
+    hid -= overlap_base[0]
+    exposed -= overlap_base[1]
+    samples = np.asarray(depth_samples or [0])
+    return {
+        "node_churn_rate": churn._rate if churn else 0.0,
+        "node_churn_events": churn.emitted if churn else 0,
+        "pipeline_quiesce": {
+            r: int(_quiesce_counts()[r] - quiesce_base[r])
+            for r in _QUIESCE_REASONS
+        },
+        # Depth sampled after every step while the producer was live:
+        # the pipeline holds --depth iff the median sits there.
+        "sustained_inflight_depth": int(np.median(samples)),
+        "max_inflight_depth": int(samples.max()),
+        "depth_seconds": {
+            str(k): round(v, 4) for k, v in coord.depth_timer.seconds().items()
+        },
+        # Share of instrumented host-stage time that ran while device
+        # waves were in flight (i.e. cost hidden behind device work).
+        "stage_overlap_ratio": round(
+            hid / (hid + exposed), 4
+        ) if hid + exposed else None,
+    }
+
+
+def _pipeline_window_start(coord, store, args):
+    """Baselines + trackers captured immediately before a measured
+    window (must run AFTER warmup — warm waves count adaptive quiesces).
+    Returns (quiesce_base, overlap_base, depth_samples, node_churn)."""
+    coord.depth_timer.reset()
+    return (
+        _quiesce_counts(),
+        _overlap_totals(),
+        [],
+        _NodeChurn(store, args.nodes, args.node_churn)
+        if args.node_churn else None,
+    )
+
+
+def _emit_report(report: dict, out_path: str | None) -> dict:
+    print(json.dumps(report), flush=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report
 
 
 def write_wave(store, items) -> None:
@@ -411,6 +533,9 @@ def main(argv=None):
         # (config 5's sustained create+delete shape at a rate); the lag
         # is capped at a quarter of the run so short runs still delete.
         lag = min(3 * coord.pod_spec.batch, max(args.pods // 4, 64))
+        quiesce_base, overlap_base, depth_samples, node_churn = (
+            _pipeline_window_start(coord, store, args)
+        )
         t0 = time.perf_counter()
         bound = 0
         emitted = 1
@@ -430,6 +555,8 @@ def main(argv=None):
                         store, list(zip(keys[emitted:due], values[emitted:due]))
                     )
                     emitted = due
+                if node_churn is not None:
+                    node_churn.advance(time.perf_counter() - t0)
                 if args.churn:
                     # Advance on EVERY cycle, not only on emission: when
                     # binds lag the producer (CPU), most land after
@@ -442,6 +569,10 @@ def main(argv=None):
                         write_wave(store, [(keys[i], None) for i in dels])
                         deleted += len(dels)
                 bound += coord.step()
+                if emitted < args.pods:
+                    # Depth evidence only while the producer is live —
+                    # the tail drain legitimately winds the pipeline down.
+                    depth_samples.append(len(coord._inflights))
                 if (
                     emitted >= args.pods
                     and not coord.queue
@@ -462,7 +593,7 @@ def main(argv=None):
         e2e = bound / sched_s if sched_s else 0.0
         if args.stats:
             _print_stage_stats(sched_s)
-        print(json.dumps({
+        return _emit_report({
             "metric": f"e2e_p50_bind_ms_{args.nodes}_nodes_at_{args.rate}",
             "value": round(lat.quantile(0.5) * 1e3, 2),
             "unit": "ms",
@@ -485,15 +616,21 @@ def main(argv=None):
                 "p50_ms": round(lat.quantile(0.5) * 1e3, 2),
                 "p95_ms": round(lat.quantile(0.95) * 1e3, 2),
                 "p99_ms": round(lat.quantile(0.99) * 1e3, 2),
+                **_pipeline_detail(
+                    coord, quiesce_base, overlap_base, depth_samples,
+                    node_churn,
+                ),
                 **_resilience_detail(),
             },
-        }), flush=True)
-        return
+        }, args.out)
 
     wave = args.batch
     if args.stats:
         REGISTRY.get("coordinator_cycle_seconds").reset()
     tune_gc()
+    quiesce_base, overlap_base, depth_samples, node_churn = (
+        _pipeline_window_start(coord, store, args)
+    )
     t0 = time.perf_counter()
     bound = 0
     off = 1
@@ -504,6 +641,8 @@ def main(argv=None):
             write_wave(
                 store, list(zip(keys[off:off + wave], values[off:off + wave]))
             )
+            if node_churn is not None:
+                node_churn.advance(time.perf_counter() - t0)
             if args.churn:
                 # Delete BOUND pods behind the emission lag — the
                 # scheduler keeps binding into capacity that deletions
@@ -514,6 +653,8 @@ def main(argv=None):
                 deleted += len(dels)
             off += wave
             bound += coord.step()
+            if off < args.pods:
+                depth_samples.append(len(coord._inflights))
         if args.churn:
             # Drain with the frontier still advancing (same lag): on CPU
             # most binds land here, after the producer finished, and the
@@ -548,7 +689,7 @@ def main(argv=None):
         _print_stage_stats(sched_s)
 
     suffix = f"_pct{args.score_pct}" if args.score_pct != 100 else ""
-    print(json.dumps({
+    return _emit_report({
         "metric": f"e2e_binds_per_sec_{args.nodes}_nodes{suffix}",
         "value": round(e2e, 1),
         "unit": "binds/s",
@@ -565,9 +706,12 @@ def main(argv=None):
             "schedule_s": round(sched_s, 2),
             "stress_watchers": args.stress_watchers,
             "p50_bind_ms": p50_ms,
+            **_pipeline_detail(
+                coord, quiesce_base, overlap_base, depth_samples, node_churn,
+            ),
             **_resilience_detail(),
         },
-    }), flush=True)
+    }, args.out)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,394 @@
+"""Quiesce-free pipelined scheduling under node churn (the pipedream PR).
+
+Three layers of evidence:
+
+1. **Differential**: capacity-only node churn applied WHILE waves are in
+   flight produces byte-identical binds and an equal final host mirror
+   vs the quiesce-every-cycle path (pipeline off — each wave retires
+   before the next dispatch), same seed, same fault plan.
+2. **Quarantine**: a row removed mid-flight is tombstoned, not reused —
+   remove + immediate re-add of the same name lands on a fresh row and
+   the in-flight wave's bind retries instead of aliasing; quarantine
+   exhaustion is the one structural event that still quiesces.
+3. **Satellites**: _nodes_pending no longer reports a permanent 1 for
+   watchers without a pending probe; _sync_table scatters dirty rows in
+   sorted order; the sched_bench --node-churn smoke holds full depth
+   with zero structural quiesces (the tier-1 acceptance gate).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from k8s1m_tpu import faultline
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
+from k8s1m_tpu.obs.metrics import REGISTRY, LevelTimer
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot.node_table import (
+    NodeInfo,
+    NodeTableHost,
+    RowsExhausted,
+)
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+from k8s1m_tpu.store.native import MemStore, prefix_end
+
+PROFILE = Profile(topology_spread=0, interpod_affinity=0)
+SPEC = TableSpec(max_nodes=128, max_zones=16, max_regions=8)
+PODS = PodSpec(batch=32)
+
+
+def put_node(store, name, zone="z0", cpu=4000, mem=8 << 20, pods=64, **kw):
+    labels = {"topology.kubernetes.io/zone": zone, **kw.pop("labels", {})}
+    store.put(
+        node_key(name),
+        encode_node(NodeInfo(name=name, cpu_milli=cpu, mem_kib=mem,
+                             pods=pods, labels=labels, **kw)),
+    )
+
+
+def put_pod(store, name, ns="default", cpu=20, mem=200 << 10, **kw):
+    store.put(
+        pod_key(ns, name),
+        encode_pod(PodInfo(name=name, namespace=ns, cpu_milli=cpu,
+                           mem_kib=mem, **kw)),
+    )
+
+
+def make_coord(store, **kw):
+    kw.setdefault("with_constraints", False)
+    return Coordinator(store, SPEC, PODS, PROFILE, chunk=64, k=4, **kw)
+
+
+def node_of(store, ns, name):
+    kv = store.get(pod_key(ns, name))
+    return json.loads(kv.value)["spec"].get("nodeName")
+
+
+def structural_quiesces() -> float:
+    return REGISTRY.get("pipeline_quiesce_total").value(reason="structural")
+
+
+# ---- 1. differential: churn during pipeline == quiesce-every-cycle ----
+
+
+def _drive_churned(pipeline: bool, depth: int = 3):
+    """One deterministic schedule of pod arrivals + capacity-only node
+    churn (same names, wiggled allocatable) + one structural fresh-row
+    add; returns (all pod bytes, host-mirror snapshot, max depth seen).
+
+    Same seed, same fault plan for both modes; pipeline=False IS the
+    quiesce-every-cycle path (every wave retires before the next
+    dispatch, exactly what the old depth-1 degeneration produced).
+    """
+    faultline.install_plan(faultline.FaultPlan(seed=11))
+    try:
+        with MemStore() as store:
+            for i in range(8):
+                put_node(store, f"n{i}", zone=f"z{i % 2}")
+            c = make_coord(
+                store, pipeline=pipeline, depth=depth, seed=5,
+                max_attempts=8,
+            )
+            c.bootstrap()
+            max_depth = 0
+            for wave in range(6):
+                for i in range(24):
+                    put_pod(store, f"w{wave}-{i}")
+                # Heartbeat-shaped churn: capacity updates for rows the
+                # table already holds, applied while waves are in flight.
+                for j in range(3):
+                    put_node(store, f"n{(wave + j) % 8}",
+                             zone=f"z{(wave + j) % 2}",
+                             cpu=4000 + 100 * wave)
+                if wave == 3:
+                    put_node(store, "fresh")   # structural: fresh row
+                c.step()
+                max_depth = max(max_depth, len(c._inflights))
+            c.run_until_idle()
+            res = store.range(b"/registry/pods/", prefix_end(b"/registry/pods/"))
+            pods = {bytes(kv.key): bytes(kv.value) for kv in res.kvs}
+            host = {
+                "row_of": dict(c.host._row_of),
+                "valid": c.host.valid.copy(),
+                "cpu_alloc": c.host.cpu_alloc.copy(),
+                "cpu_req": c.host.cpu_req.copy(),
+                "mem_req": c.host.mem_req.copy(),
+                "pods_req": c.host.pods_req.copy(),
+            }
+            table_req = np.asarray(c.table.pods_req).copy()
+            c.close()
+            return pods, host, table_req, max_depth
+    finally:
+        faultline.install_plan(faultline.FaultPlan())
+
+
+def test_churn_during_pipeline_matches_quiesce_always():
+    base = structural_quiesces()
+    pods_p, host_p, treq_p, depth_p = _drive_churned(pipeline=True)
+    assert structural_quiesces() == base     # capacity churn never quiesces
+    assert depth_p >= 2                      # ...and the pipeline stayed deep
+    pods_f, host_f, treq_f, _ = _drive_churned(pipeline=False)
+    # Byte-identical binds: every stored pod object, spliced nodeName
+    # included, matches the quiesce-every-cycle run exactly.
+    assert pods_p == pods_f
+    # Equal final host mirror, row-for-row.
+    assert host_p["row_of"] == host_f["row_of"]
+    for col in ("valid", "cpu_alloc", "cpu_req", "mem_req", "pods_req"):
+        np.testing.assert_array_equal(host_p[col], host_f[col])
+    # And the device table converged to the same request totals.
+    np.testing.assert_array_equal(treq_p, treq_f)
+    assert host_p["pods_req"].sum() == 6 * 24
+
+
+# ---- 2. quarantine: removes mid-flight cannot alias rows --------------
+
+
+def test_remove_readd_same_name_no_row_aliasing():
+    """Remove a node and immediately re-add the same name while a wave
+    is in flight: the new node must get a FRESH row (the old one stays
+    quarantined + tombstoned until the wave retires), and the in-flight
+    bind onto the old row must retry onto the new one."""
+    with MemStore() as store:
+        put_node(store, "a", labels={"disk": "ssd"})
+        c = make_coord(store, pipeline=True, depth=2, max_attempts=8)
+        c.bootstrap()
+        put_pod(store, "p0", node_selector={"disk": "ssd"})
+        c.step()
+        assert len(c._inflights) == 1
+        old_row = c.host.row_of("a")
+        store.delete(node_key("a"))
+        put_node(store, "a", labels={"disk": "ssd"})
+        assert c._drain_node_events() == 2
+        new_row = c.host.row_of("a")
+        assert new_row != old_row
+        assert c.host.quarantined == 1
+        assert not c.host.valid[old_row]     # tombstoned immediately
+        total = c.run_until_idle()
+        assert total == 1
+        assert node_of(store, "default", "p0") == "a"
+        assert c.host.pods_req[new_row] == 1
+        assert c.host.pods_req[old_row] == 0  # never aliased
+        assert c.host.quarantined == 0        # released once idle
+        c.close()
+
+
+def test_removed_row_not_reused_for_different_node():
+    """The aliasing bug shape: remove node a, add node b while a wave
+    holding a's row is in flight.  b must not inherit a's row — the
+    wave's bind would land the pod on b under a's placement decision."""
+    with MemStore() as store:
+        put_node(store, "a", labels={"disk": "ssd"})
+        c = make_coord(store, pipeline=True, depth=2, max_attempts=2)
+        c.bootstrap()
+        put_pod(store, "p0", node_selector={"disk": "ssd"})
+        c.step()
+        assert len(c._inflights) == 1
+        old_row = c.host.row_of("a")
+        store.delete(node_key("a"))
+        put_node(store, "b", labels={"disk": "hdd"})
+        c._drain_node_events()
+        assert c.host.row_of("b") != old_row
+        c.run_until_idle()
+        # p0 required ssd; with a gone nothing feasible remains — it
+        # must park unschedulable, never land on b.
+        assert node_of(store, "default", "p0") is None
+        assert "default/p0" in c.unschedulable
+        assert c.host.pods_req[c.host.row_of("b")] == 0
+        c.close()
+
+
+def test_quarantine_exhaustion_is_the_structural_quiesce():
+    """A fresh-row alloc that can only be satisfied by quarantined rows
+    retires the pipeline (reason=structural), releases them, and
+    proceeds — the one structural event left that quiesces."""
+    tiny = TableSpec(max_nodes=4, max_zones=16, max_regions=8)
+    with MemStore() as store:
+        for i in range(4):
+            put_node(store, f"n{i}")
+        c = Coordinator(store, tiny, PodSpec(batch=8), PROFILE, chunk=4,
+                        k=2, with_constraints=False, pipeline=True, depth=2)
+        c.bootstrap()
+        put_pod(store, "p0")
+        c.step()
+        assert len(c._inflights) == 1
+        old_row = c.host.row_of("n3")
+        store.delete(node_key("n3"))
+        put_node(store, "m0")     # table full; only the quarantined row fits
+        base = structural_quiesces()
+        c._drain_node_events()
+        assert structural_quiesces() == base + 1
+        assert not c._inflights               # pipeline was retired
+        assert c.host.row_of("m0") == old_row  # released row reused
+        # The bind retired by the exhaustion flush is deferred-credited,
+        # so the driver-visible total still accounts for every pod.
+        assert c.run_until_idle() == 1
+        assert node_of(store, "default", "p0") is not None
+        c.close()
+
+
+def test_host_quarantine_epoch_release_order():
+    h = NodeTableHost(TableSpec(max_nodes=4, max_zones=16, max_regions=8))
+    for n in ("a", "b", "c"):
+        h.upsert(NodeInfo(n))
+    e1 = h.begin_wave()
+    row_a = h.row_of("a")
+    h.remove("a")                 # removal epoch e1
+    e2 = h.begin_wave()
+    row_b = h.row_of("b")
+    h.remove("b")                 # removal epoch e2
+    assert h.quarantined == 2
+    # Oldest in-flight wave is e1: nothing is releasable yet.
+    assert h.release_rows(e1) == 0
+    # e1 retired; oldest in flight is now e2 -> only a's row frees.
+    assert h.release_rows(e2) == 1 and h._free_rows[-1] == row_a
+    assert h.release_rows(None) == 1 and h._free_rows[-1] == row_b
+    # Standalone users (wave_epoch never begun) free immediately.
+    h2 = NodeTableHost(TableSpec(max_nodes=4, max_zones=16, max_regions=8))
+    h2.upsert(NodeInfo("x"))
+    h2.remove("x")
+    assert h2.quarantined == 0 and len(h2._free_rows) == 1
+    # Exhaustion reports the quarantine so callers know a quiesce helps.
+    for n in ("p", "q", "r"):     # fills rows alongside the surviving c
+        h.upsert(NodeInfo(n))
+    h.begin_wave()
+    h.remove("p")
+    with pytest.raises(RowsExhausted) as ei:
+        h.upsert(NodeInfo("t"))
+    assert ei.value.quarantined == 1
+
+
+# ---- 3. satellites ----------------------------------------------------
+
+
+class _NoPendingWatch:
+    """Third-party-shaped watcher: poll_light only — no pending probe,
+    no poll_pods, no native queue."""
+
+    dropped = 0
+    canceled = False
+
+    def __init__(self):
+        self.events = []
+
+    def poll_light(self, batch):
+        evs, self.events = self.events[:batch], self.events[batch:]
+        return evs
+
+    def cancel(self):
+        pass
+
+
+def test_nodes_pending_not_permanently_one():
+    """Satellite: a watcher without .pending must not report a permanent
+    1 (which used to quiesce the pipeline every cycle) — it reports
+    whether the last drain actually applied anything."""
+    with MemStore() as store:
+        put_node(store, "n0")
+        c = make_coord(store, pipeline=True, depth=2)
+        c.bootstrap()
+        c._nodes_watch.cancel()
+        w = _NoPendingWatch()
+        c._nodes_watch = w
+        assert c._drain_node_events() == 0
+        assert c._nodes_pending() == 0        # was: permanent 1
+        w.events.append((0, node_key("n1"), encode_node(NodeInfo("n1")), 1))
+        assert c._drain_node_events() == 1
+        assert c._nodes_pending() == 1        # stream recently active
+        assert c._drain_node_events() == 0
+        assert c._nodes_pending() == 0
+        c.close()
+
+
+def test_sync_table_scatters_sorted_rows():
+    """Satellite: dirty rows scatter in sorted order (np.fromiter over a
+    set is arbitrary-order — nondeterministic padded input otherwise)."""
+    with MemStore() as store:
+        for i in range(6):
+            put_node(store, f"n{i}")
+        c = make_coord(store)
+        c.bootstrap()
+        seen = []
+        orig = c._scatter
+
+        def spy(table, rows, delta):
+            seen.append(np.asarray(rows).copy())
+            return orig(table, rows, delta)
+
+        c._scatter = spy
+        c._dirty_rows.update({5, 0, 3})
+        c._sync_table()
+        assert len(seen) == 1
+        rows = seen[0]
+        assert rows[:3].tolist() == [0, 3, 5]   # sorted before padding
+        assert rows.tolist()[3:] == [5]          # pow2 pad repeats last
+        c.close()
+
+
+def test_capacity_delta_scatters_mid_flight_feature_cols_only():
+    """A capacity-only node update lands on the device while a wave is
+    in flight — through the CAP-columns scatter, so the device's
+    in-flight request assumes are untouched."""
+    with MemStore() as store:
+        put_node(store, "n0", cpu=4000)
+        c = make_coord(store, pipeline=True, depth=2)
+        c.bootstrap()
+        put_pod(store, "p0")
+        c.step()
+        assert len(c._inflights) == 1
+        put_node(store, "n0", cpu=5000)          # heartbeat capacity bump
+        c._drain_node_events()
+        row = c.host.row_of("n0")
+        assert row in c._dirty_caps and row not in c._dirty_rows
+        c._sync_table()                           # mid-flight, no quiesce
+        assert len(c._inflights) == 1
+        assert int(np.asarray(c.table.cpu_alloc)[row]) == 5000
+        c.run_until_idle()
+        assert node_of(store, "default", "p0") == "n0"
+        # Device and host agree on requests after the pipeline drains.
+        assert int(np.asarray(c.table.cpu_req)[row]) == c.host.cpu_req[row]
+        c.close()
+
+
+# ---- 4. the bench smoke (committed-evidence gate) ---------------------
+
+
+def test_sched_bench_node_churn_smoke(tmp_path):
+    """Tier-1 acceptance gate: under sustained capacity-only node churn,
+    zero structural quiesces and sustained in-flight depth == --depth
+    (the wave cadence fully decoupled from the watch cadence).  The
+    committed artifacts/churn_pipeline.json is one run of this shape."""
+    from k8s1m_tpu.tools.sched_bench import main
+
+    out = tmp_path / "churn_pipeline.json"
+    report = main([
+        "--nodes", "256", "--pods", "2048", "--batch", "128",
+        "--backend", "xla", "--depth", "3", "--node-churn", "4000",
+        "--out", str(out),
+    ])
+    d = report["detail"]
+    assert d["node_churn_events"] > 0
+    assert d["pipeline_quiesce"]["structural"] == 0
+    assert d["pipeline_quiesce"]["resync"] == 0
+    assert d["sustained_inflight_depth"] == 3
+    assert d["max_inflight_depth"] == 3
+    assert d["bound"] == 2047                 # every offered pod bound
+    assert json.loads(out.read_text())["detail"]["bound"] == 2047
+
+
+def test_level_timer_occupancy():
+    t = [0.0]
+    lt = LevelTimer(clock=lambda: t[0])
+    lt.set_level(0)
+    t[0] = 1.0
+    lt.set_level(2)
+    t[0] = 4.0
+    lt.set_level(1)
+    t[0] = 5.0
+    secs = lt.seconds()
+    assert secs[0] == pytest.approx(1.0)
+    assert secs[2] == pytest.approx(3.0)
+    assert secs[1] == pytest.approx(1.0)
+    assert lt.share(2) == pytest.approx(0.6)

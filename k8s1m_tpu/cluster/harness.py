@@ -159,7 +159,8 @@ def wait_for_port(
             with socket.create_connection(("127.0.0.1", port), timeout=1.0):
                 return
         except OSError:
-            time.sleep(0.05)
+            # Deadline-bounded port-readiness poll, not an op retry.
+            time.sleep(0.05)  # graftlint: disable=retry-through-policy
     raise TimeoutError(f"store server did not listen on :{port}")
 
 
@@ -562,14 +563,16 @@ class Cluster:
         for m in self.shard_members:
             try:
                 m.close()
-            except Exception:
+            # Teardown ladder: one member's close must not strand the rest.
+            except Exception:  # graftlint: disable=broad-except
                 pass
         for k in self.kwoks:
             k.close()
         for c in self._clients:
             try:
                 c.close()
-            except Exception:
+            # Teardown ladder: one client's close must not strand the rest.
+            except Exception:  # graftlint: disable=broad-except
                 pass
         for tier in self._tiers:
             tier.terminate()

@@ -82,6 +82,7 @@ import numpy as np
 from k8s1m_tpu import faultline
 from k8s1m_tpu.config import DEFAULT_SCHEDULER, PodSpec, TableSpec
 from k8s1m_tpu.faultline import RetryPolicy, note_give_up, note_retry, policy_for
+from k8s1m_tpu.lint import THREAD_OWNER, guarded_by, racy_read
 from k8s1m_tpu.control.objects import (
     decode_node,
     decode_pod,
@@ -158,10 +159,19 @@ _RESYNCS = Counter(
 _NODE_COUNT = Gauge("coordinator_node_count", "Nodes in the snapshot", ())
 # All live coordinators in this process; gauges aggregate over them so a
 # discarded instance neither pins memory nor clobbers the live one's stats.
+# Scrape-thread reads of cycle-thread-owned state go through racy_read:
+# a deliberate, audited-as-exempt torn-snapshot read (a monitoring len()
+# must neither block on the cycle nor count as a discipline violation).
 _LIVE: weakref.WeakSet = weakref.WeakSet()
-_NODE_COUNT.set_function(lambda: sum(c.host.num_nodes for c in _LIVE))
-_QUEUE_DEPTH.set_function(lambda: sum(len(c.queue) for c in _LIVE))
-_BACKOFF_DEPTH.set_function(lambda: sum(len(c._backoff) for c in _LIVE))
+_NODE_COUNT.set_function(
+    lambda: sum(len(racy_read(c.host, "_row_of")) for c in _LIVE)
+)
+_QUEUE_DEPTH.set_function(
+    lambda: sum(len(racy_read(c, "queue")) for c in _LIVE)
+)
+_BACKOFF_DEPTH.set_function(
+    lambda: sum(len(racy_read(c, "_backoff")) for c in _LIVE)
+)
 
 _PIPE_QUIESCE = Counter(
     "pipeline_quiesce_total",
@@ -172,7 +182,9 @@ _PIPE_QUIESCE = Counter(
 _PIPE_DEPTH = Gauge(
     "pipeline_inflight_depth", "Device waves currently in flight", ()
 )
-_PIPE_DEPTH.set_function(lambda: sum(len(c._inflights) for c in _LIVE))
+_PIPE_DEPTH.set_function(
+    lambda: sum(len(racy_read(c, "_inflights")) for c in _LIVE)
+)
 _PIPE_OVERLAP = Counter(
     "pipeline_stage_overlap_seconds_total",
     "Host-stage seconds split by whether device waves were in flight "
@@ -252,6 +264,22 @@ def splice_node_name(raw: bytes, node_name: str) -> bytes | None:
     )
 
 
+@guarded_by(
+    # Webhook-thread <-> cycle-thread boundary: the staging list is the
+    # ONLY coordinator state server threads may touch, and only under
+    # its lock (lint/guards.py; audited by tests/test_guard_stress.py).
+    _external="_external_lock",
+    # Cycle-thread-confined state: the wave pipeline, the backoff heap,
+    # the pod queue and the dirty-row sets all belong to whichever
+    # thread drives step()/flush() — never to a server thread.
+    _inflights=THREAD_OWNER,
+    _backoff=THREAD_OWNER,
+    queue=THREAD_OWNER,
+    _queued_keys=THREAD_OWNER,
+    _dirty_rows=THREAD_OWNER,
+    _dirty_caps=THREAD_OWNER,
+    _midflight_rows=THREAD_OWNER,
+)
 class Coordinator:
     """Single-process scheduling coordinator over an in-process store."""
 
@@ -1156,10 +1184,17 @@ class Coordinator:
         with self._external_lock:
             self._external.append(obj)
 
-    def _drain_external(self) -> None:
-        if not self._external:
-            return
+    def _external_pending(self) -> int:
+        """Staged webhook pods (locked read — the unlocked peek this
+        replaced was a benign race on CPython, but the guard audit is
+        only meaningful if the annotated discipline has no exceptions)."""
         with self._external_lock:
+            return len(self._external)
+
+    def _drain_external(self) -> None:
+        with self._external_lock:
+            if not self._external:
+                return
             staged, self._external = self._external, []
         for obj in staged:
             try:
@@ -1304,7 +1339,9 @@ class Coordinator:
         # relay round trip.
         try:
             rows_dev.copy_to_host_async()
-        except Exception:
+        # Best-effort prefetch: some array types/backends simply lack the
+        # async copy; the sync device_get in _complete is the fallback.
+        except Exception:  # graftlint: disable=broad-except
             pass
         # begin_wave stamps the snapshot epoch AFTER the dispatch above:
         # rows removed from here on quarantine until this wave retires.
@@ -1324,7 +1361,7 @@ class Coordinator:
         conflicts = _PODS_SCHEDULED.value(outcome="conflict")
         resyncs = _RESYNCS.value()
         ls.tick(Signals(
-            queue_depth=len(self.queue) + len(self._external),
+            queue_depth=len(self.queue) + self._external_pending(),
             backoff_depth=len(self._backoff),
             conflicts=int(conflicts - self._sig_conflicts),
             resyncs=int(resyncs - self._sig_resyncs),
@@ -1372,6 +1409,11 @@ class Coordinator:
             try:
                 nd = decode_node(kv.value)
             except Exception:
+                # Same quarantine contract as the watch drains: one
+                # malformed object must not silently shrink the
+                # emergency fallback's candidate set.
+                _DECODE_ERRORS.inc(kind="node")
+                log.exception("undecodable node in fallback list; skipping")
                 continue
             row = self.host._row_of.get(nd.name)
             if row is None:
@@ -1651,6 +1693,7 @@ class Coordinator:
                 self.profiler.dump(
                     os.path.join(
                         self.flight.dump_dir,
+                        # graftlint: disable=no-wall-clock (epoch-ms dump name, correlates with flight dumps)
                         f"profile-slowcycle-{int(time.time() * 1e3)}"
                         f"-{self._profile_dumps}.json",
                     )
@@ -1976,7 +2019,11 @@ class Coordinator:
                     idle = 0
                     continue
                 idle += 1
-                if idle > 1 and self.drain_watches() == 0 and not self._external:
+                if (
+                    idle > 1
+                    and self.drain_watches() == 0
+                    and not self._external_pending()
+                ):
                     break
             else:
                 idle = 0

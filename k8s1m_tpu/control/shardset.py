@@ -56,6 +56,7 @@ import numpy as np
 from k8s1m_tpu import faultline
 from k8s1m_tpu.control.coordinator import Coordinator
 from k8s1m_tpu.faultline import GiveUp, policy_for
+from k8s1m_tpu.lint import THREAD_OWNER, guarded_by
 from k8s1m_tpu.obs.metrics import Counter, Gauge
 from k8s1m_tpu.store.native import drain_events_light, prefix_end
 
@@ -216,6 +217,16 @@ def rebalance_groups(
     return groups
 
 
+@guarded_by(
+    # Mask state is tick-thread-confined: the ownership set, the
+    # deferred-claim set (drop-before-claim correctness depends on their
+    # relative order) and the row->group journal fold all belong to the
+    # thread driving tick() — audited, not assumed (lint/guards.py).
+    _claimed=THREAD_OWNER,
+    _pending_claim=THREAD_OWNER,
+    _row_group=THREAD_OWNER,
+    assignment=THREAD_OWNER,
+)
 class ShardMember:
     """One shard: a Coordinator plus intake filter, ownership mask
     upkeep, and a status heartbeat.
@@ -313,7 +324,9 @@ class ShardMember:
             log.info("assignment watch lost; resyncing", exc_info=True)
             try:
                 self._assign_watch.cancel()
-            except Exception:
+            # Canceling an already-broken watch may itself fail; the
+            # rewatch below is the recovery either way.
+            except Exception:  # graftlint: disable=broad-except
                 pass
             cur = load_assignment(self.store)
             if cur is not None:
@@ -484,6 +497,11 @@ class Rebalancer:
                 if now - float(obj["renewTime"]) <= self.dead_after:
                     alive.add(int(obj["shard"]))
             except Exception:
+                # A malformed heartbeat reads as a dead shard (its groups
+                # get evacuated) — keep the parse failure diagnosable
+                # without letting one bad record kill the round.
+                log.debug("undecodable shard status %r", kv.key,
+                          exc_info=True)
                 continue
         return {s for s in alive if 0 <= s < self.num_shards}
 

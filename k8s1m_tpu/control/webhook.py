@@ -119,6 +119,9 @@ class WebhookServer:
                     uid = req.get("uid", "")
                     obj = req.get("object") or {}
                 except Exception:
+                    # 400 is the contract for malformed reviews, but the
+                    # parse failure itself must stay diagnosable.
+                    log.debug("malformed AdmissionReview", exc_info=True)
                     self.send_error(400)
                     _REQUESTS.inc(outcome="bad_request")
                     return

@@ -111,7 +111,8 @@ def default_retryable(e: Exception) -> bool:
         return True
     try:
         import grpc
-    except Exception:  # pragma: no cover - grpc is always present in-tree
+    # Import guard: without grpc there is nothing gRPC-retryable.
+    except Exception:  # graftlint: disable=broad-except  # pragma: no cover - grpc is always present in-tree
         return False
     if isinstance(e, grpc.RpcError):
         code = e.code() if callable(getattr(e, "code", None)) else None

@@ -1,0 +1,33 @@
+"""graftlint — project-native static analysis + runtime lock discipline.
+
+Static half (``python -m k8s1m_tpu.lint``): AST passes enforcing the
+invariants no generic linter knows — no host sync in the TPU cycle
+path, no wall clock where determinism-by-seed is the contract, all
+retries through the one faultline RetryPolicy, a checked metric
+namespace, no silent ``except Exception``, no trace-time branching on
+traced values.  See cli.py for the driver, base.py for the pragma and
+baseline escape hatches.
+
+Runtime half (``lint/guards.py``): ``@guarded_by`` annotations on
+shared mutable state, audited under a test-only instrumentation mode
+that raises on any access without the named lock held (or off the
+owning thread) — the race detector for the webhook-thread vs
+cycle-thread interleavings the overload and pipelining work hardened
+by hand.
+
+This module deliberately imports only the guards API: production code
+imports ``guarded_by`` from here, and must not pay for (or depend on)
+the ast machinery.
+"""
+
+from k8s1m_tpu.lint.guards import (  # noqa: F401
+    THREAD_OWNER,
+    GuardViolation,
+    audit,
+    audit_enabled,
+    disown,
+    guarded_by,
+    racy_read,
+    set_owner,
+    violations,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from k8s1m_tpu.lint.cli import main
+
+sys.exit(main())

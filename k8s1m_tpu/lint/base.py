@@ -1,0 +1,193 @@
+"""graftlint core: files, pragmas, findings, and the rule protocol.
+
+The framework is deliberately small — ``ast`` stdlib only, no
+configuration language.  A rule is a class with an ``id`` and either a
+``check_file(path, tree, lines)`` hook (runs per file) or a
+``check_tree(files)`` hook (runs once over the parsed tree, for
+cross-file invariants like the metric registry).  Findings are
+``path:line rule-id message`` tuples; two escape hatches exist:
+
+- a ``# graftlint: disable=<rule>[,<rule>]`` pragma on the offending
+  line (or on a standalone comment line directly above it), for sites
+  where the violation is deliberate and locally justified;
+- the committed baseline file (see baseline.py), for grandfathered
+  findings that predate the rule and are tracked until fixed.
+
+Pragmas should carry a short reason in the same comment, e.g.::
+
+    span = {"t": time.time()}  # graftlint: disable=no-wall-clock (wall stamp for cross-process correlation)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import tokenize
+
+# Directories never linted: generated protobufs, C sources, committed
+# artifacts, the lint fixture tree (each fixture deliberately violates
+# exactly one rule), and VCS/tool internals.
+SKIP_DIRS = {
+    ".git", "__pycache__", "artifacts", "lint_fixtures", "native",
+    "related", "proto",
+}
+SKIP_FILE_SUFFIXES = ("_pb2.py",)
+
+_PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str          # repo-root-relative, '/'-separated
+    line: int          # 1-based
+    rule: str          # rule id, e.g. "broad-except"
+    message: str
+    # The stripped source text of the offending line: the baseline's
+    # drift-stable fingerprint (line numbers move; the text rarely does).
+    source: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: str                    # repo-root-relative
+    abspath: str
+    tree: ast.AST
+    lines: list[str]             # raw source lines (index 0 = line 1)
+    pragmas: dict[int, set[str]]  # line -> disabled rule ids
+
+
+class Rule:
+    """Base rule.  Subclasses set ``id`` and override one hook."""
+
+    id = ""
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        return []
+
+    def check_tree(self, files: list[SourceFile]) -> list[Finding]:
+        return []
+
+    # -- helpers ---------------------------------------------------------
+
+    def finding(self, f: SourceFile, node_or_line, message: str) -> Finding:
+        line = (
+            node_or_line
+            if isinstance(node_or_line, int)
+            else getattr(node_or_line, "lineno", 0)
+        )
+        src = f.lines[line - 1].strip() if 0 < line <= len(f.lines) else ""
+        return Finding(f.path, line, self.id, message, src)
+
+
+def _collect_pragmas(source: str) -> dict[int, set[str]]:
+    """Map line -> rule ids disabled on that line.
+
+    A pragma comment that shares its line with code applies to that
+    line; a standalone pragma comment applies to the next line holding
+    code (so multi-line statements can be annotated above).
+    """
+    pragmas: dict[int, set[str]] = {}
+    import io
+
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return pragmas
+    lines = source.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        lineno = tok.start[0]
+        stripped = lines[lineno - 1].strip() if lineno <= len(lines) else ""
+        if stripped.startswith("#"):
+            # Standalone comment: applies to the next code line.
+            tgt = lineno + 1
+            while tgt <= len(lines) and (
+                not lines[tgt - 1].strip()
+                or lines[tgt - 1].strip().startswith("#")
+            ):
+                tgt += 1
+            pragmas.setdefault(tgt, set()).update(rules)
+        else:
+            pragmas.setdefault(lineno, set()).update(rules)
+    return pragmas
+
+
+def load_file(root: str, relpath: str) -> SourceFile | None:
+    abspath = os.path.join(root, relpath)
+    try:
+        with open(abspath, encoding="utf-8") as fh:
+            source = fh.read()
+        tree = ast.parse(source, filename=relpath)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    return SourceFile(
+        path=relpath.replace(os.sep, "/"),
+        abspath=abspath,
+        tree=tree,
+        lines=source.splitlines(),
+        pragmas=_collect_pragmas(source),
+    )
+
+
+def iter_py_files(root: str, subdirs: tuple[str, ...] = ()) -> list[str]:
+    """Repo-relative paths of lintable .py files under ``root`` (or only
+    under ``root/<subdir>`` for each given subdir)."""
+    out: list[str] = []
+    starts = [os.path.join(root, s) for s in subdirs] if subdirs else [root]
+    for start in starts:
+        for dirpath, dirnames, filenames in os.walk(start):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in SKIP_DIRS and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                if fn.endswith(SKIP_FILE_SUFFIXES):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                out.append(rel)
+    return out
+
+
+def suppressed(f: SourceFile, finding: Finding) -> bool:
+    return finding.rule in f.pragmas.get(finding.line, ())
+
+
+# -- small AST helpers shared by rules ----------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def walk_no_nested_functions(node: ast.AST):
+    """Yield nodes in ``node``'s body without descending into nested
+    function/class definitions (their bodies run in another scope/time)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,62 @@
+"""The committed baseline: grandfathered findings, tracked until fixed.
+
+Format — one entry per line, ``#`` comments encouraged (one per entry,
+saying WHY it is grandfathered rather than fixed)::
+
+    # soak cleanup: wait-then-kill is the documented teardown ladder
+    k8s1m_tpu/tools/soak.py|broad-except|except Exception:
+
+Fields are ``path|rule-id|source-fingerprint`` where the fingerprint is
+the stripped text of the offending line — stable across the line-number
+drift that makes path:line baselines rot.  Identical (path, rule,
+fingerprint) triples are counted: two hits need two entries.
+
+Matching is exact in both directions: a finding with no entry is NEW
+(lint fails); an entry with no finding is STALE (``--check-baseline``
+fails, so a fixed site must also be removed from the file — no silent
+drift either way).
+"""
+
+from __future__ import annotations
+
+import collections
+
+from k8s1m_tpu.lint.base import Finding
+
+BASELINE_NAME = "lint_baseline.txt"
+
+
+def parse_baseline(text: str) -> list[tuple[str, str, str]]:
+    entries: list[tuple[str, str, str]] = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split("|", 2)
+        if len(parts) != 3:
+            raise ValueError(
+                f"baseline line {lineno}: want 'path|rule|fingerprint', "
+                f"got {raw!r}"
+            )
+        entries.append((parts[0], parts[1], parts[2]))
+    return entries
+
+
+def format_entry(finding: Finding) -> str:
+    return f"{finding.path}|{finding.rule}|{finding.source}"
+
+
+def split_findings(
+    findings: list[Finding], entries: list[tuple[str, str, str]]
+) -> tuple[list[Finding], list[tuple[str, str, str]]]:
+    """(new findings, stale entries) after counted matching."""
+    budget = collections.Counter(entries)
+    new: list[Finding] = []
+    for fd in findings:
+        key = (fd.path, fd.rule, fd.source)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+        else:
+            new.append(fd)
+    stale = [k for k, n in budget.items() for _ in range(n)]
+    return new, stale

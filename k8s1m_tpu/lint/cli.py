@@ -1,0 +1,163 @@
+"""graftlint driver: run the passes, apply pragmas + baseline, report.
+
+    python -m k8s1m_tpu.lint                 # lint the repo, honor baseline
+    python -m k8s1m_tpu.lint --check-baseline  # also fail on stale entries
+    python -m k8s1m_tpu.lint path/to/file.py   # lint specific files
+    python -m k8s1m_tpu.lint --write-baseline  # regenerate (keeps comments out)
+
+Exit codes: 0 clean (every finding baselined/pragma'd), 1 new findings
+(or stale baseline entries under ``--check-baseline``), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+from k8s1m_tpu.lint import baseline as baseline_mod
+from k8s1m_tpu.lint.base import (
+    Finding,
+    Rule,
+    SourceFile,
+    iter_py_files,
+    load_file,
+    suppressed,
+)
+from k8s1m_tpu.lint.rules_clock import NoWallClock
+from k8s1m_tpu.lint.rules_except import BroadExcept
+from k8s1m_tpu.lint.rules_jax import HotPathHostSync, TraceTimeBranch
+from k8s1m_tpu.lint.rules_metrics import MetricsRegistry
+from k8s1m_tpu.lint.rules_retry import RetryThroughPolicy
+
+ALL_RULES: tuple[type[Rule], ...] = (
+    HotPathHostSync,
+    NoWallClock,
+    RetryThroughPolicy,
+    MetricsRegistry,
+    BroadExcept,
+    TraceTimeBranch,
+)
+
+# The linted slice of the repo (everything else is docs/artifacts).
+DEFAULT_SUBDIRS = ("k8s1m_tpu", "tests")
+
+
+def repo_root() -> str:
+    """The directory holding the k8s1m_tpu package (= repo root)."""
+    import k8s1m_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        k8s1m_tpu.__file__
+    )))
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]                    # after pragma suppression
+    new: list[Finding]                         # not covered by baseline
+    stale: list[tuple[str, str, str]]          # baseline entries unmatched
+    files: int
+
+
+def run_lint(
+    root: str | None = None,
+    paths: list[str] | None = None,
+    baseline_path: str | None = None,
+    rules: tuple[type[Rule], ...] = ALL_RULES,
+) -> LintResult:
+    """Run every pass; returns findings split against the baseline.
+
+    ``baseline_path=None`` means "use <root>/lint_baseline.txt if it
+    exists"; pass ``baseline_path=""`` to ignore any baseline.
+    """
+    root = root or repo_root()
+    rels = paths if paths else iter_py_files(root, DEFAULT_SUBDIRS)
+    files: list[SourceFile] = []
+    for rel in rels:
+        f = load_file(root, rel)
+        if f is not None:
+            files.append(f)
+
+    instances = [cls() for cls in rules]
+    findings: list[Finding] = []
+    by_path = {f.path: f for f in files}
+    for rule in instances:
+        for f in files:
+            for fd in rule.check_file(f):
+                if not suppressed(f, fd):
+                    findings.append(fd)
+        for fd in rule.check_tree(files):
+            src = by_path.get(fd.path)
+            if src is None or not suppressed(src, fd):
+                findings.append(fd)
+    findings.sort(key=lambda fd: (fd.path, fd.line, fd.rule))
+
+    entries: list[tuple[str, str, str]] = []
+    if baseline_path != "":
+        bp = baseline_path or os.path.join(
+            root, baseline_mod.BASELINE_NAME
+        )
+        if os.path.exists(bp):
+            with open(bp, encoding="utf-8") as fh:
+                entries = baseline_mod.parse_baseline(fh.read())
+        if paths:
+            # Explicit file subset: entries for files outside it were
+            # never given a chance to match — reporting them stale
+            # would fail every single-file invocation.
+            linted = {f.path for f in files}
+            entries = [e for e in entries if e[0] in linted]
+    new, stale = baseline_mod.split_findings(findings, entries)
+    return LintResult(findings, new, stale, len(files))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m k8s1m_tpu.lint",
+        description="graftlint: project-native static analysis",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative .py files (default: whole tree)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: derived from the package)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: <root>/lint_baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baseline ignored")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="also fail on stale baseline entries (drift gate)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="print current findings in baseline format")
+    args = ap.parse_args(argv)
+
+    result = run_lint(
+        root=args.root,
+        paths=args.paths or None,
+        baseline_path="" if args.no_baseline else args.baseline,
+    )
+    if args.write_baseline:
+        print("# graftlint baseline — one 'path|rule|fingerprint' per "
+              "line; comment WHY above each entry")
+        for fd in result.findings:
+            print(baseline_mod.format_entry(fd))
+        return 0
+
+    for fd in result.new:
+        print(fd.render())
+    if args.check_baseline:
+        for path, rule, fp in result.stale:
+            print(f"{path} {rule} STALE baseline entry (fixed? remove it): "
+                  f"{fp!r}")
+    failed = bool(result.new) or (args.check_baseline and bool(result.stale))
+    grandfathered = len(result.findings) - len(result.new)
+    print(
+        f"graftlint: {result.files} files, {len(result.new)} new finding(s)"
+        f", {grandfathered} baselined"
+        + (f", {len(result.stale)} stale" if args.check_baseline else "")
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""no-wall-clock: deterministic code must not read the wall clock.
+
+Three tiers, strictest first:
+
+1. **Virtual-clock dirs** (``faultline/``, ``loadshed/``,
+   ``tools/overload_drill.py``, ``tests/``): any ``time.time()`` or
+   argless ``datetime.now()``/``utcnow()`` is flagged.  Determinism by
+   seed is the contract there — drills and fault plans replay the same
+   trajectory from the same seed, which a wall-clock read silently
+   breaks.
+2. **Durations anywhere**: a subtraction whose operand came from
+   ``time.time()`` (directly, via a local name, or via a ``self.``
+   attribute assigned in the same class) is flagged — wall clocks step
+   (NTP, leap smearing); durations must use ``time.monotonic()`` /
+   ``perf_counter()``.
+3. **Everything else**: a bare ``time.time()`` is still flagged, so
+   every wall-clock read in the tree is either converted or carries a
+   pragma naming its reason (timestamps for cross-process correlation
+   are legitimate — and now auditable).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile, dotted_name
+
+VIRTUAL_CLOCK_PATHS = (
+    "k8s1m_tpu/faultline/",
+    "k8s1m_tpu/loadshed/",
+    "k8s1m_tpu/tools/overload_drill.py",
+    "tests/",
+)
+
+_WALL_CALLS = {"time.time"}
+_DATETIME_NOW = {"datetime.now", "datetime.datetime.now",
+                 "datetime.utcnow", "datetime.datetime.utcnow"}
+
+
+def _is_wall_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call) and dotted_name(node.func) in _WALL_CALLS
+    )
+
+
+class NoWallClock(Rule):
+    id = "no-wall-clock"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        banned_dir = f.path.startswith(VIRTUAL_CLOCK_PATHS)
+
+        # Names/attrs assigned from time.time(), for the duration check.
+        wall_names: set[str] = set()       # local/global names
+        wall_attrs: set[str] = set()       # self.<attr> within a class
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign) and _is_wall_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        wall_names.add(tgt.id)
+                    elif (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        wall_attrs.add(tgt.attr)
+
+        def is_wall_operand(n: ast.AST) -> bool:
+            if _is_wall_call(n):
+                return True
+            if isinstance(n, ast.Name) and n.id in wall_names:
+                return True
+            return (
+                isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+                and n.attr in wall_attrs
+            )
+
+        duration_lines: set[int] = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if is_wall_operand(node.left) or is_wall_operand(node.right):
+                    duration_lines.add(node.lineno)
+                    out.append(self.finding(
+                        f, node,
+                        "duration computed from time.time(); wall clocks "
+                        "step — use time.monotonic()/perf_counter()",
+                    ))
+
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _WALL_CALLS:
+                if node.lineno in duration_lines:
+                    continue        # already reported as a duration
+                if banned_dir:
+                    out.append(self.finding(
+                        f, node,
+                        "wall-clock read in virtual-clock code "
+                        "(determinism-by-seed is the contract here; use "
+                        "the tick clock or an injected clock)",
+                    ))
+                else:
+                    out.append(self.finding(
+                        f, node,
+                        "time.time(): use time.monotonic() for "
+                        "durations, or pragma a deliberate wall-clock "
+                        "timestamp with its reason",
+                    ))
+            elif (
+                banned_dir
+                and name in _DATETIME_NOW
+                and not node.args
+                and not node.keywords
+            ):
+                out.append(self.finding(
+                    f, node,
+                    "argless datetime.now() in virtual-clock code "
+                    "(wall clock + naive tz; use the injected clock)",
+                ))
+        return out

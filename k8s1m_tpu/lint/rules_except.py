@@ -1,0 +1,77 @@
+"""broad-except: ``except Exception`` must not swallow silently.
+
+A handler catching ``Exception``/``BaseException`` (alone or in a
+tuple) must do at least one of:
+
+- re-raise (any ``raise`` statement in the handler body),
+- log with a traceback (``log.exception(...)`` or any logging call
+  passing ``exc_info=``),
+- carry a ``# graftlint: disable=broad-except`` pragma with its reason.
+
+The rule exists because the control plane degrades *gracefully by
+design* — resyncs, requeues, fallbacks — and a silent swallow converts
+a designed degradation into an undiagnosable one.  The tree had ~40
+bare sites when this rule landed; each is now a fix, a justified
+pragma, or a baselined grandfather entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import (
+    Finding,
+    Rule,
+    SourceFile,
+    walk_no_nested_functions,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _names_in_type(node: ast.AST | None) -> set[str]:
+    out: set[str] = set()
+    if node is None:
+        return out
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _handler_complies(handler: ast.ExceptHandler) -> bool:
+    # walk_no_nested_functions: a raise/log.exception inside a nested
+    # def the handler merely DEFINES is not the handler complying.
+    for n in walk_no_nested_functions(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if isinstance(n, ast.Call):
+            func = n.func
+            if isinstance(func, ast.Attribute) and func.attr == "exception":
+                return True
+            if any(kw.arg == "exc_info" for kw in n.keywords):
+                return True
+    return False
+
+
+class BroadExcept(Rule):
+    id = "broad-except"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not (_names_in_type(node.type) & _BROAD):
+                continue
+            if _handler_complies(node):
+                continue
+            out.append(self.finding(
+                f, node,
+                "except Exception must re-raise, log with traceback "
+                "(log.exception / exc_info=True), or carry a pragma "
+                "naming why the swallow is safe",
+            ))
+        return out

@@ -1,0 +1,253 @@
+"""Device-hot-path rules: hot-path-host-sync and trace-time-branch.
+
+Both rules need the same structural fact: *which functions are jitted
+regions*.  A function counts as jitted when it is
+
+- decorated with ``@jax.jit`` / ``@pjit`` / ``@functools.partial(jax.jit,
+  ...)``, or
+- passed (possibly through one local name alias) as the first argument
+  of a ``jax.jit(...)`` / ``pjit(...)`` call anywhere in the module —
+  the assignment-wrapped idiom this codebase favors
+  (``_scatter_rows = jax.jit(scatter_rows)``, ``return jax.jit(fn)``).
+
+``static_argnames`` from the jit call/decorator are honored: branching
+on a static argument is exactly what static args are for.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile, dotted_name
+
+_JIT_CALLEES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+# Paths (repo-relative prefixes) whose code feeds compiled TPU cycles:
+# a host sync here stalls the pipeline for every wave behind it.
+HOT_DIRS = (
+    "k8s1m_tpu/engine/",
+    "k8s1m_tpu/parallel/",
+    "k8s1m_tpu/plugins/",
+    "k8s1m_tpu/snapshot/",
+)
+
+# The host mirror: NodeTableHost's numpy columns ARE host state by
+# design (the authoritative side of the epoch-buffered snapshot), so
+# host<->device staging there is the mechanism, not a leak.
+HOT_ALLOWLIST = ("k8s1m_tpu/snapshot/node_table.py",)
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name in _JIT_CALLEES:
+        return True
+    # functools.partial(jax.jit, ...)
+    if name in ("functools.partial", "partial") and call.args:
+        inner = dotted_name(call.args[0])
+        return inner in _JIT_CALLEES
+    return False
+
+
+def _static_names(call: ast.Call) -> set[str]:
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+    return out
+
+
+def jitted_functions(tree: ast.AST) -> list[tuple[ast.AST, set[str]]]:
+    """(function node, static arg names) for every jitted region.
+
+    Lambdas passed to jit are included (host-sync calls can hide in
+    them even though they cannot hold if/while statements).
+    """
+    # Pass 1: name -> FunctionDef, and alias -> name (one level).
+    defs: dict[str, ast.AST] = {}
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Name):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    aliases[tgt.id] = node.value.id
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Lambda
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    defs.setdefault(tgt.id, node.value)
+
+    regions: dict[int, tuple[ast.AST, set[str]]] = {}
+
+    def add(fn: ast.AST, statics: set[str]) -> None:
+        key = id(fn)
+        if key in regions:
+            regions[key][1].update(statics)
+        else:
+            regions[key] = (fn, set(statics))
+
+    for node in ast.walk(tree):
+        # Decorator form.
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_call(dec):
+                    add(node, _static_names(dec))
+                elif dotted_name(dec) in _JIT_CALLEES:
+                    add(node, set())
+        # Call form: jax.jit(fn_or_lambda, ...).
+        if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+            target = node.args[0]
+            statics = _static_names(node)
+            if isinstance(target, ast.Lambda):
+                add(target, statics)
+            else:
+                name = dotted_name(target)
+                if name is not None:
+                    name = aliases.get(name, name)
+                    fn = defs.get(name)
+                    if fn is not None:
+                        add(fn, statics)
+    return list(regions.values())
+
+
+def _params_of(fn: ast.AST) -> list[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n != "self"]
+
+
+class HotPathHostSync(Rule):
+    """Forbid host-synchronizing calls where compiled cycles live.
+
+    ``.item()``, ``jax.device_get`` and ``.block_until_ready()`` force a
+    device->host round trip wherever they appear in the hot dirs; a
+    single one in the cycle path silently collapses the pipelined
+    scheduler to depth-1 (each wave blocks on the previous fetch).
+    ``np.asarray``/``np.array`` and ``float()/int()/bool()`` coercions
+    are flagged only inside jitted regions, where they would pull a
+    tracer to the host at trace time.
+    """
+
+    id = "hot-path-host-sync"
+
+    _SYNC_CALLS = {"jax.device_get"}
+    _SYNC_METHODS = {"item", "block_until_ready"}
+    _TRACE_COERCE_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                           "numpy.array"}
+    _TRACE_COERCE_BUILTINS = {"float", "int", "bool"}
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if not f.path.startswith(HOT_DIRS) or f.path in HOT_ALLOWLIST:
+            return []
+        out: list[Finding] = []
+        jit_nodes: set[int] = set()
+        for fn, _statics in jitted_functions(f.tree):
+            for n in ast.walk(fn):
+                jit_nodes.add(id(n))
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in self._SYNC_CALLS:
+                out.append(self.finding(
+                    f, node,
+                    f"{name}() is a device->host sync on the hot path "
+                    "(collapses the pipeline to depth-1)",
+                ))
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._SYNC_METHODS
+                and not node.args
+            ):
+                out.append(self.finding(
+                    f, node,
+                    f".{node.func.attr}() is a device->host sync on the "
+                    "hot path (collapses the pipeline to depth-1)",
+                ))
+                continue
+            if id(node) in jit_nodes:
+                if name in self._TRACE_COERCE_CALLS:
+                    out.append(self.finding(
+                        f, node,
+                        f"{name}() inside a jitted region pulls the value "
+                        "to host at trace time (use jnp, or hoist out of "
+                        "the jit)",
+                    ))
+                elif (
+                    name in self._TRACE_COERCE_BUILTINS
+                    and node.args
+                    and not isinstance(node.args[0], ast.Constant)
+                ):
+                    out.append(self.finding(
+                        f, node,
+                        f"{name}() coercion inside a jitted region "
+                        "concretizes a tracer (host sync at trace time)",
+                    ))
+        return out
+
+
+class TraceTimeBranch(Rule):
+    """Python ``if``/``while`` on a traced argument inside a jitted
+    region: either a latent ConcretizationTypeError or — worse — a
+    silent per-value recompile if the value is weakly typed.  ``is
+    None`` / ``is not None`` structure checks are trace-safe (pytree
+    structure is static) and exempt, as are ``static_argnames``.
+    """
+
+    id = "trace-time-branch"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        for fn, statics in jitted_functions(f.tree):
+            if isinstance(fn, ast.Lambda):
+                continue            # lambdas cannot hold statements
+            traced = set(_params_of(fn)) - statics
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                names = self._suspect_names(node.test, traced)
+                if names:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    out.append(super().finding(
+                        f, node,
+                        f"python `{kind}` on traced argument(s) "
+                        f"{sorted(names)} inside a jitted region (use "
+                        "jnp.where/lax.cond, or mark static)",
+                    ))
+        return out
+
+    @staticmethod
+    def _suspect_names(test: ast.AST, traced: set[str]) -> set[str]:
+        """Traced params referenced by ``test`` outside an ``is``
+        comparison."""
+        exempt: set[int] = set()
+        for n in ast.walk(test):
+            if isinstance(n, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops
+            ):
+                for sub in ast.walk(n):
+                    exempt.add(id(sub))
+            elif isinstance(n, ast.Call) and dotted_name(n.func) in (
+                "isinstance", "len", "getattr", "hasattr",
+            ):
+                # Structure/arity checks resolve at trace time.
+                for sub in ast.walk(n):
+                    exempt.add(id(sub))
+        return {
+            n.id
+            for n in ast.walk(test)
+            if isinstance(n, ast.Name)
+            and n.id in traced
+            and id(n) not in exempt
+        }

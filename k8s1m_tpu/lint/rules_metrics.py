@@ -1,0 +1,226 @@
+"""metrics-registry: the metric namespace is a checked interface.
+
+Three invariants over the whole tree (a cross-file rule):
+
+1. **Declared exactly once** — every metric name constructed via the
+   ``obs/metrics.py`` helpers (Counter/Gauge/Histogram/
+   AlertingHistogram/CallbackMetric) appears in exactly one
+   declaration under ``k8s1m_tpu/``.  The runtime Registry only catches
+   duplicates that actually import together; this catches them at lint
+   time, tree-wide.
+2. **Dashboard coverage both ways** — every row prefix in
+   ``obs/dashboard.py`` matches at least one declared metric (a stale
+   prefix is a silently empty dashboard row), and every declared
+   metric is covered by some row prefix (an uncovered metric is
+   evidence nobody can see).
+3. **Label-set consistency** — every ``.inc()/.set()/.observe()/...``
+   call site passes exactly the declared label names (call sites using
+   ``**kwargs`` are skipped — they are dynamic by construction).
+
+Tests are exempt from declaration scanning: scoped registries with
+colliding names are a legitimate fixture pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile, dotted_name
+
+_CTORS = {"Counter", "Gauge", "Histogram", "AlertingHistogram",
+          "CallbackMetric"}
+# Metric methods whose **labels kwargs must match the declaration.
+_LABEL_METHODS = {"inc", "dec", "set", "observe", "observe_many", "time",
+                  "value", "set_function", "sum", "quantile"}
+
+DASHBOARD_PATH = "k8s1m_tpu/obs/dashboard.py"
+# Declared metrics that intentionally render nowhere (internal plumbing
+# with a dedicated consumer rather than a panel).
+DASHBOARD_EXEMPT: set[str] = set()
+
+
+@dataclasses.dataclass
+class _Decl:
+    name: str
+    labels: tuple[str, ...] | None   # None = not statically resolvable
+    file: SourceFile
+    node: ast.Call
+    var: str | None                  # module-level variable name, if any
+
+
+def _ctor_name(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf if leaf in _CTORS else None
+
+
+def _const_str_tuple(node: ast.AST) -> tuple[str, ...] | None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+def _labels_of(call: ast.Call, ctor: str) -> tuple[str, ...] | None:
+    """Statically-known labelnames of a metric constructor call."""
+    if ctor == "CallbackMetric":
+        return ()                    # CallbackMetric has no labelnames arg
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return _const_str_tuple(kw.value)
+        if kw.arg is None:
+            return None              # **kwargs construction: unknown
+    # Positional: (name, help, labelnames, ...)
+    if len(call.args) >= 3:
+        return _const_str_tuple(call.args[2])
+    return ()
+
+
+class MetricsRegistry(Rule):
+    id = "metrics-registry"
+
+    def check_tree(self, files: list[SourceFile]) -> list[Finding]:
+        out: list[Finding] = []
+        decls: list[_Decl] = []
+        # module dotted name -> {var -> decl}
+        module_vars: dict[str, dict[str, _Decl]] = {}
+
+        for f in files:
+            if not f.path.startswith("k8s1m_tpu/"):
+                continue
+            mod = f.path[:-3].replace("/", ".")
+            for node in ast.walk(f.tree):
+                if not (isinstance(node, ast.Call) and _ctor_name(node)):
+                    continue
+                ctor = _ctor_name(node)
+                name = None
+                if node.args and isinstance(node.args[0], ast.Constant):
+                    if isinstance(node.args[0].value, str):
+                        name = node.args[0].value
+                if name is None:
+                    for kw in node.keywords:
+                        if kw.arg == "name" and isinstance(
+                            kw.value, ast.Constant
+                        ):
+                            name = kw.value.value
+                if name is None:
+                    continue        # dynamic name: out of scope
+                decls.append(_Decl(name, _labels_of(node, ctor), f, node, None))
+            # Map module-level vars to their decls.
+            for stmt in f.tree.body:
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call
+                ) and _ctor_name(stmt.value):
+                    for d in decls:
+                        if d.node is stmt.value:
+                            for tgt in stmt.targets:
+                                if isinstance(tgt, ast.Name):
+                                    d.var = tgt.id
+                                    module_vars.setdefault(mod, {})[
+                                        tgt.id
+                                    ] = d
+
+        # 1. declared exactly once.
+        seen: dict[str, _Decl] = {}
+        for d in decls:
+            if d.name in seen:
+                first = seen[d.name]
+                out.append(self.finding(
+                    d.file, d.node,
+                    f"metric {d.name!r} declared more than once (first "
+                    f"at {first.file.path}:{first.node.lineno})",
+                ))
+            else:
+                seen[d.name] = d
+
+        # 2. dashboard coverage, both directions.
+        dash = next((f for f in files if f.path == DASHBOARD_PATH), None)
+        if dash is not None and seen:
+            prefixes = self._dashboard_prefixes(dash)
+            names = set(seen)
+            for prefix, node in prefixes:
+                if not any(n.startswith(prefix) for n in names):
+                    out.append(self.finding(
+                        dash, node,
+                        f"dashboard row prefix {prefix!r} matches no "
+                        "declared metric (silently empty row)",
+                    ))
+            all_prefixes = tuple(p for p, _ in prefixes)
+            for n, d in seen.items():
+                if n in DASHBOARD_EXEMPT:
+                    continue
+                if all_prefixes and not n.startswith(all_prefixes):
+                    out.append(self.finding(
+                        d.file, d.node,
+                        f"metric {n!r} is covered by no dashboard row "
+                        "prefix (obs/dashboard.py ROWS) — unobservable "
+                        "evidence",
+                    ))
+
+        # 3. label-set consistency at call sites.
+        for f in files:
+            if not f.path.startswith("k8s1m_tpu/"):
+                continue
+            local = dict(module_vars.get(f.path[:-3].replace("/", "."), {}))
+            # Resolve `from k8s1m_tpu.x import METRIC [as alias]`.
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    src = module_vars.get(node.module)
+                    if not src:
+                        continue
+                    for alias in node.names:
+                        if alias.name in src:
+                            local[alias.asname or alias.name] = src[
+                                alias.name
+                            ]
+            if not local:
+                continue
+            for node in ast.walk(f.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _LABEL_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in local
+                ):
+                    continue
+                d = local[node.func.value.id]
+                if d.labels is None:
+                    continue
+                if any(kw.arg is None for kw in node.keywords):
+                    continue        # **labels: dynamic, skip
+                got = {kw.arg for kw in node.keywords}
+                want = set(d.labels)
+                if got != want and (got or want):
+                    out.append(self.finding(
+                        f, node,
+                        f"label set {sorted(got)} != declared "
+                        f"{sorted(want)} for metric {d.name!r}",
+                    ))
+        return out
+
+    @staticmethod
+    def _dashboard_prefixes(dash: SourceFile) -> list[tuple[str, ast.AST]]:
+        out: list[tuple[str, ast.AST]] = []
+        for stmt in dash.tree.body:
+            if not (
+                isinstance(stmt, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "ROWS"
+                    for t in stmt.targets
+                )
+            ):
+                continue
+            for n in ast.walk(stmt.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    if n.value.endswith("_"):
+                        out.append((n.value, n))
+        return out

@@ -1,0 +1,109 @@
+"""retry-through-policy: all retries flow through faultline.RetryPolicy.
+
+PR 1's whole point was ONE resilience policy — capped exponential
+backoff, jitter, deadline budget, give-up metrics — replacing every
+hand-rolled loop.  This rule keeps it that way: a ``while``/``for``
+loop whose ``except`` handler sleeps (the classic hand-rolled retry
+shape) is flagged unless the sleep duration is derived from a
+``RetryPolicy`` (``delay_for(...)`` taint), because an ad-hoc constant
+backoff re-introduces exactly the thundering-herd and silent-give-up
+bugs the policy centralizes away.
+
+``faultline/policy.py`` itself is exempt — it IS the policy.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import (
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+    walk_no_nested_functions,
+)
+
+EXEMPT_PATHS = ("k8s1m_tpu/faultline/policy.py",)
+
+_SLEEP_CALLEES = {"time.sleep", "sleep", "asyncio.sleep"}
+
+
+def _sleep_calls(node: ast.AST) -> list[ast.Call]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and dotted_name(n.func) in _SLEEP_CALLEES:
+            out.append(n)
+    return out
+
+
+class RetryThroughPolicy(Rule):
+    id = "retry-through-policy"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if f.path in EXEMPT_PATHS:
+            return []
+        out: list[Finding] = []
+        reported: set[int] = set()
+        for scope in ast.walk(f.tree):
+            if not isinstance(
+                scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+            ):
+                continue
+            # Names tainted by RetryPolicy pacing within this scope.
+            policy_names = self._policy_tainted(scope)
+            for node in walk_no_nested_functions(scope):
+                if not isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                    continue
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Try):
+                        continue
+                    for handler in sub.handlers:
+                        for call in _sleep_calls(handler):
+                            if id(call) in reported:
+                                continue            # nested-loop re-visit
+                            reported.add(id(call))
+                            if self._policy_paced(call, policy_names):
+                                continue
+                            out.append(self.finding(
+                                f, call,
+                                "hand-rolled retry (loop + except + "
+                                "sleep); route through faultline "
+                                "RetryPolicy.call / delay_for so backoff, "
+                                "jitter, deadline and give-up metrics "
+                                "stay centralized",
+                            ))
+        return out
+
+    @staticmethod
+    def _policy_tainted(scope: ast.AST) -> set[str]:
+        """Names assigned from an expression mentioning ``delay_for`` or
+        ``policy_for`` anywhere in this scope."""
+        names: set[str] = set()
+        for n in walk_no_nested_functions(scope):
+            if isinstance(n, ast.Assign):
+                mentions = any(
+                    isinstance(m, ast.Attribute) and m.attr == "delay_for"
+                    or isinstance(m, ast.Name)
+                    and m.id in ("delay_for", "policy_for")
+                    for m in ast.walk(n.value)
+                )
+                if mentions:
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name):
+                            names.add(tgt.id)
+        return names
+
+    @staticmethod
+    def _policy_paced(call: ast.Call, policy_names: set[str]) -> bool:
+        if not call.args:
+            return False
+        arg = call.args[0]
+        for m in ast.walk(arg):
+            if isinstance(m, ast.Attribute) and m.attr == "delay_for":
+                return True
+            if isinstance(m, ast.Name) and (
+                m.id in policy_names or m.id in ("delay_for",)
+            ):
+                return True
+        return False

@@ -47,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 
+from k8s1m_tpu.lint import guarded_by
 from k8s1m_tpu.obs.metrics import Counter, Gauge
 
 HEALTHY, DEGRADED, SHEDDING = 0, 1, 2
@@ -143,6 +144,20 @@ class LoadshedConfig:
             )
 
 
+@guarded_by(
+    # Everything the webhook handler threads and the cycle thread both
+    # touch lives under the admission lock: the sampled load + intra-tick
+    # admission count (the hard-cap arithmetic), the shedding floor and
+    # priority bounds (written by tick, read by every admission), and the
+    # state itself (read by admissions and the degraded-knobs switch).
+    # The lint/guards.py audit raises on any access outside the lock.
+    _load="_admit_lock",
+    _admitted_since_tick="_admit_lock",
+    _floor="_admit_lock",
+    _prio_lo="_admit_lock",
+    _prio_hi="_admit_lock",
+    state="_admit_lock",
+)
 class HealthController:
     """The overload state machine; one per coordinator.
 
@@ -181,6 +196,8 @@ class HealthController:
     # ---- state machine -------------------------------------------------
 
     def _set_state(self, new: int) -> None:
+        """State transition; caller must hold ``_admit_lock`` (state and
+        the shedding floor are read concurrently by admissions)."""
         if new == self.state:
             return
         _TRANSITIONS.inc(
@@ -196,9 +213,6 @@ class HealthController:
         """Advance one cycle; returns the (possibly new) state."""
         self.ticks += 1
         cfg = self.config
-        with self._admit_lock:
-            self._load = signals.load
-            self._admitted_since_tick = 0
         self._lat.append(signals.cycle_s)
         if len(self._lat) > cfg.latency_window:
             self._lat.pop(0)
@@ -210,27 +224,35 @@ class HealthController:
             or signals.conflicts >= cfg.conflicts_degraded
             or signals.resyncs > 0
         )
-        if overloaded:
-            self._calm = 0
-            self._set_state(SHEDDING)
-            # Still at/above the high watermark: shed one priority level
-            # deeper.  Deterministic — pure function of the load series.
-            self._floor = min(self._floor + 1, self._prio_hi)
-        elif strained:
-            self._calm = 0
-            if self.state < DEGRADED:
-                self._set_state(DEGRADED)
-        elif signals.load <= cfg.queue_recover:
-            self._calm += 1
-            if self._calm >= cfg.recover_cycles and self.state > HEALTHY:
-                # Hysteresis: one state per recover_cycles calm ticks,
-                # never a straight SHEDDING -> HEALTHY jump.
-                self._set_state(self.state - 1)
+        # The whole transition runs under the admission lock: webhook
+        # handler threads read state/floor on every admission, and a
+        # half-applied transition (state moved, floor not yet) would
+        # leak exactly the burst the watermarks exist to stop.
+        with self._admit_lock:
+            self._load = signals.load
+            self._admitted_since_tick = 0
+            if overloaded:
                 self._calm = 0
-        else:
-            # Between recover and degraded watermarks: hold.
-            self._calm = 0
-        return self.state
+                self._set_state(SHEDDING)
+                # Still at/above the high watermark: shed one priority
+                # level deeper.  Deterministic — pure function of the
+                # load series.
+                self._floor = min(self._floor + 1, self._prio_hi)
+            elif strained:
+                self._calm = 0
+                if self.state < DEGRADED:
+                    self._set_state(DEGRADED)
+            elif signals.load <= cfg.queue_recover:
+                self._calm += 1
+                if self._calm >= cfg.recover_cycles and self.state > HEALTHY:
+                    # Hysteresis: one state per recover_cycles calm
+                    # ticks, never a straight SHEDDING -> HEALTHY jump.
+                    self._set_state(self.state - 1)
+                    self._calm = 0
+            else:
+                # Between recover and degraded watermarks: hold.
+                self._calm = 0
+            return self.state
 
     def cycle_p99(self) -> float:
         if not self._lat:
@@ -240,7 +262,8 @@ class HealthController:
 
     @property
     def degraded(self) -> bool:
-        return self.state != HEALTHY
+        with self._admit_lock:
+            return self.state != HEALTHY
 
     # ---- admission -----------------------------------------------------
 
@@ -253,9 +276,12 @@ class HealthController:
         PriorityClass rather than just back off).  Counts every accept
         against the load sampled at the last tick so ``queue_cap`` is a
         hard bound, not a per-tick approximation."""
-        self._prio_lo = min(self._prio_lo, priority)
-        self._prio_hi = max(self._prio_hi, priority)
         with self._admit_lock:
+            # Bounds tracking moved under the lock: concurrent admissions
+            # used to lose min/max updates (the shedding floor could then
+            # never climb high enough to bite) — found by the guard audit.
+            self._prio_lo = min(self._prio_lo, priority)
+            self._prio_hi = max(self._prio_hi, priority)
             if (
                 self._load + self._admitted_since_tick
                 >= self.config.queue_cap
@@ -286,4 +312,6 @@ class HealthController:
     def note_degraded_cycle(self) -> None:
         """Called by the coordinator for every wave launched with
         degraded knobs (the ``degraded_cycles_total`` evidence)."""
-        _DEGRADED_CYCLES.inc(mode=STATE_NAMES[self.state])
+        with self._admit_lock:
+            mode = STATE_NAMES[self.state]
+        _DEGRADED_CYCLES.inc(mode=mode)

@@ -15,7 +15,44 @@ import hmac
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from k8s1m_tpu.lint import guarded_by
 from k8s1m_tpu.obs.metrics import REGISTRY
+
+
+@guarded_by(scrapes="_lock", denied="_lock", not_found="_lock")
+class ScrapeStats:
+    """Per-server scrape counters, mutated by concurrent handler threads.
+
+    ThreadingHTTPServer runs one thread per connection, so these counts
+    are exactly the shared-state shape the lint/guards.py audit checks:
+    every increment and read takes ``_lock`` (int += is not atomic under
+    free-threading, and torn counts in the self-monitoring endpoint are
+    the kind of lie that wastes an incident hour).  Exposed as
+    ``server.scrape_stats`` for harnesses and tests.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.scrapes = 0
+        self.denied = 0
+        self.not_found = 0
+
+    def note(self, outcome: str) -> None:
+        with self._lock:
+            if outcome == "ok":
+                self.scrapes += 1
+            elif outcome == "denied":
+                self.denied += 1
+            else:
+                self.not_found += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "scrapes": self.scrapes,
+                "denied": self.denied,
+                "not_found": self.not_found,
+            }
 
 
 def start_metrics_server(
@@ -41,6 +78,7 @@ def start_metrics_server(
         expected = "Basic " + base64.b64encode(
             f"{basic_auth[0]}:{basic_auth[1]}".encode()
         ).decode()
+    stats = ScrapeStats()
 
     class Handler(BaseHTTPRequestHandler):
         # Applied to the connection by StreamRequestHandler.setup();
@@ -51,14 +89,17 @@ def start_metrics_server(
             if expected is not None and not hmac.compare_digest(
                 self.headers.get("Authorization", ""), expected
             ):
+                stats.note("denied")
                 self.send_response(401)
                 self.send_header("WWW-Authenticate", "Basic realm=metrics")
                 self.end_headers()
                 return
             if self.path.rstrip("/") not in ("", "/metrics"):
+                stats.note("not_found")
                 self.send_response(404)
                 self.end_headers()
                 return
+            stats.note("ok")
             body = REGISTRY.render()
             if extra is not None:
                 body += extra()
@@ -106,5 +147,6 @@ def start_metrics_server(
 
         server = TLSServer((host, port), Handler)
     server.daemon_threads = True
+    server.scrape_stats = stats
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server

@@ -32,7 +32,21 @@ import os
 import threading
 import time
 
+from k8s1m_tpu.lint import guarded_by
 
+
+@guarded_by(
+    # Reader threads emit concurrently with pipe()/close() callers; the
+    # fd and reader bookkeeping used to mutate unlocked (a close racing
+    # a late pipe() could leak the new fd or skip its join) — found by
+    # the lint/guards.py audit, fixed by taking _lock everywhere and
+    # refusing pipe() once close() has begun (_accepting).
+    _f="_lock",
+    _closed="_lock",
+    _accepting="_lock",
+    _readers="_lock",
+    _write_fds="_lock",
+)
 class LogShipper:
     """Funnel many processes' output streams into one JSONL file."""
 
@@ -40,13 +54,19 @@ class LogShipper:
         os.makedirs(run_dir, exist_ok=True)
         ts = time.strftime("%Y%m%dT%H%M%S")
         self.path = os.path.join(run_dir, name or f"cluster-{ts}.jsonl")
-        self._f = open(self.path, "a", buffering=1)
         self._lock = threading.Lock()
+        self._f = open(self.path, "a", buffering=1)
         self._readers: list[threading.Thread] = []
         self._write_fds: list[int] = []
+        # _accepting gates pipe() the moment close() begins (a pipe
+        # registered after close's snapshot would leak its fd and strand
+        # its reader); _closed gates emit() only after the readers have
+        # drained, so the tail lines still land in the file.
+        self._accepting = True
         self._closed = False
 
     def emit(self, src: str, line: str) -> None:
+        # graftlint: disable=no-wall-clock (cross-process log correlation needs epoch time)
         rec = {"ts": round(time.time(), 3), "src": src, "line": line}
         with self._lock:
             if not self._closed:
@@ -58,7 +78,6 @@ class LogShipper:
         closes its copy after spawn; the reader thread exits on EOF when
         the LAST process holding the fd exits."""
         r, w = os.pipe()
-        self._write_fds.append(w)
 
         def read() -> None:
             with os.fdopen(r, "r", errors="replace") as f:
@@ -66,8 +85,18 @@ class LogShipper:
                     self.emit(src, line.rstrip("\n"))
 
         t = threading.Thread(target=read, name=f"logship-{src}", daemon=True)
-        t.start()
-        self._readers.append(t)
+        # Register AND start under one lock acquisition: close() must
+        # either see nothing (and this call raises) or see a started
+        # reader plus its fd (and joins/closes both) — never a half-
+        # registered pipe.
+        with self._lock:
+            if not self._accepting:
+                os.close(r)
+                os.close(w)
+                raise RuntimeError("LogShipper is closed")
+            self._write_fds.append(w)
+            self._readers.append(t)
+            t.start()
         return w
 
     def attach_logging(self, src: str = "harness",
@@ -79,7 +108,8 @@ class LogShipper:
             def emit(self, record: logging.LogRecord) -> None:
                 try:
                     ship.emit(src, self.format(record))
-                except Exception:
+                # A logging handler must never raise into its caller.
+                except Exception:  # graftlint: disable=broad-except
                     pass
 
         h = _H()
@@ -90,13 +120,18 @@ class LogShipper:
     def close(self, timeout: float = 5.0) -> None:
         """Close parent-side write fds (children should have exited) and
         drain the readers."""
-        for w in self._write_fds:
+        # Snapshot under the lock, join outside it: the readers need the
+        # lock inside emit(), so holding it across join() would deadlock.
+        with self._lock:
+            self._accepting = False     # no pipes registered past here
+            fds, self._write_fds = self._write_fds, []
+            readers = list(self._readers)
+        for w in fds:
             try:
                 os.close(w)
             except OSError:
                 pass
-        self._write_fds.clear()
-        for t in self._readers:
+        for t in readers:
             t.join(timeout=timeout)
         with self._lock:
             self._closed = True

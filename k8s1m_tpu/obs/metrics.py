@@ -117,7 +117,7 @@ class Gauge(Metric):
             for key, fn in self._callbacks.items():
                 try:
                     items[key] = float(fn())
-                except Exception:  # scrape must not die with the callback
+                except Exception:  # graftlint: disable=broad-except (scrape must not die with the callback)
                     continue
         for key, v in sorted(items.items()):
             lbl = _label_str(dict(zip(self.labelnames, key)))
@@ -144,7 +144,8 @@ class CallbackMetric(Metric):
                f"# TYPE {self.name} {self.kind}"]
         try:
             samples = self._fn()
-        except Exception:
+        # A failing callback yields no samples (class contract above).
+        except Exception:  # graftlint: disable=broad-except
             return out
         for labels, v in samples:
             out.append(f"{self.name}{_label_str(dict(labels))} {v}")

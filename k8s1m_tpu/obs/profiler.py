@@ -75,7 +75,8 @@ class SamplingProfiler:
     # -- lifecycle ------------------------------------------------------
 
     def start(self) -> "SamplingProfiler":
-        self.started_at = time.time()
+        # Wall stamp is report metadata; durations below use perf_counter.
+        self.started_at = time.time()  # graftlint: disable=no-wall-clock
         self._t0 = time.perf_counter()
         self._stop.clear()
         self._thread = threading.Thread(
@@ -145,6 +146,7 @@ class SamplingProfiler:
     def dump(self, path: str | None = None, top: int = 25) -> str:
         """Write the report next to the flight-recorder dumps."""
         if path is None:
+            # graftlint: disable=no-wall-clock (epoch-ms dump name, correlates across restarts)
             path = f"/tmp/profile-{int(time.time() * 1e3)}.json"
         with open(path, "w") as f:
             json.dump(self.report(top), f, indent=1)
@@ -172,6 +174,7 @@ def install_signal_dump(
 
     def handler(signum, frame):
         path = os.path.join(
+            # graftlint: disable=no-wall-clock (epoch dump name, correlates across restarts)
             dump_dir, f"stacks-{os.getpid()}-{int(time.time())}.txt"
         )
         names = {t.ident: t.name for t in threading.enumerate()}

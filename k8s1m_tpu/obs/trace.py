@@ -36,6 +36,7 @@ class FlightRecorder:
         self._dumps = 0
 
     def record(self, name: str, duration_s: float, **fields) -> None:
+        # graftlint: disable=no-wall-clock (span wall stamp for cross-process correlation; dur_s is caller-measured monotonic)
         span = {"name": name, "t": time.time(), "dur_s": duration_s, **fields}
         with self._lock:
             self._ring.append(span)
@@ -52,6 +53,7 @@ class FlightRecorder:
             self._dumps += 1
             ring = list(self._ring)
         path = os.path.join(
+            # graftlint: disable=no-wall-clock (epoch-ms dump name, correlates across restarts)
             self.dump_dir, f"flight-{int(time.time() * 1e3)}-{self._dumps}.json"
         )
         try:

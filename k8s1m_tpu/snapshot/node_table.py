@@ -48,6 +48,7 @@ from k8s1m_tpu.config import (
     NONE_ID,
     TableSpec,
 )
+from k8s1m_tpu.lint import THREAD_OWNER, guarded_by
 from k8s1m_tpu.snapshot.interning import Vocab, numeric_of
 
 class RowsExhausted(ValueError):
@@ -153,6 +154,17 @@ def empty_table(spec: TableSpec) -> NodeTable:
     )
 
 
+@guarded_by(
+    # The wave-epoch quarantine and the row mapping are the no-aliasing
+    # core of quiesce-free pipelining (PR 3): both are cycle-thread-
+    # confined, and a foreign thread touching either could hand an
+    # in-flight wave's row to a new node.  Audited under
+    # lint/guards.py's instrumentation mode.
+    _quarantine=THREAD_OWNER,
+    _free_rows=THREAD_OWNER,
+    _row_of=THREAD_OWNER,
+    wave_epoch=THREAD_OWNER,
+)
 class NodeTableHost:
     """Host-side builder/mirror of the node table (numpy, mutable).
 

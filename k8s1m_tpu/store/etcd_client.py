@@ -279,7 +279,9 @@ class WatchSession:
                     )
                 )
                 await self._call.done_writing()
-            except Exception:
+            # Half-close on teardown is best-effort; cancel() below is
+            # the authoritative cleanup.
+            except Exception:  # graftlint: disable=broad-except
                 pass
             self._call.cancel()
             self._call = None

@@ -45,6 +45,7 @@ from grpc import aio
 
 from k8s1m_tpu import faultline
 from k8s1m_tpu.faultline import InjectedFault, policy_for
+from k8s1m_tpu.lint import THREAD_OWNER, guarded_by
 from k8s1m_tpu.obs.metrics import Counter, Gauge
 from k8s1m_tpu.store.etcd_client import EtcdClient
 from k8s1m_tpu.store.native import prefix_end
@@ -123,6 +124,18 @@ class Downstream:
         self.wakeup.set()
 
 
+@guarded_by(
+    # The cache is event-loop-confined by design: the upstream pump, the
+    # downstream reader tasks and every Range all run on one asyncio
+    # loop.  THREAD_OWNER makes that a checked invariant — a second
+    # event loop (or a bare thread) reaching into the cache is exactly
+    # the corruption an async tier makes easy to write and hard to see.
+    objects=THREAD_OWNER,
+    sorted_keys=THREAD_OWNER,
+    history=THREAD_OWNER,
+    _exact=THREAD_OWNER,
+    _ranges=THREAD_OWNER,
+)
 class WatchCache:
     """Cached objects + bounded event history + downstream fan-out."""
 
@@ -424,7 +437,7 @@ async def run_upstream(
             delay = policy.delay_for(failures)
             log.warning(
                 "upstream watch for %r broke (%s); relisting in %.2fs",
-                prefix, e, delay,
+                prefix, e, delay, exc_info=True,
             )
             await asyncio.sleep(delay)
 
@@ -512,7 +525,8 @@ class UpstreamHandle:
                 self.requests_sent = target
                 try:
                     await s.request_progress()
-                except Exception:
+                # The swallow is the documented counter rollback below.
+                except Exception:  # graftlint: disable=broad-except
                     # The request never reached the store; leaving the
                     # counter bumped would make every later confirm wait
                     # for a response that can't come (until the next
@@ -865,7 +879,8 @@ class WatchCacheTier:
         for t in self.tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
+            # Awaiting a canceled pump; teardown continues regardless.
+            except (asyncio.CancelledError, Exception):  # graftlint: disable=broad-except
                 pass
         await self.upstream.close()
         await self.server.stop(None)
@@ -1036,7 +1051,8 @@ async def serve_watch_cache(
         for t in tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
+            # Awaiting a canceled pump; teardown continues regardless.
+            except (asyncio.CancelledError, Exception):  # graftlint: disable=broad-except
                 pass
         await upstream.close()
         raise

@@ -227,7 +227,8 @@ async def _kill_and_resume(
         await resume.wait_created(
             len(canary_keys), timeout=max(120.0, 4 * args.canary_timeout)
         )
-    except Exception as e:
+    # Reported in the drill's structured result (recovery_s: None).
+    except Exception as e:  # graftlint: disable=broad-except
         print(f"# tier kill drill: resume FAILED: {e!r}", file=sys.stderr)
         canary_muxes.append(resume)      # count whatever it delivers
         return {
@@ -241,7 +242,8 @@ async def _kill_and_resume(
         st = await seed.status()
         if st.header.revision - 2000 > 1:
             await seed.compact(st.header.revision - 2000)
-    except Exception:
+    # Best-effort compaction pressure; the canary gate is the check.
+    except Exception:  # graftlint: disable=broad-except
         pass
     deadline = time.monotonic() + args.canary_timeout
     while (
@@ -273,7 +275,8 @@ async def _wait_port(port: int, proc, deadline_s: float) -> None:
         except OSError:
             if time.monotonic() > deadline:
                 raise TimeoutError(f"port {port} never bound")
-            await asyncio.sleep(0.1)
+            # Deadline-bounded readiness poll, not an op retry.
+            await asyncio.sleep(0.1)  # graftlint: disable=retry-through-policy
 
 
 async def amain(args) -> dict:
@@ -427,7 +430,7 @@ async def amain(args) -> dict:
                     target = st.header.revision - 5000
                     if target > 1:
                         await seed.compact(target)
-                except Exception:
+                except Exception:  # graftlint: disable=broad-except
                     pass    # compaction is best-effort in the soak
             tick += 1
             try:
@@ -437,7 +440,7 @@ async def amain(args) -> dict:
                     # after 3 of N puts DID write 3 events — counting 0
                     # would turn them into phantom negative event_loss.
                     canary_written += 1
-            except Exception:
+            except Exception:  # graftlint: disable=broad-except
                 pass        # ledger writes pause while the store restarts
             if (
                 args.kill_tier_at

@@ -125,7 +125,8 @@ class MuxWatch:
         self._reader.cancel()
         try:
             await self._reader
-        except (asyncio.CancelledError, Exception):
+        # Close-path cancel: the reader is being torn down either way.
+        except (asyncio.CancelledError, Exception):  # graftlint: disable=broad-except
             pass
 
 
@@ -221,7 +222,8 @@ async def amain(args) -> dict:
                 except OSError:
                     if time.monotonic() > deadline:
                         raise TimeoutError("tier did not bind")
-                    await asyncio.sleep(0.05)
+                    # Deadline-bounded readiness poll, not an op retry.
+                    await asyncio.sleep(0.05)  # graftlint: disable=retry-through-policy
         rss0 = sum(_tier_rss_mb(p.pid) for p in tier_procs)
 
         channels = [

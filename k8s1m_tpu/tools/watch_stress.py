@@ -49,7 +49,8 @@ async def amain(args) -> dict:
                 batch = await s.next(timeout=10)
             except asyncio.TimeoutError:
                 return
-            except Exception:
+            # Counted, not logged: stream_errors is the report's signal.
+            except Exception:  # graftlint: disable=broad-except
                 # A failed stream must not masquerade as slow delivery:
                 # count it so the summary distinguishes error from lag.
                 stream_errors += 1

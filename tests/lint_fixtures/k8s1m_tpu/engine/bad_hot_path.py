@@ -1,0 +1,5 @@
+"""Fixture: exactly one hot-path-host-sync violation (.item())."""
+
+
+def read_scalar(rows_dev):
+    return rows_dev.item()

@@ -1,0 +1,10 @@
+"""Fixture: exactly one trace-time-branch violation."""
+
+import jax
+
+
+@jax.jit
+def clamp(x):
+    if x > 0:
+        return x
+    return -x

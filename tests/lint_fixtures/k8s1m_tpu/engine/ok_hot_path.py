@@ -1,0 +1,6 @@
+"""Pragma twin: the same host sync, deliberately annotated."""
+
+
+def read_scalar(rows_dev):
+    # One scalar at the end of a drill, not on the cycle path.
+    return rows_dev.item()  # graftlint: disable=hot-path-host-sync

@@ -1,0 +1,11 @@
+"""Pragma twin: the branch is deliberate (value is always concrete)."""
+
+import jax
+
+
+@jax.jit
+def clamp(x):
+    # graftlint: disable=trace-time-branch (x is a static python scalar here)
+    if x > 0:
+        return x
+    return -x

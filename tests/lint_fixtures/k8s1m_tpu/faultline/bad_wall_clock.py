@@ -1,0 +1,7 @@
+"""Fixture: exactly one no-wall-clock violation (banned dir)."""
+
+import time
+
+
+def stamp():
+    return time.time()

@@ -1,0 +1,8 @@
+"""Pragma twin: a deliberate wall stamp, annotated with its reason."""
+
+import time
+
+
+def stamp():
+    # graftlint: disable=no-wall-clock (report metadata, not drill logic)
+    return time.time()

@@ -1,0 +1,6 @@
+"""Fixture: exactly one metrics-registry violation (duplicate name)."""
+
+from k8s1m_tpu.obs.metrics import Counter
+
+_A = Counter("fixture_dup_total", "first declaration", ())
+_B = Counter("fixture_dup_total", "second declaration", ())

@@ -1,0 +1,8 @@
+"""Pragma twin: the re-declaration is deliberate (scoped registry)."""
+
+from k8s1m_tpu.obs.metrics import Counter, Registry
+
+_A = Counter("fixture_twin_total", "first declaration", ())
+# Scoped-registry re-declaration; the runtime Registry keeps them apart.
+_B = Counter("fixture_twin_total", "scoped twin", (),  # graftlint: disable=metrics-registry
+             registry=Registry())

@@ -1,0 +1,8 @@
+"""Fixture: exactly one broad-except violation."""
+
+
+def swallow(op):
+    try:
+        op()
+    except Exception:
+        pass

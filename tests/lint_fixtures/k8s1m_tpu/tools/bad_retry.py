@@ -1,0 +1,11 @@
+"""Fixture: exactly one retry-through-policy violation."""
+
+import time
+
+
+def fetch(op):
+    while True:
+        try:
+            return op()
+        except ConnectionError:
+            time.sleep(0.2)

@@ -1,0 +1,12 @@
+"""Pragma twin: the same shape, deliberately exempted."""
+
+import time
+
+
+def fetch(op):
+    while True:
+        try:
+            return op()
+        except ConnectionError:
+            # Deadline-bounded readiness poll, not an op retry.
+            time.sleep(0.2)  # graftlint: disable=retry-through-policy

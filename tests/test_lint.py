@@ -1,0 +1,148 @@
+"""graftlint: fixture true-positives, pragma twins, baseline drift gate.
+
+Layers:
+
+1. Per-rule fixtures — ``tests/lint_fixtures/`` mirrors the real tree's
+   layout (the hot-path and wall-clock rules are dir-scoped); each
+   ``bad_*.py`` violates exactly ONE rule and each ``ok_*.py`` is the
+   same violation behind a ``# graftlint: disable=`` pragma.
+2. The baseline machinery — parse/format round trip, counted matching,
+   both drift directions.
+3. The tier-1 gate — the REAL repo tree lints clean against the
+   committed ``lint_baseline.txt`` with zero new findings and zero
+   stale entries, and the ``python -m k8s1m_tpu.lint`` CLI agrees.
+   Every future PR inherits this check: a new violation fails here
+   until it is fixed, pragma'd with a reason, or consciously baselined.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from k8s1m_tpu.lint.base import Finding
+from k8s1m_tpu.lint.baseline import (
+    format_entry,
+    parse_baseline,
+    split_findings,
+)
+from k8s1m_tpu.lint.cli import ALL_RULES, repo_root, run_lint
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+EXPECTED = {
+    "hot-path-host-sync": "k8s1m_tpu/engine/bad_hot_path.py",
+    "trace-time-branch": "k8s1m_tpu/engine/bad_trace_branch.py",
+    "no-wall-clock": "k8s1m_tpu/faultline/bad_wall_clock.py",
+    "retry-through-policy": "k8s1m_tpu/tools/bad_retry.py",
+    "broad-except": "k8s1m_tpu/store/bad_broad_except.py",
+    "metrics-registry": "k8s1m_tpu/obs/bad_metrics.py",
+}
+
+
+@pytest.fixture(scope="module")
+def fixture_result():
+    return run_lint(root=FIXTURES, baseline_path="")
+
+
+def test_every_rule_has_a_true_positive_fixture(fixture_result):
+    got = {(f.rule, f.path) for f in fixture_result.findings}
+    assert got == {(rule, path) for rule, path in EXPECTED.items()}
+    # Exactly one finding per rule: each fixture violates ONE rule.
+    assert len(fixture_result.findings) == len(EXPECTED)
+
+
+def test_rule_ids_cover_expectations():
+    assert {r.id for r in ALL_RULES} == set(EXPECTED)
+
+
+def test_pragma_twins_pass(fixture_result):
+    ok_files = {
+        f.path for f in fixture_result.findings
+        if "/ok_" in f.path
+    }
+    assert ok_files == set()
+    # And the twins were actually linted (not skipped).
+    assert fixture_result.files == 2 * len(EXPECTED)
+
+
+# ---- baseline machinery ----------------------------------------------
+
+
+def test_baseline_round_trip_and_counted_matching():
+    f1 = Finding("a.py", 3, "broad-except", "msg", "except Exception:")
+    f2 = Finding("a.py", 9, "broad-except", "msg", "except Exception:")
+    entry = format_entry(f1)
+    entries = parse_baseline(f"# why\n{entry}\n")
+    assert entries == [("a.py", "broad-except", "except Exception:")]
+    # One entry absorbs exactly one of two identical findings.
+    new, stale = split_findings([f1, f2], entries)
+    assert len(new) == 1 and stale == []
+    # Two entries absorb both; a third is stale.
+    new, stale = split_findings([f1, f2], entries * 3)
+    assert new == [] and len(stale) == 1
+
+
+def test_baseline_rejects_malformed_lines():
+    with pytest.raises(ValueError):
+        parse_baseline("not a valid entry\n")
+
+
+# ---- the tier-1 gate over the real tree ------------------------------
+
+
+def test_repo_lints_clean_against_committed_baseline():
+    """No new findings AND no stale entries: the baseline matches the
+    tree exactly, so drift in either direction fails tier-1."""
+    result = run_lint()
+    assert [f.render() for f in result.new] == []
+    assert result.stale == []
+    # The baseline stays small by policy (<= 10 grandfathered findings).
+    grandfathered = len(result.findings) - len(result.new)
+    assert grandfathered <= 10
+
+
+def test_broad_except_not_satisfied_by_nested_function(tmp_path):
+    """A raise/log.exception inside a nested def the handler merely
+    DEFINES must not make a silent swallow pass the rule."""
+    pkg = tmp_path / "k8s1m_tpu"
+    pkg.mkdir()
+    (pkg / "sneaky.py").write_text(
+        "def f(op):\n"
+        "    try:\n"
+        "        op()\n"
+        "    except Exception:\n"
+        "        def helper():\n"
+        "            raise ValueError('never called')\n"
+        "        pass\n"
+    )
+    result = run_lint(root=str(tmp_path), baseline_path="")
+    assert [f.rule for f in result.findings] == ["broad-except"]
+
+
+def test_single_file_run_ignores_unrelated_baseline_entries():
+    """`tools/lint.sh path/to/file.py` must not report the whole
+    baseline as stale: entries for files outside the linted subset were
+    never given a chance to match."""
+    result = run_lint(paths=["k8s1m_tpu/control/coordinator.py"])
+    assert result.new == [] and result.stale == []
+    # A subset that CONTAINS a baselined file still matches its entry.
+    result = run_lint(paths=["k8s1m_tpu/tools/soak.py"])
+    assert result.new == [] and result.stale == []
+    assert len(result.findings) == 1          # the grandfathered swallow
+
+
+def test_cli_entry_point_agrees():
+    proc = subprocess.run(
+        [sys.executable, "-m", "k8s1m_tpu.lint", "--check-baseline"],
+        capture_output=True,
+        text=True,
+        cwd=repo_root(),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new finding(s)" in proc.stdout

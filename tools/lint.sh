@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# graftlint runner — the same invocation locally and in any future CI.
+#
+#   tools/lint.sh                 # full tree, baseline honored, drift-checked
+#   tools/lint.sh --no-baseline   # every finding, grandfathered included
+#   tools/lint.sh path/to/file.py # one file
+#
+# Exit 0 = clean (every finding fixed, pragma'd, or baselined and the
+# committed lint_baseline.txt matches the tree exactly); nonzero fails
+# the build.  tests/test_lint.py runs the identical gate in tier-1.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m k8s1m_tpu.lint --check-baseline "$@"

@@ -59,6 +59,18 @@ breaker, adaptive partial buckets, or quarantine exhaustion —
 ``pipeline_quiesce_total{reason}`` counts each, and under pure
 capacity churn the structural reason stays 0 (tier-1 asserted via
 ``sched_bench --node-churn``).
+
+**Host feed & encode cache** (snapshot/hotfeed.py): every encoder this
+coordinator owns shares one shape-keyed template cache (invalidated by
+``Vocab.generation()``), so batches full of shape-sharing pods fill in
+vectorized per-shape writes rather than per-pod Python; with
+``hotfeed`` on (default: follows ``pipeline``) a worker thread encodes
+the NEXT full batch while the current wave is in flight and the
+dispatch claims the pre-staged ``PackedPodBatch`` — discarded, never
+trusted, if the queue prefix or the vocab generation moved
+(``hotfeed_stale_batches_total{reason}``).  The degraded loadshed path
+and ``_process_adjusts`` re-encodes ride the same cache, so CAS-
+rollback storms re-encode against warm templates.
 """
 
 from __future__ import annotations
@@ -95,7 +107,6 @@ from k8s1m_tpu.engine.cycle import (
     adjust_constraints,
     adjust_constraints_impl,
     commit_fields_np,
-    commit_fields_of,
     sample_offset_for,
     sample_rows_for,
     schedule_batch_packed,
@@ -109,6 +120,12 @@ from k8s1m_tpu.ops.priority import pod_priority_of
 from k8s1m_tpu.oracle import oracle_feasible, oracle_score
 from k8s1m_tpu.plugins.registry import Profile, degraded_profile
 from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
+from k8s1m_tpu.snapshot.hotfeed import (
+    EncodeCache,
+    HostFeed,
+    HotPodBatchHost,
+    encode_batch,
+)
 from k8s1m_tpu.snapshot.node_table import (
     ALL_COLUMNS,
     CAP_COLUMNS,
@@ -235,13 +252,22 @@ class PendingPod:
     # retry (RetryPolicy backoff; 0 = immediately eligible).
     not_before: float = 0.0
 
+    def peek_pod(self) -> PodInfo:
+        """The PodInfo WITHOUT caching it on the record — the hotfeed
+        worker's form (a peeked pod still belongs to the cycle thread's
+        queue; assigning ``self.pod`` there would be a cross-thread
+        write on shared state)."""
+        if self.pod is not None:
+            return self.pod
+        ns, name = self.key_str.split("/", 1)
+        return PodInfo(
+            name=name, namespace=ns,
+            cpu_milli=self.cpu_milli, mem_kib=self.mem_kib,
+        )
+
     def ensure_pod(self) -> PodInfo:
         if self.pod is None:
-            ns, name = self.key_str.split("/", 1)
-            self.pod = PodInfo(
-                name=name, namespace=ns,
-                cpu_milli=self.cpu_milli, mem_kib=self.mem_kib,
-            )
+            self.pod = self.peek_pod()
         return self.pod
 
 
@@ -318,6 +344,14 @@ class Coordinator:
         # scheduler while open.  None (the default) = none of that runs.
         loadshed: HealthController | None = None,
         breaker: CircuitBreaker | None = None,
+        # Host feed (snapshot/hotfeed.py): encode batch N+1 in a worker
+        # thread while batch N's wave is in flight, so encode_packed
+        # leaves the cycle's serial section whenever the queue is deep
+        # enough to stage a full batch ahead.  None = follow `pipeline`
+        # (the overlap only pays when waves overlap host work).  The
+        # shape-keyed encode CACHE is always on — it is byte-identical
+        # to the uncached encode by construction (tests/test_hotfeed.py).
+        hotfeed: bool | None = None,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -411,7 +445,33 @@ class Coordinator:
 
         self.host = NodeTableHost(table_spec)
         self.tracker = ConstraintTracker(table_spec)
-        self.encoder = PodBatchHost(pod_spec, table_spec, self.host.vocab)
+        # One shape-keyed template cache shared by every encoder this
+        # coordinator owns (inline buckets, the feed's worker, the
+        # adjust path) — templates carry no batch dimension, and cache
+        # reuse across the paths is what makes a CAS-rollback storm's
+        # re-encodes near-free (the shapes were all seen at intake).
+        self.encode_cache = EncodeCache()
+        self.encoder = HotPodBatchHost(
+            pod_spec, table_spec, self.host.vocab, cache=self.encode_cache
+        )
+        if hotfeed is None:
+            hotfeed = pipeline
+        self._feed = (
+            HostFeed(HotPodBatchHost(
+                pod_spec, table_spec, self.host.vocab,
+                cache=self.encode_cache, path="feed",
+            ))
+            if hotfeed else None
+        )
+        if self._feed is not None:
+            # A coordinator dropped without close() must not leak the
+            # parked worker thread (the thread's bound target pins the
+            # feed, encoder, and arena forever otherwise).
+            weakref.finalize(self, self._feed.close)
+        # Reusable scratch for _process_adjusts (allocated lazily at
+        # first use; zeroed per chunk) — the per-call np.zeros were
+        # measurable during rollback storms.
+        self._adjust_scratch: dict | None = None
         # Adaptive batch buckets: a shallow queue schedules in a smaller
         # power-of-two batch instead of waiting out a full wave's worth
         # of padding — the lever that keeps p50 schedule-to-bind low at
@@ -1135,22 +1195,40 @@ class Coordinator:
     # ---- the cycle -----------------------------------------------------
 
     def _process_adjusts(self) -> None:
-        """Batch-apply queued constraint-count corrections."""
+        """Batch-apply queued constraint-count corrections.
+
+        Runs through the hotfeed encode cache (the pods being adjusted
+        were all encoded at intake, so a CAS-rollback storm's re-encodes
+        are template hits) and reuses one scratch arena instead of five
+        fresh ``np.zeros`` per chunk — this path fires exactly when the
+        system is already struggling (rollback storms, deletions), so
+        its constant cost matters most."""
         if not self._pending_adjusts or self.constraints is None:
             return
         b = self.pod_spec.batch
         pending, self._pending_adjusts = self._pending_adjusts, []
+        scr = self._adjust_scratch
+        if scr is None:
+            scr = self._adjust_scratch = {
+                "node_row": np.zeros(b, np.int32),
+                "zone": np.zeros(b, np.int32),
+                "region": np.zeros(b, np.int32),
+                "mask_node": np.zeros(b, bool),
+                "mask_dom": np.zeros(b, bool),
+            }
         for sign in (1, -1):
             group = [a for a in pending if a[4] == sign]
             for off in range(0, len(group), b):
                 chunk = group[off : off + b]
-                batch = self.encoder.encode([g[0] for g in chunk])
-                fields = commit_fields_of(batch)
-                node_row = np.zeros(b, np.int32)
-                zone = np.zeros(b, np.int32)
-                region = np.zeros(b, np.int32)
-                mask_node = np.zeros(b, bool)
-                mask_dom = np.zeros(b, bool)
+                batch = self.encoder.encode_packed([g[0] for g in chunk])
+                fields = commit_fields_np(batch.fields)
+                for arr in scr.values():
+                    arr[:] = 0
+                node_row = scr["node_row"]
+                zone = scr["zone"]
+                region = scr["region"]
+                mask_node = scr["mask_node"]
+                mask_dom = scr["mask_dom"]
                 for i, (_, node_name, z, r, _s) in enumerate(chunk):
                     row = self.host._row_of.get(node_name)
                     if row is not None:
@@ -1158,10 +1236,13 @@ class Coordinator:
                         mask_node[i] = True
                     zone[i], region[i] = z, r
                     mask_dom[i] = True
+                # jnp.array (copy=True), NOT asarray: CPU jax may alias
+                # numpy memory zero-copy, and the scratch is mutated for
+                # the next chunk while this dispatch is still in flight.
                 self.constraints = self._adjust(
                     self.constraints, fields,
-                    jnp.asarray(node_row), jnp.asarray(zone), jnp.asarray(region),
-                    jnp.asarray(mask_node), jnp.asarray(mask_dom), sign=sign,
+                    jnp.array(node_row), jnp.array(zone), jnp.array(region),
+                    jnp.array(mask_node), jnp.array(mask_dom), sign=sign,
                 )
 
     def submit_external(self, obj: dict, *, admitted: bool = False) -> None:
@@ -1238,9 +1319,10 @@ class Coordinator:
             return self.encoder
         enc = self._encoders.get(b)
         if enc is None:
-            enc = PodBatchHost(
+            enc = HotPodBatchHost(
                 dataclasses.replace(self.pod_spec, batch=b),
                 self.table_spec, self.host.vocab,
+                cache=self.encode_cache,
             )
             self._encoders[b] = enc
         return enc
@@ -1264,27 +1346,28 @@ class Coordinator:
 
     def _take_batch(self):
         """Pop and encode up to one batch of pending pods; (None, None)
-        when the queue is empty."""
+        when the queue is empty.  A feed-staged batch (encoded in the
+        worker while the last wave was in flight) is claimed first; the
+        claim fails closed — queue prefix changed, vocab generation
+        moved, worker error — and the inline cached encode covers it."""
         self._release_backoff()
         if not self.queue:
             return None, None
         batch_pods: list[PendingPod] = []
         while self.queue and len(batch_pods) < self.pod_spec.batch:
             batch_pods.append(self.queue.popleft())
+        # graftlint: disable=hotfeed-no-per-pod-python (O(pods) set bookkeeping for popped keys)
         for p in batch_pods:
             self._queued_keys.discard(p.key_str)
         with self._stage("encode"):
-            enc = self._encoder_for(len(batch_pods))
-            if all(p.pod is None for p in batch_pods):
-                # Native-intake fast lane: a wave of plain pods encodes
-                # from two int columns, no per-pod Python.
-                batch = enc.encode_packed_plain(
-                    [p.cpu_milli for p in batch_pods],
-                    [p.mem_kib for p in batch_pods],
+            batch = None
+            if self._feed is not None:
+                batch = self._feed.claim(
+                    batch_pods, self.host.vocab.feed_generation()
                 )
-            else:
-                batch = enc.encode_packed(
-                    [p.ensure_pod() for p in batch_pods]
+            if batch is None:
+                batch = encode_batch(
+                    self._encoder_for(len(batch_pods)), batch_pods
                 )
         return batch_pods, batch
 
@@ -1748,6 +1831,11 @@ class Coordinator:
                 self.breaker.record_failure()
                 self._requeue_front(batch_pods)
                 return 0
+            if self._feed is not None:
+                # Encode the NEXT full batch while _complete below waits
+                # out the device round trip (the one overlap window the
+                # unpipelined cycle has).
+                self._feed.stage(self.queue, self.pod_spec.batch)
             return self._complete(inflight)
         # Pipelined: up to ``depth`` waves in flight, so each wave's
         # device compute AND its result-fetch round trip overlap the host
@@ -1823,6 +1911,10 @@ class Coordinator:
                 return done
             self._inflights.append(inflight)
             self.depth_timer.set_level(len(self._inflights))
+            if self._feed is not None:
+                # Wave N is in flight: peek (never pop) the next full
+                # batch and let the worker encode it behind the device.
+                self._feed.stage(self.queue, self.pod_spec.batch)
             if self.adaptive_batch and batch.batch < self.pod_spec.batch:
                 # Light load (partial bucket): pipelining buys no
                 # throughput — the queue is draining faster than it
@@ -1997,11 +2089,14 @@ class Coordinator:
     def close(self) -> None:
         """Cancel store watches (native watchers are registered until
         explicitly cancelled — dropping the object alone would leave the
-        store dispatching into a 10,000-event queue forever)."""
+        store dispatching into a 10,000-event queue forever) and stop
+        the host-feed worker."""
         for w in (self._nodes_watch, self._pods_watch):
             if w is not None:
                 w.cancel()
         self._nodes_watch = self._pods_watch = None
+        if self._feed is not None:
+            self._feed.close()
 
     def run_until_idle(self, max_cycles: int = 10000) -> int:
         """Drive cycles until no pending pods remain; returns total binds."""

@@ -27,6 +27,7 @@ from k8s1m_tpu.lint.base import (
 )
 from k8s1m_tpu.lint.rules_clock import NoWallClock
 from k8s1m_tpu.lint.rules_except import BroadExcept
+from k8s1m_tpu.lint.rules_hotfeed import HotfeedNoPerPodPython
 from k8s1m_tpu.lint.rules_jax import HotPathHostSync, TraceTimeBranch
 from k8s1m_tpu.lint.rules_metrics import MetricsRegistry
 from k8s1m_tpu.lint.rules_retry import RetryThroughPolicy
@@ -38,6 +39,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     MetricsRegistry,
     BroadExcept,
     TraceTimeBranch,
+    HotfeedNoPerPodPython,
 )
 
 # The linted slice of the repo (everything else is docs/artifacts).

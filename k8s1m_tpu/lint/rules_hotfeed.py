@@ -1,0 +1,98 @@
+"""hotfeed-no-per-pod-python: the hot encode path stays vectorized.
+
+The hotfeed contract (snapshot/hotfeed.py) is that per-POD work in the
+encode path is bounded to cheap dict/tuple bookkeeping — every array
+write is a vectorized column write or a per-SHAPE fancy-indexed row
+broadcast.  A ``for ... in pods:`` loop quietly reintroduced into that
+path regresses the whole point of the subsystem, and nothing else would
+catch it (the code stays correct, just 10x slower).
+
+Scope — deliberately narrow, the two places the contract holds:
+
+- any ``*hotfeed*.py`` under ``k8s1m_tpu/snapshot/`` (whole file —
+  including ``encode_batch``, the one shared encode body);
+- the coordinator feed path: the body of ``_take_batch`` in
+  ``k8s1m_tpu/control/coordinator.py`` (pop + claim + encode).
+
+Flagged shapes: ``for``-statements and comprehension generators whose
+iterable is a pod list (names ``pods`` / ``batch_pods``, bare or
+wrapped in enumerate/zip/reversed/sorted/list, or ``range(len(pods))``).
+
+Escape hatches (base.py): a ``# graftlint: disable=`` pragma carrying
+the reason the site is irreducibly O(pods)-cheap (fingerprinting, qkey
+replay, scalar extraction feeding a vectorized write), or a baseline
+entry for a grandfathered site.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile
+
+_POD_LIST_NAMES = {"pods", "batch_pods"}
+_WRAPPERS = {"enumerate", "zip", "reversed", "sorted", "list", "tuple"}
+
+COORDINATOR_PATH = "k8s1m_tpu/control/coordinator.py"
+FEED_FUNCS = {"_take_batch"}
+
+
+def _is_pod_iterable(node: ast.AST) -> bool:
+    """True when ``node`` iterates a pod list: ``pods``, ``self.pods``,
+    ``enumerate(pods)``, ``zip(a, pods)``, ``range(len(pods))``..."""
+    if isinstance(node, ast.Name) and node.id in _POD_LIST_NAMES:
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in _POD_LIST_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id in _WRAPPERS:
+                return any(_is_pod_iterable(a) for a in node.args)
+            if fn.id == "range":
+                # range(len(pods)) and friends.
+                for a in ast.walk(node):
+                    if isinstance(a, ast.Name) and a.id in _POD_LIST_NAMES:
+                        return True
+    return False
+
+
+class HotfeedNoPerPodPython(Rule):
+    id = "hotfeed-no-per-pod-python"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        base = f.path.rsplit("/", 1)[-1]
+        if f.path.startswith("k8s1m_tpu/snapshot/") and "hotfeed" in base:
+            return self._scan(f, f.tree)
+        if f.path == COORDINATOR_PATH:
+            out: list[Finding] = []
+            for node in ast.walk(f.tree):
+                if (
+                    isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in FEED_FUNCS
+                ):
+                    out.extend(self._scan(f, node))
+            return out
+        return []
+
+    def _scan(self, f: SourceFile, root: ast.AST) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(root):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if _is_pod_iterable(node.iter):
+                    out.append(self._flag(f, node))
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                       ast.GeneratorExp)
+            ):
+                if any(_is_pod_iterable(g.iter) for g in node.generators):
+                    out.append(self._flag(f, node))
+        return out
+
+    def _flag(self, f: SourceFile, node: ast.AST) -> Finding:
+        return self.finding(
+            f, node,
+            "per-pod Python in the hotfeed encode path; use a cached "
+            "template + vectorized column/row write, or pragma with the "
+            "reason this site is irreducibly O(pods)-cheap",
+        )

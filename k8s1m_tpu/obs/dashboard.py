@@ -38,6 +38,10 @@ ROWS = [
     # Quiesce-free pipelining evidence: quiesce reasons, in-flight depth,
     # and the host-stage overlap split (pipeline_* in control/coordinator).
     ("Scheduling cycle", ("pipeline_",)),
+    # Cached + overlapped pod encoding (snapshot/hotfeed.py): encode
+    # seconds by path, template-cache hit/miss, staged-batch use and the
+    # stale-discard reasons.
+    ("Host feed", ("hotfeed_",)),
     ("Overload control", ("loadshed_", "admission_", "breaker_",
                           "degraded_")),
     # Fault injection + the one shared RetryPolicy (k8s1m_tpu/faultline).

@@ -92,3 +92,31 @@ class Vocab:
         self.node_names = Interner()
         self.zones = Interner()
         self.regions = Interner()
+
+    def generation(self) -> int:
+        """Monotonic counter over the *encode-relevant* namespaces.
+
+        The hotfeed encode cache (snapshot/hotfeed.py) keys its templates
+        on this: a cached ``tolerated`` row bakes in the taint triples
+        interned at encode time, and cached selector value ids bake in
+        ``label_values`` lookups (a value unseen then encodes NONE_ID but
+        would encode a real id after a node introduces it).  Interners
+        only grow, so the sum of lengths is a valid generation counter.
+
+        ``node_names`` / ``zones`` / ``regions`` are deliberately
+        EXCLUDED: ``spec.nodeName`` is resolved per pod at fill time (a
+        scalar column, never cached in a template), so node churn — the
+        high-rate namespace — must not invalidate the shape cache.
+        """
+        return len(self.label_keys) + len(self.label_values) + len(self.taints)
+
+    def feed_generation(self) -> int:
+        """Staleness stamp for a fully-ENCODED batch — ``generation()``
+        plus the node-name namespace.  A batch's scalar ``node_name_id``
+        column bakes ``node_names`` lookups (a ``spec.nodeName`` naming
+        a then-unknown node encodes the -1 never-matches sentinel, but
+        would resolve once the node interns), so the hotfeed's staged
+        batches must also go stale on node-name growth — unlike the
+        template cache, whose rows never contain node-name ids.
+        """
+        return self.generation() + len(self.node_names)

@@ -190,6 +190,13 @@ class PodBatch:
         return self.valid.shape[0]
 
 
+# SHAPE-ONLY by construction — deliberately NOT keyed by vocab
+# generation: every shape below derives from PodSpec/TableSpec static
+# bounds alone (batch, term/expr/value slot counts, max_taint_ids).  No
+# interned id ever flows into a shape — ids are array *contents*, sized
+# by the static bounds — so vocab growth can never make a cached spec
+# stale.  Anything content-dependent (the hotfeed template cache) must
+# key on Vocab.generation() instead; see snapshot/hotfeed.py.
 @functools.lru_cache(maxsize=16)
 def batch_field_specs(
     s: PodSpec, t: TableSpec
@@ -298,6 +305,14 @@ class PackedPodBatch:
     spec: PodSpec
     table_spec: TableSpec
     groups: frozenset   # included group names
+    # Vocab.feed_generation() the encode ran against, stamped by the
+    # hotfeed encoder (snapshot/hotfeed.py) — the batch stamp includes
+    # node_names because the node_name_id column bakes its lookups.
+    # None = vocab-independent (the plain fast lane touches no interned
+    # namespace) or a legacy encode; the double-buffered feed compares
+    # this against the live feed_generation before handing a pre-staged
+    # batch to a wave.
+    vocab_gen: int | None = None
 
     @property
     def batch(self) -> int:
@@ -453,79 +468,93 @@ class PodBatchHost:
             if pod.node_name is not None:
                 nid = v.node_names.lookup(pod.node_name)
                 out["node_name_id"][i] = nid if nid != NONE_ID else -1
+            self._fill_pod(out, i, pod, qidx, taints)
 
-            # Evaluate this pod's tolerations against every distinct taint
-            # triple (upstream: v1.Toleration.ToleratesTaint per node taint).
-            if taints:
-                for tid, (tkey, tval, teffect) in taints:
-                    out["tolerated"][i, tid] = pod_tolerates_taint(
-                        pod.tolerations, Taint(tkey, tval, teffect)
-                    )
+    def _fill_pod(self, out: dict, i: int, pod: PodInfo, qidx, taints) -> None:
+        """Encode one pod's structural features into row ``i`` of ``out``.
 
-            if not (
-                pod.node_selector or pod.required_terms or pod.preferred_terms
-                or pod.spread_refs or pod.affinity_refs or pod.spread_incs
-                or pod.ipa_incs
-            ):
-                continue    # plain pod: everything below stays zero
+        Shared between the batch loop above and the hotfeed template
+        encoder (snapshot/hotfeed.py encodes each distinct shape ONCE
+        through this body, then replays the cached rows with vectorized
+        writes) — one source of truth is what makes cached encodes
+        byte-identical to uncached by construction."""
+        s = self.spec
+        v = self.vocab
 
-            if len(pod.node_selector) > s.aff_exprs:
-                raise ValueError(f"pod {pod.key}: nodeSelector too large")
-            for j, (k, val) in enumerate(sorted(pod.node_selector.items())):
-                out["sel_valid"][i, j] = True
-                out["sel_qidx"][i, j] = qidx(k)
-                out["sel_val"][i, j] = v.label_values.lookup(val)
-
-            if len(pod.required_terms) > s.aff_terms:
-                raise ValueError(f"pod {pod.key}: too many required affinity terms")
-            for j, term in enumerate(pod.required_terms):
-                out["req_term_valid"][i, j] = True
-                self._encode_exprs(
-                    qidx, i, j, term.match_expressions, out["req_expr_valid"],
-                    out["req_qidx"], out["req_op"], out["req_vals"], out["req_num"],
-                )
-            if len(pod.preferred_terms) > s.pref_terms:
-                raise ValueError(f"pod {pod.key}: too many preferred terms")
-            for j, pt in enumerate(pod.preferred_terms):
-                out["pref_term_valid"][i, j] = True
-                out["pref_weight"][i, j] = pt.weight
-                self._encode_exprs(
-                    qidx, i, j, pt.term.match_expressions, out["pref_expr_valid"],
-                    out["pref_qidx"], out["pref_op"], out["pref_vals"], out["pref_num"],
+        # Evaluate this pod's tolerations against every distinct taint
+        # triple (upstream: v1.Toleration.ToleratesTaint per node taint).
+        # A pod with no tolerations tolerates nothing — skip the
+        # per-triple scan instead of evaluating an empty list per triple.
+        if taints and pod.tolerations:
+            for tid, (tkey, tval, teffect) in taints:
+                out["tolerated"][i, tid] = pod_tolerates_taint(
+                    pod.tolerations, Taint(tkey, tval, teffect)
                 )
 
-            if len(pod.spread_refs) > s.spread_refs:
-                raise ValueError(f"pod {pod.key}: too many spread constraints")
-            for j, ref in enumerate(pod.spread_refs):
-                out["spread_valid"][i, j] = True
-                out["spread_cid"][i, j] = ref.cid
-                out["spread_topo"][i, j] = ref.topo
-                out["spread_max_skew"][i, j] = ref.max_skew
-                out["spread_mode"][i, j] = ref.mode
-                out["spread_self"][i, j] = ref.self_match
-            if len(pod.affinity_refs) > s.affinity_refs:
-                raise ValueError(f"pod {pod.key}: too many affinity terms")
-            for j, ref in enumerate(pod.affinity_refs):
-                out["ipa_valid"][i, j] = True
-                out["ipa_tid"][i, j] = ref.tid
-                out["ipa_topo"][i, j] = ref.topo
-                out["ipa_required"][i, j] = ref.required
-                out["ipa_anti"][i, j] = ref.anti
-                out["ipa_weight"][i, j] = ref.weight
-                out["ipa_self"][i, j] = ref.self_match
+        if not (
+            pod.node_selector or pod.required_terms or pod.preferred_terms
+            or pod.spread_refs or pod.affinity_refs or pod.spread_incs
+            or pod.ipa_incs
+        ):
+            return    # plain pod: everything below stays zero
 
-            if len(pod.spread_incs) > s.spread_incs:
-                raise ValueError(f"pod {pod.key}: too many spread increments")
-            for j, (cid, topo) in enumerate(pod.spread_incs):
-                out["sinc_valid"][i, j] = True
-                out["sinc_cid"][i, j] = cid
-                out["sinc_topo"][i, j] = topo
-            if len(pod.ipa_incs) > s.ipa_incs:
-                raise ValueError(f"pod {pod.key}: too many affinity increments")
-            for j, (tid, topo) in enumerate(pod.ipa_incs):
-                out["iinc_valid"][i, j] = True
-                out["iinc_tid"][i, j] = tid
-                out["iinc_topo"][i, j] = topo
+        if len(pod.node_selector) > s.aff_exprs:
+            raise ValueError(f"pod {pod.key}: nodeSelector too large")
+        for j, (k, val) in enumerate(sorted(pod.node_selector.items())):
+            out["sel_valid"][i, j] = True
+            out["sel_qidx"][i, j] = qidx(k)
+            out["sel_val"][i, j] = v.label_values.lookup(val)
+
+        if len(pod.required_terms) > s.aff_terms:
+            raise ValueError(f"pod {pod.key}: too many required affinity terms")
+        for j, term in enumerate(pod.required_terms):
+            out["req_term_valid"][i, j] = True
+            self._encode_exprs(
+                qidx, i, j, term.match_expressions, out["req_expr_valid"],
+                out["req_qidx"], out["req_op"], out["req_vals"], out["req_num"],
+            )
+        if len(pod.preferred_terms) > s.pref_terms:
+            raise ValueError(f"pod {pod.key}: too many preferred terms")
+        for j, pt in enumerate(pod.preferred_terms):
+            out["pref_term_valid"][i, j] = True
+            out["pref_weight"][i, j] = pt.weight
+            self._encode_exprs(
+                qidx, i, j, pt.term.match_expressions, out["pref_expr_valid"],
+                out["pref_qidx"], out["pref_op"], out["pref_vals"], out["pref_num"],
+            )
+
+        if len(pod.spread_refs) > s.spread_refs:
+            raise ValueError(f"pod {pod.key}: too many spread constraints")
+        for j, ref in enumerate(pod.spread_refs):
+            out["spread_valid"][i, j] = True
+            out["spread_cid"][i, j] = ref.cid
+            out["spread_topo"][i, j] = ref.topo
+            out["spread_max_skew"][i, j] = ref.max_skew
+            out["spread_mode"][i, j] = ref.mode
+            out["spread_self"][i, j] = ref.self_match
+        if len(pod.affinity_refs) > s.affinity_refs:
+            raise ValueError(f"pod {pod.key}: too many affinity terms")
+        for j, ref in enumerate(pod.affinity_refs):
+            out["ipa_valid"][i, j] = True
+            out["ipa_tid"][i, j] = ref.tid
+            out["ipa_topo"][i, j] = ref.topo
+            out["ipa_required"][i, j] = ref.required
+            out["ipa_anti"][i, j] = ref.anti
+            out["ipa_weight"][i, j] = ref.weight
+            out["ipa_self"][i, j] = ref.self_match
+
+        if len(pod.spread_incs) > s.spread_incs:
+            raise ValueError(f"pod {pod.key}: too many spread increments")
+        for j, (cid, topo) in enumerate(pod.spread_incs):
+            out["sinc_valid"][i, j] = True
+            out["sinc_cid"][i, j] = cid
+            out["sinc_topo"][i, j] = topo
+        if len(pod.ipa_incs) > s.ipa_incs:
+            raise ValueError(f"pod {pod.key}: too many affinity increments")
+        for j, (tid, topo) in enumerate(pod.ipa_incs):
+            out["iinc_valid"][i, j] = True
+            out["iinc_tid"][i, j] = tid
+            out["iinc_topo"][i, j] = topo
 
     def _encode_exprs(self, qidx, i, j, exprs, expr_valid, qidx_arr, op, vals, num):
         s = self.spec

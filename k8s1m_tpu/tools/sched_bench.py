@@ -80,6 +80,13 @@ def parse_args(argv=None):
         "(drain/encode/device/sync_out/bind) to stderr",
     )
     ap.add_argument(
+        "--encode-profile", action="store_true",
+        help="add host-feed evidence to the report detail: host-encode "
+        "seconds by path (inline vs hidden in the feed worker), encode "
+        "template-cache hit rate, staged-batch use and stale-discard "
+        "counts (snapshot/hotfeed.py)",
+    )
+    ap.add_argument(
         "--depth", type=int, default=2,
         help="scheduling pipeline depth (in-flight waves; >2 helps when "
         "the device round trip dominates the wave, e.g. a remote relay)",
@@ -166,6 +173,42 @@ def offered_pods_at(args, t: float) -> float:
     if t > t2:
         total += args.rate * (t - t2)
     return total
+
+
+def _encode_profile_detail(enabled: bool) -> dict:
+    """Host-feed evidence for the report (empty unless --encode-profile)."""
+    if not enabled:
+        return {}
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    enc = REGISTRY.get("hotfeed_encode_seconds_total")
+    hits = REGISTRY.get("hotfeed_cache_hits_total").value()
+    misses = REGISTRY.get("hotfeed_cache_misses_total").value()
+    stale = REGISTRY.get("hotfeed_stale_batches_total")
+    cyc = REGISTRY.get("coordinator_cycle_seconds")
+    return {"encode_profile": {
+        # Worker-path seconds ran OFF the cycle critical path; the
+        # encode stage below is what the cycle actually waited on
+        # (claim hits make it ~the staged-batch handoff cost).
+        "host_encode_seconds": {
+            "inline": round(enc.value(path="inline"), 4),
+            "feed": round(enc.value(path="feed"), 4),
+        },
+        "encode_stage_seconds": round(cyc.sum(stage="encode"), 4),
+        "cache_hit_rate": (
+            round(hits / (hits + misses), 4) if hits + misses else None
+        ),
+        "staged_used": int(
+            REGISTRY.get("hotfeed_staged_used_total").value()
+        ),
+        "staged_stale": {
+            r: int(stale.value(reason=r))
+            for r in ("vocab", "reordered", "error")
+        },
+        "staged_depth": int(
+            REGISTRY.get("hotfeed_staged_depth").value()
+        ),
+    }}
 
 
 def _resilience_detail() -> dict:
@@ -620,6 +663,7 @@ def main(argv=None):
                     coord, quiesce_base, overlap_base, depth_samples,
                     node_churn,
                 ),
+                **_encode_profile_detail(args.encode_profile),
                 **_resilience_detail(),
             },
         }, args.out)
@@ -709,6 +753,7 @@ def main(argv=None):
             **_pipeline_detail(
                 coord, quiesce_base, overlap_base, depth_samples, node_churn,
             ),
+            **_encode_profile_detail(args.encode_profile),
             **_resilience_detail(),
         },
     }, args.out)

@@ -1,0 +1,7 @@
+"""Fixture: per-pod Python loop in a hotfeed-path file (violates
+hotfeed-no-per-pod-python and nothing else)."""
+
+
+def fill(out, pods):
+    for i, pod in enumerate(pods):
+        out["cpu"][i] = pod.cpu_milli

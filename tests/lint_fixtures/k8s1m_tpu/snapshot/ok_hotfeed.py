@@ -1,0 +1,8 @@
+"""Pragma twin of bad_hotfeed.py: the same per-pod loop, carrying the
+reason it is acceptable."""
+
+
+def fill(out, pods):
+    # graftlint: disable=hotfeed-no-per-pod-python (fixture: O(pods) dict bookkeeping only)
+    for i, pod in enumerate(pods):
+        out["cpu"][i] = pod.cpu_milli

@@ -40,6 +40,7 @@ EXPECTED = {
     "retry-through-policy": "k8s1m_tpu/tools/bad_retry.py",
     "broad-except": "k8s1m_tpu/store/bad_broad_except.py",
     "metrics-registry": "k8s1m_tpu/obs/bad_metrics.py",
+    "hotfeed-no-per-pod-python": "k8s1m_tpu/snapshot/bad_hotfeed.py",
 }
 
 

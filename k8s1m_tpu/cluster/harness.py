@@ -110,6 +110,11 @@ class ClusterSpec:
     )
     chunk: int = 1 << 10
     backend: str = "xla"
+    # Device-mesh execution (parallel/mesh.py): "DPxSP", "auto", or None
+    # (None defers to K8S1M_MESH; unset = single-device).  The mesh and
+    # the scheduler shard set are different scale-out axes — shard mode
+    # pins its members single-device (compose meshes across processes).
+    mesh: str | None = None
 
     def __post_init__(self):
         # Fail before any subprocess is spawned: a bad value raised from
@@ -126,6 +131,11 @@ class ClusterSpec:
             raise ValueError("tier_replicas must be >= 1")
         if self.tier_replicas > 1 and not self.watch_cache:
             raise ValueError("tier_replicas > 1 requires watch_cache=True")
+        if self.mesh and self.shards > 1:
+            raise ValueError(
+                "mesh and shards > 1 are different scale-out axes; "
+                "compose them across processes, not inside one spec"
+            )
 
     def table_spec(self) -> TableSpec:
         if self.table is not None:
@@ -278,6 +288,9 @@ class Cluster:
                     spec.profile, chunk=spec.chunk, backend=spec.backend,
                     with_constraints=spec.profile.topology_spread > 0
                     or spec.profile.interpod_affinity > 0,
+                    # Shard members scale out by row masks; "none" also
+                    # shuts out a K8S1M_MESH inherited from the rig env.
+                    mesh="none",
                 )
                 self.shard_members.append(
                     ShardMember(store, coord, i, spec.shards)
@@ -306,6 +319,9 @@ class Cluster:
                             backend=spec.backend,
                             with_constraints=spec.profile.topology_spread > 0
                             or spec.profile.interpod_affinity > 0,
+                            # spec.mesh ("DPxSP"/"auto"/None->K8S1M_MESH)
+                            # is the tfvars-level production-mesh switch.
+                            mesh=spec.mesh,
                         ),
                     )
                 )

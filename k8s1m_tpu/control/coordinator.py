@@ -124,6 +124,7 @@ from k8s1m_tpu.snapshot.hotfeed import (
     EncodeCache,
     HostFeed,
     HotPodBatchHost,
+    ShardedHostFeed,
     encode_batch,
 )
 from k8s1m_tpu.snapshot.node_table import (
@@ -211,6 +212,41 @@ _PIPE_OVERLAP = Counter(
 # Stages instrumented with the overlap split (drives the bench's
 # overlap-ratio report; keep in sync with _stage call sites).
 _OVERLAP_STAGES = ("drain", "encode", "sync", "sync_out", "bind")
+
+# ---- mesh execution (parallel/): the dp x sp sharded cycle ------------
+_MESH_DEVICES = Gauge(
+    "mesh_devices",
+    "Devices along each mesh axis across live mesh coordinators "
+    "(0 = every coordinator runs single-device)",
+    ("axis",),
+)
+for _axis in ("dp", "sp"):
+    _MESH_DEVICES.set_function(
+        lambda _a=_axis: sum(
+            c.mesh.shape[_a] for c in _LIVE if c.mesh is not None
+        ),
+        axis=_axis,
+    )
+_MESH_SCATTER = Counter(
+    "mesh_sharded_scatter_total",
+    "Dirty-row scatters dispatched against the sp-sharded device table, "
+    "by column class (full = host-authoritative row re-upload, cap = "
+    "capacity/feature columns only) — each one lands mid-flight with no "
+    "quiesce and no reshard (make_sharded_scatter pins the row sharding)",
+    ("cols",),
+)
+_MESH_FEED_DEPTH = Gauge(
+    "mesh_feed_staged_depth",
+    "Batches staged or encoding across per-dp-shard host feeds "
+    "(snapshot/hotfeed.ShardedHostFeed; up to dp per mesh coordinator)",
+    (),
+)
+_MESH_FEED_DEPTH.set_function(
+    lambda: sum(
+        c._feed.depth() for c in _LIVE
+        if isinstance(getattr(c, "_feed", None), ShardedHostFeed)
+    )
+)
 
 _BIND_LATENCY = Histogram(
     "coordinator_schedule_to_bind_seconds",
@@ -390,6 +426,17 @@ class Coordinator:
         # make_sharded_packed_step and percentageOfNodesToScore windows
         # rotate SHARD-LOCALLY (each device samples its own rows, like
         # each dist-scheduler replica samples the nodes it owns).
+        # ``mesh`` accepts a built jax Mesh, a spec string ("2x4",
+        # "auto", "none"), or None — which defers to the K8S1M_MESH env
+        # var (unset = single-device), so deployments flip the
+        # production path on without touching construction sites.
+        if mesh is None or isinstance(mesh, str):
+            from k8s1m_tpu.parallel.mesh import resolve_mesh
+
+            mesh = resolve_mesh(
+                mesh, batch=pod_spec.batch,
+                max_nodes=table_spec.max_nodes, chunk=chunk,
+            )
         self.mesh = mesh
         if mesh is not None:
             dp_size, sp_size = mesh.shape["dp"], mesh.shape["sp"]
@@ -456,13 +503,30 @@ class Coordinator:
         )
         if hotfeed is None:
             hotfeed = pipeline
-        self._feed = (
-            HostFeed(HotPodBatchHost(
+        dp_shards = self.mesh.shape["dp"] if self.mesh is not None else 1
+        if not hotfeed:
+            self._feed = None
+        elif dp_shards > 1:
+            # One HostFeed per dp shard: dp workers encode the wave's
+            # contiguous batch slices concurrently (sharing the one
+            # template cache) and claim() merges them byte-identically
+            # to the inline encode — the overlap survives sharding AND
+            # the fill parallelizes like the device work it hides behind.
+            self._feed = ShardedHostFeed([
+                HotPodBatchHost(
+                    dataclasses.replace(
+                        pod_spec, batch=pod_spec.batch // dp_shards
+                    ),
+                    table_spec, self.host.vocab,
+                    cache=self.encode_cache, path="feed",
+                )
+                for _ in range(dp_shards)
+            ])
+        else:
+            self._feed = HostFeed(HotPodBatchHost(
                 pod_spec, table_spec, self.host.vocab,
                 cache=self.encode_cache, path="feed",
             ))
-            if hotfeed else None
-        )
         if self._feed is not None:
             # A coordinator dropped without close() must not leak the
             # parked worker thread (the thread's bound target pins the
@@ -1184,6 +1248,8 @@ class Coordinator:
                 self._dirty_rows.clear()
                 delta = {c: getattr(h, c)[rows] for c in ALL_COLUMNS}
                 self.table = self._scatter(self.table, rows, delta)
+                if self.mesh is not None:
+                    _MESH_SCATTER.inc(cols="full")
             if self._dirty_caps:
                 rows = self._pad_rows(
                     np.fromiter(self._dirty_caps, np.int32)
@@ -1191,6 +1257,8 @@ class Coordinator:
                 self._dirty_caps.clear()
                 delta = {c: getattr(h, c)[rows] for c in CAP_COLUMNS}
                 self.table = self._scatter(self.table, rows, delta)
+                if self.mesh is not None:
+                    _MESH_SCATTER.inc(cols="cap")
 
     # ---- the cycle -----------------------------------------------------
 

@@ -269,11 +269,19 @@ def filter_score_topk(
     constraints: ConstraintState | None = None,
     stats=None,
     row_offset=0,
+    pod_offset=0,
 ) -> Candidates:
     """Stream the node table in chunks, keeping each pod's top-k candidates.
 
     ``row_offset`` biases emitted node rows — under shard_map each shard
-    passes its global row offset so candidate indices stay global.
+    passes its global row offset so candidate indices stay global.  It
+    also biases the tie-break hash's node coordinate, so a shard hashing
+    its local rows draws the SAME jitter a single device drew for those
+    global rows.  ``pod_offset`` does the same for the pod coordinate (a
+    dp shard passes its batch-block offset).  Together they make the
+    sharded cycle's priorities a pure function of (seed, global pod row,
+    global node row) — the byte-identity contract the mesh differential
+    gate rests on (tests/test_mesh_differential.py).
     """
     n = table.num_rows
     if n % chunk:
@@ -294,7 +302,7 @@ def filter_score_topk(
     # identical priorities for the same wave (and the counter-mode PRNG,
     # ~1.8s per [4096,16384] wave on XLA CPU, leaves the hot loop).
     seed = seed_of(key)
-    pod_rows = lax.broadcasted_iota(jnp.int32, (b, 1), 0)
+    pod_rows = lax.broadcasted_iota(jnp.int32, (b, 1), 0) + pod_offset
 
     def body(carry, _):
         carry, ci = carry
@@ -306,7 +314,8 @@ def filter_score_topk(
         )
         mask, score = score_and_filter(tchunk, batch, profile, cchunk, stats)
         node_cols = (
-            lax.broadcasted_iota(jnp.int32, (1, chunk), 1) + start
+            lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+            + start + row_offset
         )
         prio = pack_hashed(score, seed, mask, pod_rows, node_cols)
         top_prio, idx = chunk_topk(prio, k)                     # [B, k]

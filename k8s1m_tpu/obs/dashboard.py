@@ -42,6 +42,9 @@ ROWS = [
     # seconds by path, template-cache hit/miss, staged-batch use and the
     # stale-discard reasons.
     ("Host feed", ("hotfeed_",)),
+    # The dp x sp sharded execution path (parallel/): mesh axis sizes,
+    # sharded dirty-row scatters by column class, per-dp-shard feed depth.
+    ("Mesh (dp x sp sharded cycle)", ("mesh_",)),
     ("Overload control", ("loadshed_", "admission_", "breaker_",
                           "degraded_")),
     # Fault injection + the one shared RetryPolicy (k8s1m_tpu/faultline).

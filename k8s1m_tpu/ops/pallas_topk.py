@@ -58,6 +58,13 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Version skew: newer jax renamed TPUCompilerParams -> CompilerParams;
+# accept either so the kernel builds on current jax AND this
+# environment's 0.4.x (the virtual CPU mesh runs it interpreted).
+_COMPILER_PARAMS = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 from k8s1m_tpu.config import (
     EFFECT_NO_EXECUTE,
     EFFECT_NO_SCHEDULE,
@@ -143,7 +150,7 @@ def _kernel(
     with_cons: bool,
 ):
     """Base refs (always):
-        seed_ref   i32[1, 1] SMEM
+        seed_ref   i32[1, 3] SMEM — (seed, pod hash base, node hash base)
         cpu_alloc, mem_alloc, pods_alloc,
         cpu_req, mem_req, pods_req, name_id   i32[1, C]
         taint_id, taint_eff                    i32[TS, C]
@@ -530,9 +537,19 @@ def _kernel(
             score += jnp.floor(ipa_score).astype(jnp.int32) * w_ipa
 
     # ---- pack priority (ops/priority.py semantics, hash jitter).
+    # seed_ref[0, 1]/[0, 2] are the pod/node hash-coordinate bases: a
+    # mesh shard passes its global offsets so the jitter it draws for a
+    # (pod, node) pair is identical to what a single device draws for
+    # the same global pair (the sharded byte-identity contract).
     cols = lax.broadcasted_iota(jnp.int32, (tb, c), 1) + c_i * chunk
-    rows_n = lax.broadcasted_iota(jnp.int32, (tb, 1), 0) + b_i * tb
-    cols_n = lax.broadcasted_iota(jnp.int32, (1, c), 1) + c_i * chunk
+    rows_n = (
+        lax.broadcasted_iota(jnp.int32, (tb, 1), 0)
+        + b_i * tb + seed_ref[0, 1]
+    )
+    cols_n = (
+        lax.broadcasted_iota(jnp.int32, (1, c), 1)
+        + c_i * chunk + seed_ref[0, 2]
+    )
     jitter = _hash_jitter(seed_ref[0, 0], rows_n, cols_n)
     mask = fits & nn_ok & taint_ok & (p_valid[:] != 0)
     if with_aff:
@@ -642,12 +659,12 @@ def _call(
     out = pl.BlockSpec((tb, k), lambda bi, ci: (bi, 0), memory_space=pltpu.VMEM)
 
     in_specs = [
-        pl.BlockSpec((1, 1), lambda bi, ci: (0, 0), memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 3), lambda bi, ci: (0, 0), memory_space=pltpu.SMEM),
         col, col, col, col, col, col, col,
         taint, taint,
     ]
     args = [
-        seed.reshape(1, 1),
+        seed.reshape(1, 3),
         cpu_alloc.reshape(1, n), mem_alloc.reshape(1, n),
         pods_alloc.reshape(1, n),
         cpu_req.reshape(1, n), mem_req.reshape(1, n), pods_req.reshape(1, n),
@@ -722,7 +739,7 @@ def _call(
             pltpu.VMEM((tb, 128), jnp.int32),
             pltpu.VMEM((tb, 128), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             vmem_limit_bytes=100 * 1024 * 1024,
         ),
         interpret=interpret,
@@ -742,10 +759,17 @@ def fused_topk(
     constraints=None,
     stats=None,
     interpret: bool | None = None,
+    row_base=0,
+    col_base=0,
 ):
     """(idx i32[B,K], prio i32[B,K]) — global-row candidates, -1 = none.
 
     ``seed`` is an i32 scalar (fold the batch counter in host-side).
+    ``row_base``/``col_base`` bias the tie-break hash's pod/node
+    coordinates (traced i32 scalars): a mesh shard passes its global
+    batch-block and row offsets so its jitter stream matches the
+    single-device stream for the same global (pod, node) pair — the
+    sharded byte-identity contract (see engine.filter_score_topk).
     ``with_affinity=False`` compiles the cheaper base kernel for waves
     whose pods carry no selectors (the coordinator knows from the packed
     field groups); it changes cost, never semantics, for such waves.
@@ -846,7 +870,11 @@ def fused_topk(
     else:
         cons_args = ()
     return _call(
-        jnp.asarray(seed, jnp.int32),
+        jnp.stack([
+            jnp.asarray(seed, jnp.int32),
+            jnp.asarray(row_base, jnp.int32),
+            jnp.asarray(col_base, jnp.int32),
+        ]),
         table.cpu_alloc, table.mem_alloc, table.pods_alloc,
         table.cpu_req, table.mem_req, table.pods_req, table.name_id,
         jnp.transpose(table.taint_id), jnp.transpose(table.taint_effect),
@@ -881,6 +909,7 @@ def pallas_candidates(
     chunk: int,
     k: int,
     row_offset=0,
+    pod_offset=0,
     with_affinity: bool = True,
     constraints=None,
     stats=None,
@@ -891,6 +920,9 @@ def pallas_candidates(
     Returns engine.cycle.Candidates with the same payload columns (free
     capacity + topology domains gathered at the candidate rows).
     ``constraints``/``stats`` run the stateful plugins fused (fused_topk).
+    ``row_offset``/``pod_offset`` follow filter_score_topk's contract:
+    they globalize the emitted rows AND the tie-break hash coordinates,
+    keeping mesh shards bit-identical to the single-device stream.
     """
     from k8s1m_tpu.engine.cycle import Candidates
 
@@ -898,6 +930,7 @@ def pallas_candidates(
         table, batch, seed_of(key), profile,
         chunk=chunk, k=k, with_affinity=with_affinity,
         constraints=constraints, stats=stats, interpret=interpret,
+        row_base=pod_offset, col_base=row_offset,
     )
     safe = jnp.clip(idx, 0)
     free_cpu, free_mem, free_pods = table.free()

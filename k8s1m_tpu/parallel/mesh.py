@@ -22,12 +22,23 @@ key) is replicated everywhere.
 
 from __future__ import annotations
 
+import logging
+import os
+
 import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from k8s1m_tpu.snapshot.node_table import NodeTable
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+log = logging.getLogger("k8s1m.mesh")
+
+# Production mesh selection (the tfvars-level knob): "DPxSP" (also
+# accepts "DP,SP"), "auto" (largest valid dp x sp over the visible
+# devices), or "none"/"" (single-device).  Read by Coordinator when no
+# explicit mesh is passed, and inherited by every tool that builds one.
+MESH_ENV = "K8S1M_MESH"
 
 
 def make_mesh(dp: int, sp: int, devices=None) -> jax.sharding.Mesh:
@@ -37,6 +48,85 @@ def make_mesh(dp: int, sp: int, devices=None) -> jax.sharding.Mesh:
         raise ValueError(f"mesh {dp}x{sp} needs {dp*sp} devices, have {len(devices)}")
     arr = np.asarray(devices[: dp * sp]).reshape(dp, sp)
     return jax.sharding.Mesh(arr, ("dp", "sp"))
+
+
+def parse_mesh(s: str | None):
+    """"DPxSP"/"DP,SP" -> (dp, sp); "auto" -> "auto"; "none"/""/None -> None."""
+    if s is None:
+        return None
+    s = s.strip().lower()
+    if s in ("", "none", "0", "off"):
+        return None
+    if s == "auto":
+        return "auto"
+    for sep in ("x", ","):
+        if sep in s:
+            dp_s, sp_s = s.split(sep, 1)
+            dp, sp = int(dp_s), int(sp_s)
+            if dp < 1 or sp < 1:
+                raise ValueError(f"mesh axes must be >= 1, got {s!r}")
+            return dp, sp
+    raise ValueError(f"mesh spec {s!r} is not DPxSP, DP,SP, auto, or none")
+
+
+def auto_mesh_shape(
+    n_devices: int, *, batch: int, max_nodes: int, chunk: int
+) -> tuple[int, int] | None:
+    """Largest valid (dp, sp) split of ``n_devices`` for this workload.
+
+    Validity is the coordinator's own divisibility contract: rows shard
+    evenly over sp in chunk-aligned blocks (max_nodes % sp == 0 and
+    rows-per-shard % chunk == 0) and the pod batch shards evenly over dp.
+    Preference order: use every device, and give ``sp`` the larger axis —
+    the node table is the only large resident, and sp is the axis whose
+    all-gather must stay cheap (parallel/multihost.py's placement note).
+    Returns None when no split beats single-device.
+    """
+    for total in range(n_devices, 1, -1):
+        for sp in range(total, 0, -1):
+            if total % sp:
+                continue
+            dp = total // sp
+            if max_nodes % sp or (max_nodes // sp) % chunk or batch % dp:
+                continue
+            return dp, sp
+    return None
+
+
+def resolve_mesh(
+    mesh, *, batch: int, max_nodes: int, chunk: int, env=None
+):
+    """The coordinator's mesh-selection funnel.
+
+    ``mesh`` may be an already-built jax Mesh (returned as-is), a spec
+    string ("DPxSP", "auto", "none"), or None — in which case the
+    ``K8S1M_MESH`` env var decides (unset = single-device, so nothing
+    changes for callers that never asked for a mesh).  "auto" picks the
+    largest workload-valid dp x sp over the visible devices and falls
+    back to single-device (with a log line saying why) when none fits —
+    the single-device fallback story documented in README "Sharded
+    execution"."""
+    if mesh is None or isinstance(mesh, str):
+        spec = mesh if isinstance(mesh, str) else (
+            (env if env is not None else os.environ).get(MESH_ENV)
+        )
+        shape = parse_mesh(spec)
+        if shape is None:
+            return None
+        if shape == "auto":
+            n = len(jax.devices())
+            shape = auto_mesh_shape(
+                n, batch=batch, max_nodes=max_nodes, chunk=chunk
+            )
+            if shape is None:
+                log.info(
+                    "mesh auto: no dp x sp split of %d devices fits "
+                    "batch=%d max_nodes=%d chunk=%d; running single-device",
+                    n, batch, max_nodes, chunk,
+                )
+                return None
+        mesh = make_mesh(*shape)
+    return mesh
 
 
 def table_specs(table: NodeTable) -> NodeTable:

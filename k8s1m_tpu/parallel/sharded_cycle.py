@@ -20,6 +20,17 @@ Dataflow per cycle (replacing reference SURVEY.md §3.2's process hops):
 Total ICI traffic per cycle is O(B * K) candidate records — independent
 of node count; the reference moves O(shards) gRPC messages per pod.
 
+Byte-identity contract: every device uses the SAME per-wave PRNG seed
+and hashes tie-break jitter over GLOBAL (pod row, node row) coordinates
+(mesh_offsets), per-shard top-k lists keep ties in ascending-global-row
+order, and the sp/dp gathers concatenate shard-major — so the merged
+candidate lists, the replicated conflict scan, and the bind rows are
+bit-identical to the single-device cycle for the same wave.  This is
+what lets the coordinator promote the mesh to the production execution
+path with a differential gate instead of a statistical one
+(tests/test_mesh_differential.py; sampled windows are the one
+exception — they rotate SHARD-locally by design).
+
 Pipelined snapshot mutation: the coordinator's dirty-row scatters
 (make_sharded_scatter) consume the *latest* table future, so they are
 stream-ordered after every dispatched wave by data dependency — a
@@ -52,6 +63,30 @@ from k8s1m_tpu.snapshot.node_table import NodeTable, scatter_rows
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the installed-version API skew.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=)``; 0.4.x only has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=)``.  Both
+    flags gate the same replication/varying-manual-axes check, which the
+    scheduling step disables (the epilogue's replicated conflict scan is
+    replicated by construction, not by inference).  Routing through this
+    shim is what lets the same mesh code drive a TPU pod on current jax
+    AND the 8-device virtual CPU mesh this environment's jax hosts.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 def make_sharded_scatter(table_sharding):
     """Dirty-row scatter pinned to the table's row sharding — the mesh
     form of the coordinator's jitted snapshot.node_table.scatter_rows.
@@ -61,12 +96,21 @@ def make_sharded_scatter(table_sharding):
     return jax.jit(scatter_rows, out_shardings=table_sharding)
 
 
-def fold_mesh_key(key):
-    """Per-device PRNG key: tie-break jitter decorrelated across both
-    mesh axes (call inside shard_map)."""
-    sp = lax.axis_index("sp")
-    dp = lax.axis_index("dp")
-    return jax.random.fold_in(jax.random.fold_in(key, sp), dp)
+def mesh_offsets(table, b_local: int):
+    """(pod_offset, row_offset) for this device (call inside shard_map).
+
+    The tie-break hash is a pure function of (seed, global pod row,
+    global node row) — ops/priority.hash_jitter over GLOBAL coordinates
+    with the SAME per-wave seed on every device.  A dp shard therefore
+    passes its batch-block offset and an sp shard its row offset, and
+    the priorities each shard computes are bit-identical to the slice a
+    single device would compute: the sharded cycle is byte-identical to
+    the single-device cycle, bind for bind (the mesh differential gate,
+    tests/test_mesh_differential.py).  Earlier revisions folded the mesh
+    coordinates into the PRNG key instead, which decorrelated tie-breaks
+    across shards and made the mesh path only statistically equivalent.
+    """
+    return lax.axis_index("dp") * b_local, lax.axis_index("sp") * table.num_rows
 
 
 def gather_and_finalize(table, batch, cand, constraints, *, k: int):
@@ -121,30 +165,30 @@ def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int):
 
     def _local_step(table: NodeTable, batch: PodBatch, key: jax.Array,
                     constraints: ConstraintState | None = None):
-        row_offset = lax.axis_index("sp") * table.num_rows
+        pod_offset, row_offset = mesh_offsets(table, batch.batch)
 
         stats = (
             topology.prologue(table, constraints, axis_name="sp")
             if constraints is not None else None
         )
 
-        # Local filter+score+top-k over this device's block.
+        # Local filter+score+top-k over this device's block — same key
+        # on every device, global hash coordinates (see mesh_offsets).
         cand = filter_score_topk(
-            table, batch, fold_mesh_key(key), profile,
+            table, batch, key, profile,
             chunk=chunk, k=k, constraints=constraints, stats=stats,
-            row_offset=row_offset,
+            row_offset=row_offset, pod_offset=pod_offset,
         )
         return gather_and_finalize(table, batch, cand, constraints, k=k)
 
     def step(table, batch, key, constraints=None):
         asg_specs = Assignment(P(), P(), P(), P(), P())
         cons_specs = constraint_specs(constraints) if constraints is not None else None
-        return jax.shard_map(
+        return shard_map_compat(
             _local_step,
             mesh=mesh,
             in_specs=(table_specs(table), batch_specs(batch), P(), cons_specs),
             out_specs=(table_specs(table), cons_specs, asg_specs),
-            check_vma=False,
         )(table, batch, key, constraints)
 
     return jax.jit(step)
@@ -209,8 +253,8 @@ def make_sharded_packed_step(
     aff = bool(groups & {"sel", "req", "pref"})
 
     def _local_step(table, ints, bools, key, offset, constraints=None):
+        pod_offset, row_offset = mesh_offsets(table, b_local)
         dp = lax.axis_index("dp")
-        row_offset = lax.axis_index("sp") * table.num_rows
 
         full = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
         batch = jax.tree.map(
@@ -225,7 +269,6 @@ def make_sharded_packed_step(
             topology.prologue(table, constraints, axis_name="sp")
             if constraints is not None else None
         )
-        local_key = fold_mesh_key(key)
 
         if sample_rows is None:
             view, view_cons, view_off = table, constraints, row_offset
@@ -240,20 +283,22 @@ def make_sharded_packed_step(
             )
             view_off = row_offset + offset
 
+        # Same key on every device; the tie-break jitter globalizes via
+        # the (pod_offset, view_off) hash bases instead (mesh_offsets) —
+        # an unsampled wave is byte-identical to the single-device wave.
         if backend == "pallas":
             from k8s1m_tpu.ops.pallas_topk import pallas_candidates
 
             cand = pallas_candidates(
-                view, batch, local_key, profile, chunk=chunk, k=k,
+                view, batch, key, profile, chunk=chunk, k=k,
+                row_offset=view_off, pod_offset=pod_offset,
                 with_affinity=aff, constraints=view_cons, stats=stats,
-            )
-            cand = cand.replace(
-                idx=jnp.where(cand.idx >= 0, cand.idx + view_off, -1)
             )
         else:
             cand = filter_score_topk(
-                view, batch, local_key, profile, chunk=chunk, k=k,
-                constraints=view_cons, stats=stats, row_offset=view_off,
+                view, batch, key, profile, chunk=chunk, k=k,
+                constraints=view_cons, stats=stats,
+                row_offset=view_off, pod_offset=pod_offset,
             )
 
         table, cons, asg = gather_and_finalize(
@@ -268,20 +313,18 @@ def make_sharded_packed_step(
             constraint_specs(constraints) if constraints is not None else None
         )
         if constraints is not None:
-            fn = jax.shard_map(
+            fn = shard_map_compat(
                 _local_step,
                 mesh=mesh,
                 in_specs=(table_specs(table), P(), P(), P(), P(), cons_specs),
                 out_specs=(table_specs(table), cons_specs, asg_specs, P()),
-                check_vma=False,
             )
             return fn(table, ints, bools, key, offset, constraints)
-        fn = jax.shard_map(
+        fn = shard_map_compat(
             lambda t, i, bl, kk, off: _local_step(t, i, bl, kk, off, None),
             mesh=mesh,
             in_specs=(table_specs(table), P(), P(), P(), P()),
             out_specs=(table_specs(table), None, asg_specs, P()),
-            check_vma=False,
         )
         return fn(table, ints, bools, key, offset)
 

@@ -109,7 +109,8 @@ _STALE = Counter(
     "hotfeed_stale_batches_total",
     "Pre-staged batches discarded at claim time, by reason (vocab = "
     "interning moved between staging and dispatch; reordered = the "
-    "queue prefix changed; error = the worker encode raised)",
+    "queue prefix changed; error = the worker encode raised; merge = "
+    "dp-shard sub-batches could not merge, e.g. a query-key overflow)",
     ("reason",),
 )
 _STAGED_DEPTH = Gauge(
@@ -584,40 +585,148 @@ class HotPodBatchHost(PodBatchHost):
         if groups & {"sel", "req", "pref"}:
             groups.add("qkey")
         groups = frozenset(groups)
-        int_parts, bool_parts = [], []
-        for name, is_bool, shape in specs:
-            g = _GROUP_OF.get(name)
-            if g is not None and g not in groups:
-                continue
-            (bool_parts if is_bool else int_parts).append(out[name].ravel())
-        ints = (
-            np.concatenate(int_parts) if int_parts else np.zeros(0, np.int32)
-        )
-        bools = (
-            np.concatenate(bool_parts) if bool_parts else np.zeros(0, np.bool_)
-        )
         # fields as views into the packed buffers: valid after the arena
         # is recycled by the next encode (CAS rollback reads them a wave
         # or more later), at zero copy cost — the buffers are fresh.
-        fields: dict[str, np.ndarray] = {}
-        io = bo = 0
-        for name, is_bool, shape in specs:
-            g = _GROUP_OF.get(name)
-            if g is not None and g not in groups:
-                fields[name] = self._zero_view(name, is_bool, shape)
-                continue
-            size = math.prod(shape)
-            if is_bool:
-                fields[name] = bools[bo : bo + size].reshape(shape)
-                bo += size
-            else:
-                fields[name] = ints[io : io + size].reshape(shape)
-                io += size
+        ints, bools, fields = _pack_buffers(
+            specs, groups, out, self._zero_view
+        )
         _ENCODE_SECONDS.inc(time.perf_counter() - t0, path=self._path)
         return PackedPodBatch(
             ints, bools, fields, self.spec, self.table_spec, groups,
             vocab_gen=self._last_gen,
         )
+
+
+def _pack_buffers(specs, groups: frozenset, out: dict, zero_view):
+    """Flatten included-group field arrays into the two packed buffers
+    and rebuild the field dict as views into them — the one packing body
+    shared by the arena encode and the dp-shard merge."""
+    int_parts, bool_parts = [], []
+    for name, is_bool, _shape in specs:
+        g = _GROUP_OF.get(name)
+        if g is not None and g not in groups:
+            continue
+        (bool_parts if is_bool else int_parts).append(out[name].ravel())
+    ints = (
+        np.concatenate(int_parts) if int_parts else np.zeros(0, np.int32)
+    )
+    bools = (
+        np.concatenate(bool_parts) if bool_parts else np.zeros(0, np.bool_)
+    )
+    fields: dict[str, np.ndarray] = {}
+    io = bo = 0
+    for name, is_bool, shape in specs:
+        g = _GROUP_OF.get(name)
+        if g is not None and g not in groups:
+            fields[name] = zero_view(name, is_bool, shape)
+            continue
+        size = math.prod(shape)
+        if is_bool:
+            fields[name] = bools[bo : bo + size].reshape(shape)
+            bo += size
+        else:
+            fields[name] = ints[io : io + size].reshape(shape)
+            io += size
+    return ints, bools, fields
+
+
+# Read-only zeros for merge_packed's excluded groups (the standalone
+# counterpart of HotPodBatchHost._zero_view; shapes are spec-derived so
+# the cache stays tiny).
+_MERGE_ZEROS: dict = {}
+
+
+def _merge_zero_view(name, is_bool, shape) -> np.ndarray:
+    z = _MERGE_ZEROS.get((name, shape))
+    if z is None:
+        z = np.zeros(shape, np.bool_ if is_bool else np.int32)
+        z.flags.writeable = False
+        _MERGE_ZEROS[(name, shape)] = z
+    return z
+
+
+def merge_packed(parts: list[PackedPodBatch]) -> PackedPodBatch | None:
+    """Concatenate dp contiguous sub-batches into one full-batch
+    ``PackedPodBatch``, or None when they cannot merge (merged query
+    keys overflow ``PodSpec.query_keys``, or the parts were encoded
+    against different vocab generations).
+
+    Byte-identity: each sub-batch's per-batch query-key table lists its
+    distinct selector keys in first-encounter order, and a key's first
+    reference across the FULL batch always happens in the earliest
+    sub-batch referencing it — so replaying the sub-tables in dp order
+    rebuilds exactly the slot assignment a single full-batch encode
+    produces, and the merged buffers are byte-identical to encoding the
+    concatenated pod list inline (tests/test_mesh_differential.py).
+    The one exception: a never-interned selector key encodes as NONE_ID,
+    which two sub-batches cannot distinguish from each other's unknown
+    keys — those slots merge by id, which the device cannot tell apart
+    either (both query an id no node carries).
+    """
+    first = parts[0]
+    b_total = sum(p.spec.batch for p in parts)
+    mspec = dataclasses.replace(first.spec, batch=b_total)
+    for p in parts[1:]:
+        if (
+            dataclasses.replace(p.spec, batch=0)
+            != dataclasses.replace(first.spec, batch=0)
+            or p.table_spec != first.table_spec
+        ):
+            raise ValueError("merge_packed parts disagree on specs")
+    gens = {p.vocab_gen for p in parts if p.vocab_gen is not None}
+    if len(gens) > 1:
+        return None
+    groups = frozenset().union(*(p.groups for p in parts))
+
+    # Merged query-key table + per-part slot permutations (slot 0 stays
+    # the reserved NONE slot everywhere).
+    qkey = np.zeros((mspec.query_keys,), np.int32)
+    slot_of: dict[int, int] = {}
+    next_slot = 1
+    perms = []
+    for p in parts:
+        used = 0
+        for name in _QIDX_FIELDS:
+            if _GROUP_OF[name] in p.groups:
+                used = max(used, int(p.fields[name].max()))
+        perm = np.zeros((used + 1,), np.int32)
+        tbl = p.fields["qkey"]
+        for local in range(1, used + 1):
+            kid = int(tbl[local])
+            slot = slot_of.get(kid) if kid != 0 else None
+            if slot is None:
+                if next_slot >= mspec.query_keys:
+                    return None      # caller falls back to inline encode
+                slot = next_slot
+                next_slot += 1
+                qkey[slot] = kid
+                if kid != 0:
+                    slot_of[kid] = slot
+            perm[local] = slot
+        perms.append(perm)
+
+    specs = batch_field_specs(mspec, first.table_spec)
+    merged: dict[str, np.ndarray] = {}
+    for name, is_bool, shape in specs:
+        g = _GROUP_OF.get(name)
+        if g is not None and g not in groups:
+            continue
+        if name == "qkey":
+            merged[name] = qkey
+        elif name in _QIDX_FIELDS:
+            merged[name] = np.concatenate(
+                [perm[p.fields[name]] for perm, p in zip(perms, parts)]
+            )
+        else:
+            merged[name] = np.concatenate([p.fields[name] for p in parts])
+    ints, bools, fields = _pack_buffers(
+        specs, groups, merged, _merge_zero_view
+    )
+    return PackedPodBatch(
+        ints, bools, fields, mspec, first.table_spec, groups,
+        vocab_gen=gens.pop() if gens else None,
+    )
 
 
 def encode_batch(enc: PodBatchHost, batch_pods, *, mutate: bool = True):
@@ -701,13 +810,19 @@ class HostFeed:
         unless a full batch is available and the feed is idle."""
         if len(queue) < batch:
             return False
+        return self.stage_pods(list(itertools.islice(queue, batch)))
+
+    def stage_pods(self, pods: list) -> bool:
+        """Submit an already-peeked pod list for background encode (the
+        sharded feed's per-dp-slice entry point).  The list must remain
+        a queue prefix snapshot — claim()'s identity check enforces it."""
         with self._lock:
             if (
                 self._closed
                 or self._req is not None or self._staged is not None
             ):
                 return False
-            self._req = list(itertools.islice(queue, batch))
+            self._req = pods
             self._cond.notify_all()
         return True
 
@@ -776,3 +891,76 @@ class HostFeed:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout=5.0)
+
+
+class ShardedHostFeed:
+    """One ``HostFeed`` per dp shard: the mesh coordinator's overlapped
+    encode, parallelized the same way the device work is.
+
+    A (dp, sp) mesh shards the pod batch over dp; the host encode was
+    still one serial worker filling the whole wave.  This feed peeks the
+    same full-batch queue prefix, splits it into dp contiguous slices,
+    and lets dp workers (one per shard, each with its own arena, all
+    sharing the coordinator's EncodeCache) encode concurrently; claim
+    verifies every slice exactly like the single feed (prefix identity +
+    vocab generation, fail closed) and merges the sub-batches into one
+    full-batch ``PackedPodBatch`` byte-identical to the inline encode
+    (merge_packed).  A merge that cannot be trusted — query-key overflow
+    across slices, mixed generations — counts
+    ``hotfeed_stale_batches_total{reason="merge"}`` and the caller
+    encodes inline, the same fail-closed contract as the single feed.
+
+    No own locked state: the sub-feeds carry the ``@guarded_by``
+    discipline, and this wrapper only ever runs on the cycle thread.
+    """
+
+    def __init__(self, encoders: list[HotPodBatchHost], name: str = "hotfeed"):
+        if not encoders:
+            raise ValueError("ShardedHostFeed needs >= 1 encoder")
+        self._b_local = encoders[0].spec.batch
+        self.feeds = [
+            HostFeed(enc, name=f"{name}-dp{i}")
+            for i, enc in enumerate(encoders)
+        ]
+
+    def depth(self) -> int:
+        return sum(f.depth() for f in self.feeds)
+
+    def depths(self) -> list[int]:
+        """Per-dp-shard staged depth (sched_bench's mesh report)."""
+        return [f.depth() for f in self.feeds]
+
+    def ready(self) -> bool:
+        return all(f.ready() for f in self.feeds)
+
+    def stage(self, queue, batch: int) -> bool:
+        if batch != self._b_local * len(self.feeds) or len(queue) < batch:
+            return False
+        if any(f.depth() for f in self.feeds):
+            return False
+        peeked = list(itertools.islice(queue, batch))
+        b = self._b_local
+        for i, f in enumerate(self.feeds):
+            f.stage_pods(peeked[i * b : (i + 1) * b])
+        return True
+
+    def claim(self, batch_pods: list, generation: int):
+        """The merged staged batch for exactly ``batch_pods``, or None.
+        Every sub-feed is claimed regardless (staged state must drain
+        even when one slice went stale, or the feeds would wedge)."""
+        b = self._b_local
+        parts = [
+            f.claim(batch_pods[i * b : (i + 1) * b], generation)
+            for i, f in enumerate(self.feeds)
+        ]
+        if any(p is None for p in parts):
+            return None
+        merged = merge_packed(parts)
+        if merged is None:
+            _STALE.inc(reason="merge")
+            return None
+        return merged
+
+    def close(self) -> None:
+        for f in self.feeds:
+            f.close()

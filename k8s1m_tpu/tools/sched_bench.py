@@ -123,12 +123,16 @@ def parse_args(argv=None):
         "host or the stressor starves the scheduler it is stressing)",
     )
     ap.add_argument(
-        "--mesh", default=None, metavar="DP,SP",
+        "--mesh", default=None, metavar="DPxSP",
         help="drive the wave through the sharded step over a dp x sp "
         "device mesh (parallel/sharded_cycle.make_sharded_packed_step) — "
         "the reference's multi-replica fan-out as mesh devices.  "
-        "Requires dp*sp <= len(jax.devices()); on one chip use 1,1; on "
-        "a v5e-8 use e.g. 1,8 or 2,4.",
+        "Accepts DPxSP or DP,SP (dp*sp <= len(jax.devices())), or "
+        "'auto' (largest workload-valid split).  Unset defers to "
+        "K8S1M_MESH; the sharded run is byte-identical to single-device "
+        "at score-pct 100, so every churn/overload/encode-profile lane "
+        "composes with it.  Mesh evidence (per-shard staged feed depth, "
+        "sharded-scatter counts) lands in the report detail.",
     )
     ap.add_argument(
         "--profile", metavar="PATH", default=None,
@@ -203,7 +207,7 @@ def _encode_profile_detail(enabled: bool) -> dict:
         ),
         "staged_stale": {
             r: int(stale.value(reason=r))
-            for r in ("vocab", "reordered", "error")
+            for r in ("vocab", "reordered", "error", "merge")
         },
         "staged_depth": int(
             REGISTRY.get("hotfeed_staged_depth").value()
@@ -312,6 +316,41 @@ def _pipeline_detail(
             hid / (hid + exposed), 4
         ) if hid + exposed else None,
     }
+
+
+def _mesh_detail(coord, feed_depth_samples) -> dict:
+    """dp x sp execution evidence for the report (empty when the run is
+    single-device): axis sizes, sharded dirty-row scatter counts, and
+    per-dp-shard staged feed depth sampled while the producer was live."""
+    if coord.mesh is None:
+        return {}
+    import numpy as np
+
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    sc = REGISTRY.get("mesh_sharded_scatter_total")
+    detail = {
+        "dp": int(coord.mesh.shape["dp"]),
+        "sp": int(coord.mesh.shape["sp"]),
+        "sharded_scatters": {
+            c: int(sc.value(cols=c)) for c in ("full", "cap")
+        },
+    }
+    if feed_depth_samples:
+        per_shard = np.asarray(feed_depth_samples)   # [samples, dp]
+        detail["feed_staged_depth_per_shard"] = {
+            "max": per_shard.max(axis=0).tolist(),
+            "mean": [round(v, 3) for v in per_shard.mean(axis=0)],
+        }
+    return {"mesh_exec": detail}
+
+
+def _sample_mesh_feed(coord, feed_depth_samples) -> None:
+    from k8s1m_tpu.snapshot.hotfeed import ShardedHostFeed
+
+    feed = getattr(coord, "_feed", None)
+    if isinstance(feed, ShardedHostFeed):
+        feed_depth_samples.append(feed.depths())
 
 
 def _pipeline_window_start(coord, store, args):
@@ -484,21 +523,27 @@ def main(argv=None):
     # The chunked scan needs chunk <= table rows (both powers of two
     # here); the per-backend default assumes a big table.
     args.chunk = min(args.chunk, cap)
-    mesh = None
-    if args.mesh:
-        from k8s1m_tpu.parallel import make_mesh
+    from k8s1m_tpu.parallel import resolve_mesh
 
-        dp, sp = (int(x) for x in args.mesh.split(","))
-        mesh = make_mesh(dp=dp, sp=sp)
+    # One resolve here (explicit --mesh, or K8S1M_MESH when unset) so
+    # the chunk clamp below applies however the mesh was selected, and
+    # an explicit `--mesh none` really opts out even under a rig env
+    # that exports K8S1M_MESH.
+    mesh = resolve_mesh(
+        args.mesh, batch=args.batch, max_nodes=cap, chunk=args.chunk
+    )
+    if mesh is not None:
         # The chunked scan runs per shard; clamp to the shard's rows.
-        args.chunk = min(args.chunk, cap // sp)
+        args.chunk = min(args.chunk, cap // mesh.shape["sp"])
     profile = Profile(node_affinity=0, topology_spread=0, interpod_affinity=0)
     coord = Coordinator(
         store, TableSpec(max_nodes=cap), PodSpec(batch=args.batch),
         profile, chunk=args.chunk, with_constraints=False,
         backend=args.backend, pipeline=not args.no_pipeline, depth=args.depth,
         score_pct=args.score_pct, adaptive_batch=bool(args.rate),
-        mesh=mesh,
+        # Already resolved above (env included): a built Mesh, or
+        # "none" so the Coordinator does NOT re-read K8S1M_MESH.
+        mesh=mesh if mesh is not None else "none",
     )
     t0 = time.perf_counter()
     coord.bootstrap()
@@ -579,6 +624,7 @@ def main(argv=None):
         quiesce_base, overlap_base, depth_samples, node_churn = (
             _pipeline_window_start(coord, store, args)
         )
+        feed_depth_samples: list = []
         t0 = time.perf_counter()
         bound = 0
         emitted = 1
@@ -616,6 +662,7 @@ def main(argv=None):
                     # Depth evidence only while the producer is live —
                     # the tail drain legitimately winds the pipeline down.
                     depth_samples.append(len(coord._inflights))
+                    _sample_mesh_feed(coord, feed_depth_samples)
                 if (
                     emitted >= args.pods
                     and not coord.queue
@@ -663,6 +710,7 @@ def main(argv=None):
                     coord, quiesce_base, overlap_base, depth_samples,
                     node_churn,
                 ),
+                **_mesh_detail(coord, feed_depth_samples),
                 **_encode_profile_detail(args.encode_profile),
                 **_resilience_detail(),
             },
@@ -675,6 +723,7 @@ def main(argv=None):
     quiesce_base, overlap_base, depth_samples, node_churn = (
         _pipeline_window_start(coord, store, args)
     )
+    feed_depth_samples: list = []
     t0 = time.perf_counter()
     bound = 0
     off = 1
@@ -699,6 +748,7 @@ def main(argv=None):
             bound += coord.step()
             if off < args.pods:
                 depth_samples.append(len(coord._inflights))
+                _sample_mesh_feed(coord, feed_depth_samples)
         if args.churn:
             # Drain with the frontier still advancing (same lag): on CPU
             # most binds land here, after the producer finished, and the
@@ -753,6 +803,7 @@ def main(argv=None):
             **_pipeline_detail(
                 coord, quiesce_base, overlap_base, depth_samples, node_churn,
             ),
+            **_mesh_detail(coord, feed_depth_samples),
             **_encode_profile_detail(args.encode_profile),
             **_resilience_detail(),
         },

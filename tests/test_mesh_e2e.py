@@ -68,17 +68,20 @@ def test_sharded_packed_matches_single_device():
         profile=PROFILE, chunk=16, k=4, mesh=mesh,
     )
     np.testing.assert_array_equal(np.asarray(a1.bound), np.asarray(a2.bound))
-    # Tie-break jitter is decorrelated per device; scores may cascade ±1.
-    np.testing.assert_allclose(
-        np.asarray(a1.score), np.asarray(a2.score), atol=1
+    # Byte-identity contract: same seed + global hash coordinates make
+    # the mesh step bit-equal to the single-device step, ties included.
+    np.testing.assert_array_equal(np.asarray(a1.score), np.asarray(a2.score))
+    np.testing.assert_array_equal(np.asarray(rows1), np.asarray(rows2))
+    np.testing.assert_array_equal(
+        np.asarray(t1.cpu_req), np.asarray(t2.cpu_req)
     )
-    assert int(t1.cpu_req.sum()) == int(np.asarray(t2.cpu_req).sum())
-    assert int(t1.pods_req.sum()) == int(np.asarray(t2.pods_req).sum())
+    np.testing.assert_array_equal(
+        np.asarray(t1.pods_req), np.asarray(t2.pods_req)
+    )
     # The packed result array agrees with the assignment on both paths.
     np.testing.assert_array_equal(
         np.asarray(rows2) >= 0, np.asarray(a2.bound)
     )
-    assert np.asarray(rows1).shape == np.asarray(rows2).shape
 
 
 def test_sharded_packed_sampled_window():
@@ -172,12 +175,17 @@ def test_coordinator_mesh_delete_frees_capacity(store):
 
 def test_coordinator_mesh_sampled_matches_full(store):
     """score_pct<100 over the mesh still binds everything (windows
-    rotate shard-locally until every row has been offered)."""
+    rotate shard-locally until every row has been offered).  The 8
+    nodes sit in the first 8 of shard 0's 32 rows, so half the rotating
+    windows are empty — retries must survive enough empty-window waves
+    to meet a populated one (max_attempts is raised accordingly: an
+    empty window consumes an attempt, and which waves a retrying pod
+    re-enters depends on backoff timing)."""
     for i in range(8):
         put_node(store, f"n{i}")
     for i in range(64):
         put_pod(store, f"p{i}")
-    coord = make_mesh_coord(store, score_pct=50)
+    coord = make_mesh_coord(store, score_pct=50, max_attempts=16)
     coord.bootstrap()
     assert coord.run_until_idle() == 64
 

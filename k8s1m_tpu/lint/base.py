@@ -167,6 +167,18 @@ def suppressed(f: SourceFile, finding: Finding) -> bool:
 # -- small AST helpers shared by rules ----------------------------------
 
 
+def call_name(call: ast.Call) -> str | None:
+    """The simple callee name of a call: 'f' for ``f(...)`` and for
+    ``a.b.f(...)`` alike, else None.  The one resolution rule every
+    pass shares — keep refinements here, not in per-rule copies."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
 def dotted_name(node: ast.AST) -> str | None:
     """'a.b.c' for a Name/Attribute chain, else None."""
     parts: list[str] = []
@@ -179,15 +191,19 @@ def dotted_name(node: ast.AST) -> str | None:
     return None
 
 
-def walk_no_nested_functions(node: ast.AST):
+def walk_no_nested_functions(node: ast.AST, *, descend_lambdas: bool = False):
     """Yield nodes in ``node``'s body without descending into nested
-    function/class definitions (their bodies run in another scope/time)."""
+    function/class definitions (their bodies run in another scope/time).
+    ``descend_lambdas=True`` still walks lambda bodies — for passes
+    whose property (e.g. value purity) holds across the lambda boundary
+    even though the lambda runs later."""
+    skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    if not descend_lambdas:
+        skip = skip + (ast.Lambda,)
     stack = list(ast.iter_child_nodes(node))
     while stack:
         n = stack.pop()
         yield n
-        if isinstance(
-            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
-        ):
+        if isinstance(n, skip):
             continue
         stack.extend(ast.iter_child_nodes(n))

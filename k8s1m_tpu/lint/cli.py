@@ -4,15 +4,26 @@
     python -m k8s1m_tpu.lint --check-baseline  # also fail on stale entries
     python -m k8s1m_tpu.lint path/to/file.py   # lint specific files
     python -m k8s1m_tpu.lint --write-baseline  # regenerate (keeps comments out)
+    python -m k8s1m_tpu.lint --json            # machine-readable report
+    python -m k8s1m_tpu.lint --write-lockgraph # refresh artifacts/lockgraph.json
 
 Exit codes: 0 clean (every finding baselined/pragma'd), 1 new findings
-(or stale baseline entries under ``--check-baseline``), 2 usage error.
+(or stale baseline entries under ``--check-baseline``, or stale pragmas
+under ``--strict-pragmas``), 2 usage error.
+
+Stale pragmas: a ``# graftlint: disable=<rule>`` on a line where that
+rule no longer fires is reported as a warning (the pragma is dead weight
+and, worse, would silently swallow a FUTURE finding on that line);
+``--strict-pragmas`` promotes the warning to a failure.  The summary
+counts pragma suppressions per rule so coverage stays visible as the
+rule count grows.
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import os
 import sys
 
@@ -25,10 +36,19 @@ from k8s1m_tpu.lint.base import (
     load_file,
     suppressed,
 )
+from k8s1m_tpu.lint.lockgraph import (
+    LockModel,
+    LockOrderCycle,
+    cycle_findings,
+    sanctioned,
+    write_artifact,
+)
 from k8s1m_tpu.lint.rules_clock import NoWallClock
 from k8s1m_tpu.lint.rules_except import BroadExcept
+from k8s1m_tpu.lint.rules_guards import StaticGuardedBy
 from k8s1m_tpu.lint.rules_hotfeed import HotfeedNoPerPodPython
 from k8s1m_tpu.lint.rules_jax import HotPathHostSync, TraceTimeBranch
+from k8s1m_tpu.lint.rules_mesh import MeshPurity
 from k8s1m_tpu.lint.rules_metrics import MetricsRegistry
 from k8s1m_tpu.lint.rules_retry import RetryThroughPolicy
 
@@ -40,10 +60,15 @@ ALL_RULES: tuple[type[Rule], ...] = (
     BroadExcept,
     TraceTimeBranch,
     HotfeedNoPerPodPython,
+    StaticGuardedBy,
+    LockOrderCycle,
+    MeshPurity,
 )
 
 # The linted slice of the repo (everything else is docs/artifacts).
 DEFAULT_SUBDIRS = ("k8s1m_tpu", "tests")
+
+LOCKGRAPH_ARTIFACT = os.path.join("artifacts", "lockgraph.json")
 
 
 def repo_root() -> str:
@@ -61,6 +86,12 @@ class LintResult:
     new: list[Finding]                         # not covered by baseline
     stale: list[tuple[str, str, str]]          # baseline entries unmatched
     files: int
+    # (path, line, rule): declared pragmas that suppressed nothing.
+    stale_pragmas: list[tuple[str, int, str]] = dataclasses.field(
+        default_factory=list
+    )
+    # rule -> number of findings a pragma suppressed.
+    pragma_counts: dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 def run_lint(
@@ -81,20 +112,65 @@ def run_lint(
         f = load_file(root, rel)
         if f is not None:
             files.append(f)
+    # Cross-file rules (metrics registry, lock graph) need the WHOLE
+    # tree for context even when only a subset is being reported — a
+    # changed-only run must not think a dashboard prefix lost its
+    # metric because the declaring file didn't change.  Findings are
+    # still reported only for the requested subset.
+    if paths:
+        linted_set = {f.path for f in files}
+        tree_files = list(files)
+        seen = set(linted_set)
+        for rel in iter_py_files(root, DEFAULT_SUBDIRS):
+            f = load_file(root, rel)
+            if f is not None and f.path not in seen:
+                seen.add(f.path)
+                tree_files.append(f)
+    else:
+        linted_set = None
+        tree_files = files
 
     instances = [cls() for cls in rules]
+    known_rules = {r.id for r in instances}
     findings: list[Finding] = []
-    by_path = {f.path: f for f in files}
+    # (path, line, rule) pragmas that matched a finding — the live set.
+    used_pragmas: set[tuple[str, int, str]] = set()
+    pragma_counts: dict[str, int] = {}
+    by_path = {f.path: f for f in tree_files}
+
+    def consider(src: SourceFile | None, fd: Finding) -> None:
+        if src is not None and suppressed(src, fd):
+            if linted_set is None or fd.path in linted_set:
+                used_pragmas.add((fd.path, fd.line, fd.rule))
+                pragma_counts[fd.rule] = pragma_counts.get(fd.rule, 0) + 1
+            return
+        if linted_set is None or fd.path in linted_set:
+            findings.append(fd)
+
     for rule in instances:
         for f in files:
             for fd in rule.check_file(f):
-                if not suppressed(f, fd):
-                    findings.append(fd)
-        for fd in rule.check_tree(files):
-            src = by_path.get(fd.path)
-            if src is None or not suppressed(src, fd):
-                findings.append(fd)
+                consider(f, fd)
+        for fd in rule.check_tree(tree_files):
+            consider(by_path.get(fd.path), fd)
     findings.sort(key=lambda fd: (fd.path, fd.line, fd.rule))
+
+    # Pragma staleness is judged against the FULL registry: an id not
+    # in ALL_RULES is a typo (always stale); an id whose rule simply
+    # did not run this invocation (rules= subset) is not evaluated —
+    # otherwise run_lint(rules=(OneRule,)) would report every other
+    # rule's live pragma as stale.
+    all_ids = {cls.id for cls in ALL_RULES}
+    stale_pragmas: list[tuple[str, int, str]] = []
+    for f in files:
+        for line, rule_ids in sorted(f.pragmas.items()):
+            for rid in sorted(rule_ids):
+                if rid not in all_ids:
+                    stale_pragmas.append((f.path, line, rid))
+                elif rid in known_rules and (
+                    (f.path, line, rid) not in used_pragmas
+                ):
+                    stale_pragmas.append((f.path, line, rid))
 
     entries: list[tuple[str, str, str]] = []
     if baseline_path != "":
@@ -111,7 +187,39 @@ def run_lint(
             linted = {f.path for f in files}
             entries = [e for e in entries if e[0] in linted]
     new, stale = baseline_mod.split_findings(findings, entries)
-    return LintResult(findings, new, stale, len(files))
+    return LintResult(
+        findings, new, stale, len(files), stale_pragmas, pragma_counts
+    )
+
+
+def _json_report(result: LintResult, check_baseline: bool) -> dict:
+    """Machine-readable report: rule -> count -> files (the CI shape)."""
+    rules: dict[str, dict] = {}
+    for fd in result.new:
+        r = rules.setdefault(fd.rule, {"count": 0, "files": []})
+        r["count"] += 1
+        if fd.path not in r["files"]:
+            r["files"].append(fd.path)
+    return {
+        "files": result.files,
+        "new": [
+            {"path": fd.path, "line": fd.line, "rule": fd.rule,
+             "message": fd.message}
+            for fd in result.new
+        ],
+        "rules": {k: rules[k] for k in sorted(rules)},
+        "baselined": len(result.findings) - len(result.new),
+        "stale_baseline": (
+            [list(e) for e in result.stale] if check_baseline else None
+        ),
+        "stale_pragmas": [
+            {"path": p, "line": ln, "rule": r}
+            for p, ln, r in result.stale_pragmas
+        ],
+        "pragma_counts": {
+            k: result.pragma_counts[k] for k in sorted(result.pragma_counts)
+        },
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -131,7 +239,35 @@ def main(argv: list[str] | None = None) -> int:
                     help="also fail on stale baseline entries (drift gate)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="print current findings in baseline format")
+    ap.add_argument("--strict-pragmas", action="store_true",
+                    help="fail on pragmas whose rule no longer fires there")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report (rule -> count -> files)")
+    ap.add_argument("--write-lockgraph", nargs="?", const=LOCKGRAPH_ARTIFACT,
+                    default=None, metavar="PATH",
+                    help="write the lock acquisition-order graph artifact "
+                         f"(default {LOCKGRAPH_ARTIFACT}) and exit")
     args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    if args.write_lockgraph is not None:
+        rels = iter_py_files(root, DEFAULT_SUBDIRS)
+        files = [f for f in (load_file(root, r) for r in rels)
+                 if f is not None]
+        model = LockModel(files)
+        out = os.path.join(root, args.write_lockgraph)
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        write_artifact(model, out, files)
+        # Pragma'd cycles (the documented escape hatch) are recorded in
+        # the artifact as sanctioned and do not fail the write.
+        bad = sum(
+            1 for _cyc, fds in cycle_findings(model, files)
+            if not sanctioned(files, fds)
+        )
+        ncyc = len(model.cycles())
+        print(f"lockgraph: {len(model.edges)} edge(s), {ncyc} cycle(s) "
+              f"({bad} unsanctioned) -> {out}")
+        return 1 if bad else 0
 
     result = run_lint(
         root=args.root,
@@ -145,19 +281,42 @@ def main(argv: list[str] | None = None) -> int:
             print(baseline_mod.format_entry(fd))
         return 0
 
-    for fd in result.new:
-        print(fd.render())
-    if args.check_baseline:
-        for path, rule, fp in result.stale:
-            print(f"{path} {rule} STALE baseline entry (fixed? remove it): "
-                  f"{fp!r}")
-    failed = bool(result.new) or (args.check_baseline and bool(result.stale))
-    grandfathered = len(result.findings) - len(result.new)
-    print(
-        f"graftlint: {result.files} files, {len(result.new)} new finding(s)"
-        f", {grandfathered} baselined"
-        + (f", {len(result.stale)} stale" if args.check_baseline else "")
+    if args.json:
+        print(json.dumps(
+            _json_report(result, args.check_baseline), indent=2
+        ))
+    else:
+        for fd in result.new:
+            print(fd.render())
+        if args.check_baseline:
+            for path, rule, fp in result.stale:
+                print(f"{path} {rule} STALE baseline entry (fixed? remove "
+                      f"it): {fp!r}")
+        known = {cls.id for cls in ALL_RULES}
+        for path, line, rid in result.stale_pragmas:
+            why = (
+                "suppresses nothing" if rid in known
+                else "names an unknown rule id (typo?)"
+            )
+            print(f"{path}:{line} stale-pragma '{rid}' {why} "
+                  f"(remove it{'' if args.strict_pragmas else ' — warning'})")
+    failed = (
+        bool(result.new)
+        or (args.check_baseline and bool(result.stale))
+        or (args.strict_pragmas and bool(result.stale_pragmas))
     )
+    if not args.json:
+        grandfathered = len(result.findings) - len(result.new)
+        coverage = ", ".join(
+            f"{k}={v}" for k, v in sorted(result.pragma_counts.items())
+        )
+        print(
+            f"graftlint: {result.files} files, {len(result.new)} new "
+            f"finding(s), {grandfathered} baselined"
+            + (f", {len(result.stale)} stale" if args.check_baseline else "")
+            + f", {len(result.stale_pragmas)} stale pragma(s)"
+            + (f"; pragma coverage: {coverage}" if coverage else "")
+        )
     return 1 if failed else 0
 
 

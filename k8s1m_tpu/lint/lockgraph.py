@@ -1,0 +1,704 @@
+"""lock-order-cycle: the static lock acquisition-order graph.
+
+``@guarded_by`` (rules_guards.py) proves each field access holds *its*
+lock; nothing yet proves the locks themselves are acquired in one global
+order.  Two call paths that nest the same pair of locks in opposite
+orders are a deadlock waiting for the right interleaving — the class of
+bug no amount of test traffic reliably finds (both orders run clean
+until the day they overlap).  This pass builds the directed
+acquisition-order graph over every ``with <lock>:`` in the tree and
+fails on cycles, printing the conflicting acquisition paths.
+
+Model:
+
+- **Lock nodes** are ``path::Class.attr`` for instance locks
+  (``self._lock = threading.Lock()`` — attributed to the *defining*
+  class, so ``Counter.inc``'s lock is ``Metric._lock``) and
+  ``path::NAME`` for module-level locks.  ``threading.Condition(self._x)``
+  aliases to ``_x``; a bare ``Condition()`` is its own (reentrant) lock.
+- **Edges** A -> B mean "B was acquired while A was held": directly
+  (lexically nested ``with``) or interprocedurally — a call made under A
+  reaching, through the intra-repo call graph (bounded depth, receiver
+  types inferred from constructor assignments, parameter annotations and
+  one-level factory returns), a function that acquires B.  Every edge
+  carries a witness: outer site, inner site, and the call chain between
+  them.
+- **Cycles** fail the lint.  A self-edge (A -> A) fails only for a
+  non-reentrant ``Lock`` whose witness chain stays on ``self`` — the
+  provable single-instance re-acquisition deadlock; same-class
+  cross-instance nesting (two ``HostFeed``s, say) shares a node but is
+  not provably the same lock, so it is recorded in the artifact and not
+  failed.
+
+The graph itself is a committed artifact (``artifacts/lockgraph.json``,
+written by ``python -m k8s1m_tpu.lint --write-lockgraph``) so every PR
+diff shows exactly which acquisition orders it adds — the reviewable
+form of the discipline, not just the pass/fail bit.
+
+Known limits (deliberate): ``lock.acquire()``/``release()`` pairs
+outside ``with`` are not tracked (the tree has none outside guards.py's
+proxy), calls through function values (``fn()``, ``set_function``
+callbacks) do not resolve, and ``super().__init__`` chains are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile, call_name as _ctor_name
+
+_MAX_DEPTH = 8
+
+
+# ---- model -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Class:
+    name: str
+    path: str
+    bases: list[str]
+    node: ast.ClassDef
+    lock_attrs: dict[str, str] = dataclasses.field(default_factory=dict)
+    # attr -> "Lock" | "RLock" | "Condition"
+    lock_alias: dict[str, str] = dataclasses.field(default_factory=dict)
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: dict[str, "_Func"] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _Func:
+    qual: str                      # "Class.meth" or "meth"
+    path: str
+    node: ast.AST
+    cls: _Class | None
+    # (lock node id, line, receiver-is-self) in body order
+    acquires: list[tuple[str, int, bool]] = dataclasses.field(
+        default_factory=list
+    )
+    # (callee key, line, held stack [(lock, line)], receiver-is-self)
+    calls: list[tuple[str, int, tuple, bool]] = dataclasses.field(
+        default_factory=list
+    )
+    # direct nested pairs:
+    # (outer lock, outer line, inner lock, inner line, both-on-self)
+    nested: list[tuple[str, int, str, int, bool]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    src: str
+    dst: str
+    outer_site: str                # "path:line" where src was taken
+    inner_site: str                # "path:line" where dst was taken
+    via: tuple[str, ...]           # call chain, "" for lexical nesting
+    self_chain: bool               # every hop stayed on ``self``
+
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+
+def _ann_name(ann: ast.AST | None) -> str | None:
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip().split("[")[0].split(".")[-1] or None
+    if isinstance(ann, ast.Subscript):
+        return _ann_name(ann.value)
+    return None
+
+
+class LockModel:
+    """The whole-tree lock/call model shared by the rule and the
+    artifact writer."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = [f for f in files if f.path.startswith("k8s1m_tpu/")]
+        self.classes: dict[str, _Class] = {}        # simple name -> class
+        self.module_locks: dict[tuple[str, str], str] = {}  # (path,name)->kind
+        self.module_types: dict[tuple[str, str], str] = {}  # (path,name)->cls
+        self.funcs: dict[str, _Func] = {}           # "path::qual" -> func
+        self.factories: dict[tuple[str, str], str] = {}  # (path,fn)->cls
+        self._collect_defs()
+        self._summarize()
+        self.edges = self._build_edges()
+
+    # -- pass 1: classes, locks, types ---------------------------------
+
+    def _collect_defs(self) -> None:
+        for f in self.files:
+            if not isinstance(f.tree, ast.Module):
+                continue
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    c = _Class(
+                        node.name, f.path,
+                        [b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+                         for b in node.bases],
+                        node,
+                    )
+                    self._scan_class_attrs(c)
+                    # First definition wins; name collisions are rare and
+                    # deterministic this way.
+                    self.classes.setdefault(node.name, c)
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Name) and isinstance(
+                        node.value, ast.Call
+                    ):
+                        ctor = _ctor_name(node.value)
+                        if ctor in _LOCK_CTORS:
+                            self.module_locks[(f.path, tgt.id)] = (
+                                _LOCK_CTORS[ctor]
+                            )
+                        elif ctor is not None:
+                            self.module_types[(f.path, tgt.id)] = ctor
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Return) and isinstance(
+                            sub.value, ast.Call
+                        ):
+                            ctor = _ctor_name(sub.value)
+                            if ctor is not None:
+                                self.factories[(f.path, node.name)] = ctor
+                                break
+
+    def _scan_class_attrs(self, c: _Class) -> None:
+        for node in ast.walk(c.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            if isinstance(node.value, ast.Call):
+                ctor = _ctor_name(node.value)
+                if ctor in _LOCK_CTORS:
+                    if ctor == "Condition" and node.value.args:
+                        a0 = node.value.args[0]
+                        if (
+                            isinstance(a0, ast.Attribute)
+                            and isinstance(a0.value, ast.Name)
+                            and a0.value.id == "self"
+                        ):
+                            c.lock_alias[tgt.attr] = a0.attr
+                            continue
+                    c.lock_attrs[tgt.attr] = _LOCK_CTORS[ctor]
+                elif ctor is not None:
+                    c.attr_types.setdefault(tgt.attr, ctor)
+            elif isinstance(node.value, ast.Name):
+                # self.x = param — type from the parameter annotation of
+                # the enclosing function, found lazily in _param_types.
+                c.attr_types.setdefault(
+                    tgt.attr, f"<param>{node.value.id}"
+                )
+
+    # -- resolution helpers --------------------------------------------
+
+    def _class_of(self, name: str | None) -> _Class | None:
+        return self.classes.get(name) if name else None
+
+    def _lock_owner(self, cls: _Class | None, attr: str) -> _Class | None:
+        """The class (self or any base, BFS) whose __init__ assigns the
+        lock — multiple inheritance checks EVERY base, or a LockMixin's
+        lock would silently vanish from the graph."""
+        queue = [cls] if cls is not None else []
+        seen: set[str] = set()
+        while queue:
+            c = queue.pop(0)
+            if c is None or c.name in seen:
+                continue
+            seen.add(c.name)
+            if attr in c.lock_attrs:
+                return c
+            queue.extend(
+                self.classes.get(b) for b in c.bases
+                if self.classes.get(b) is not None
+            )
+        return None
+
+    def _lock_node(self, cls: _Class | None, attr: str) -> str | None:
+        owner = self._lock_owner(cls, attr)
+        if owner is None:
+            return None
+        return f"{owner.path}::{owner.name}.{attr}"
+
+    def lock_kind(self, node_id: str) -> str:
+        path, _, rest = node_id.partition("::")
+        if "." in rest:
+            cname, attr = rest.split(".", 1)
+            c = self.classes.get(cname)
+            if c is not None:
+                return c.lock_attrs.get(attr, "Lock")
+            return "Lock"
+        return self.module_locks.get((path, rest), "Lock")
+
+    def _method_of(self, cls: _Class | None, name: str) -> _Func | None:
+        """Method lookup over self and ALL bases (BFS; approximates the
+        MRO closely enough for a lint — exact C3 order only matters
+        when two bases define the same method AND acquire different
+        locks in it)."""
+        queue = [cls] if cls is not None else []
+        seen: set[str] = set()
+        while queue:
+            c = queue.pop(0)
+            if c is None or c.name in seen:
+                continue
+            seen.add(c.name)
+            fn = c.methods.get(name)
+            if fn is not None:
+                return fn
+            queue.extend(
+                self.classes.get(b) for b in c.bases
+                if self.classes.get(b) is not None
+            )
+        return None
+
+    # -- pass 2: per-function summaries --------------------------------
+
+    def _summarize(self) -> None:
+        # Pre-register every function/method so calls resolve regardless
+        # of definition order (forward references are the common case).
+        work: list[tuple[SourceFile, ast.AST, _Class | None]] = []
+        for f in self.files:
+            if not isinstance(f.tree, ast.Module):
+                continue
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    c = self.classes.get(node.name)
+                    if c is None or c.path != f.path:
+                        continue
+                    for sub in node.body:
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            fn = _Func(f"{c.name}.{sub.name}", f.path, sub, c)
+                            c.methods[sub.name] = fn
+                            self.funcs[f"{f.path}::{fn.qual}"] = fn
+                            work.append((f, sub, c))
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = _Func(node.name, f.path, node, None)
+                    self.funcs[f"{f.path}::{fn.qual}"] = fn
+                    work.append((f, node, None))
+        imports_cache: dict[str, dict[str, str]] = {}
+        for f, node, c in work:
+            if f.path not in imports_cache:
+                imports_cache[f.path] = self._imports_of(f)
+            self._summarize_func(f, node, c, imports_cache[f.path])
+
+    def _imports_of(self, f: SourceFile) -> dict[str, tuple[str | None, str]]:
+        """local name -> (source module dotted path or None, simple name).
+
+        The module matters: resolving an imported callee by simple-name
+        suffix alone could bind `flush` to whichever repo module sorts
+        first and fabricate phantom acquisition edges.
+        """
+        out: dict[str, tuple[str | None, str]] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    out[alias.asname or alias.name] = (
+                        node.module if not node.level else None,
+                        alias.name,
+                    )
+        return out
+
+    def _summarize_func(
+        self, f: SourceFile, fn, cls: _Class | None, imports: dict
+    ) -> _Func:
+        qual = f"{cls.name}.{fn.name}" if cls is not None else fn.name
+        out = self.funcs[f"{f.path}::{qual}"]
+        param_types: dict[str, str] = {}
+        for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+            t = _ann_name(a.annotation)
+            if t is not None:
+                param_types[a.arg] = t
+        local_types: dict[str, str] = dict(param_types)
+
+        def resolve_param_attr(tname: str | None) -> str | None:
+            # "<param>x" markers from _scan_class_attrs resolve through
+            # the __init__ annotations of the owning class.
+            if tname is None or not tname.startswith("<param>"):
+                return tname
+            if cls is None:
+                return None
+            init = cls.methods.get("__init__")
+            pname = tname[len("<param>"):]
+            node = init.node if init is not None else None
+            if node is None:
+                for sub in cls.node.body:
+                    if (
+                        isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and sub.name == "__init__"
+                    ):
+                        node = sub
+                        break
+            if node is None:
+                return None
+            for a in list(node.args.args) + list(node.args.kwonlyargs):
+                if a.arg == pname:
+                    return _ann_name(a.annotation)
+            return None
+
+        def type_of(expr: ast.AST) -> str | None:
+            """Best-effort class simple-name of an expression."""
+            if isinstance(expr, ast.Name):
+                t = local_types.get(expr.id)
+                if t is not None:
+                    return t
+                t = self.module_types.get((f.path, expr.id))
+                if t is not None:
+                    return t
+                return None
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and cls is not None
+            ):
+                return resolve_param_attr(cls.attr_types.get(expr.attr))
+            if isinstance(expr, ast.Call):
+                ctor = _ctor_name(expr)
+                if ctor in self.classes:
+                    return ctor
+                if ctor is not None:
+                    fac = self.factories.get((f.path, ctor))
+                    if fac is not None:
+                        return fac
+            return None
+
+        def lock_id(expr: ast.AST) -> tuple[str | None, bool]:
+            """(lock node id, receiver-is-self) for a with-item."""
+            if isinstance(expr, ast.Attribute):
+                if isinstance(expr.value, ast.Name):
+                    if expr.value.id == "self" and cls is not None:
+                        attr = cls.lock_alias.get(expr.attr, expr.attr)
+                        return self._lock_node(cls, attr), True
+                    t = self._class_of(type_of(expr.value))
+                    if t is not None:
+                        attr = t.lock_alias.get(expr.attr, expr.attr)
+                        return self._lock_node(t, attr), False
+                elif isinstance(expr.value, ast.Attribute):
+                    t = self._class_of(type_of(expr.value))
+                    if t is not None:
+                        attr = t.lock_alias.get(expr.attr, expr.attr)
+                        return self._lock_node(t, attr), False
+            elif isinstance(expr, ast.Name):
+                name = expr.id
+                if (f.path, name) in self.module_locks:
+                    return f"{f.path}::{name}", False
+                imp = imports.get(name)
+                if imp is not None and imp[0] is not None:
+                    p = imp[0].replace(".", "/") + ".py"
+                    if (p, imp[1]) in self.module_locks:
+                        return f"{p}::{imp[1]}", False
+            return None, False
+
+        def callee_key(call: ast.Call) -> tuple[str | None, bool]:
+            """(func table key, receiver-is-self) for a call."""
+            fnexpr = call.func
+            if isinstance(fnexpr, ast.Name):
+                name = fnexpr.id
+                key = f"{f.path}::{name}"      # same-module first
+                if key in self.funcs:
+                    return key, False
+                imported = imports.get(name)
+                if imported is not None:
+                    module, simple = imported
+                    if module is not None:
+                        # Exact: the imported module's own function.
+                        mkey = f"{module.replace('.', '/')}.py::{simple}"
+                        if mkey in self.funcs:
+                            return mkey, False
+                return None, False
+            if isinstance(fnexpr, ast.Attribute):
+                if (
+                    isinstance(fnexpr.value, ast.Name)
+                    and fnexpr.value.id == "self"
+                    and cls is not None
+                ):
+                    m = self._method_of(cls, fnexpr.attr)
+                    if m is not None:
+                        return f"{m.path}::{m.qual}", True
+                    return None, False
+                t = self._class_of(type_of(fnexpr.value))
+                if t is not None:
+                    m = self._method_of(t, fnexpr.attr)
+                    if m is not None:
+                        return f"{m.path}::{m.qual}", False
+            return None, False
+
+        def visit(node: ast.AST, held: tuple) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new = list(held)
+                for item in node.items:
+                    lid, via_self = lock_id(item.context_expr)
+                    if lid is not None:
+                        line = item.context_expr.lineno
+                        out.acquires.append((lid, line, via_self))
+                        for hl, hline, h_self in new:
+                            # both-on-self only when BOTH receivers are
+                            # ``self``: `with self._a: with other._a:`
+                            # shares a node but is not provably the
+                            # same lock instance.
+                            out.nested.append(
+                                (hl, hline, lid, line, h_self and via_self)
+                            )
+                        new.append((lid, line, via_self))
+                    else:
+                        # Not a recognizable lock — but the header runs
+                        # under the locks of the items before it, and a
+                        # call in it (`with self._a, self._grab_b():`)
+                        # can acquire locks: visit it with the stack.
+                        visit(item.context_expr, tuple(new))
+                for child in node.body:
+                    visit(child, tuple(new))
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return      # runs later / different scope: no lock context
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    t = type_of(node.value)
+                    if t is not None:
+                        local_types[tgt.id] = t
+            if isinstance(node, ast.Call):
+                key, via_self = callee_key(node)
+                if key is not None:
+                    out.calls.append((key, node.lineno, held, via_self))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in fn.body:
+            visit(child, ())
+        return out
+
+    # -- pass 3: edges ---------------------------------------------------
+
+    def _transitive_acquires(
+        self, key: str, depth: int, stack: frozenset
+    ) -> list[tuple[str, str, tuple[str, ...], bool]]:
+        """[(lock, site, via chain, all-self)] reachable from ``key``."""
+        if depth > _MAX_DEPTH or key in stack:
+            return []
+        fn = self.funcs.get(key)
+        if fn is None:
+            return []
+        out = []
+        for lid, line, via_self in fn.acquires:
+            out.append((lid, f"{fn.path}:{line}", (), via_self))
+        stack = stack | {key}
+        for callee, line, _held, call_self in fn.calls:
+            step = f"{callee.split('::')[-1]} ({fn.path}:{line})"
+            for lid, site, via, chain_self in self._transitive_acquires(
+                callee, depth + 1, stack
+            ):
+                out.append(
+                    (lid, site, (step,) + via, call_self and chain_self)
+                )
+        return out
+
+    def _build_edges(self) -> list[Edge]:
+        # Keyed on (src, dst, self_chain): a self-chain witness is what
+        # proves a single-instance re-acquisition deadlock, so it must
+        # never be displaced by a shorter cross-instance witness of the
+        # same (src, dst) pair — both variants are kept.
+        edges: dict[tuple[str, str, bool], Edge] = {}
+
+        def add(e: Edge) -> None:
+            k = (e.src, e.dst, e.self_chain)
+            prev = edges.get(k)
+            if prev is None or (
+                (len(e.via), e.outer_site, e.inner_site)
+                < (len(prev.via), prev.outer_site, prev.inner_site)
+            ):
+                edges[k] = e
+
+        for key in sorted(self.funcs):
+            fn = self.funcs[key]
+            for outer, oline, inner, iline, both_self in fn.nested:
+                add(Edge(
+                    outer, inner,
+                    f"{fn.path}:{oline}", f"{fn.path}:{iline}",
+                    (), both_self,
+                ))
+            for callee, line, held, call_self in fn.calls:
+                if not held:
+                    continue
+                step = f"{callee.split('::')[-1]} ({fn.path}:{line})"
+                for lid, site, via, chain_self in self._transitive_acquires(
+                    callee, 1, frozenset({key})
+                ):
+                    for hl, hline, h_self in held:
+                        add(Edge(
+                            hl, lid,
+                            f"{fn.path}:{hline}", site,
+                            (step,) + via,
+                            h_self and call_self and chain_self,
+                        ))
+        return sorted(
+            edges.values(), key=lambda e: (e.src, e.dst, e.self_chain)
+        )
+
+    # -- cycles ----------------------------------------------------------
+
+    def cycles(self) -> list[list[Edge]]:
+        """Elementary cycles that FAIL the lint: every multi-node cycle,
+        plus single-node self-loops provably on one instance of a
+        non-reentrant Lock."""
+        by_src: dict[str, list[Edge]] = {}
+        for e in self.edges:
+            by_src.setdefault(e.src, []).append(e)
+        out: list[list[Edge]] = []
+        seen_keys: set[tuple] = set()
+
+        for e in self.edges:
+            if e.src == e.dst and e.self_chain and (
+                self.lock_kind(e.src) == "Lock"
+            ):
+                out.append([e])
+
+        # Bounded DFS for multi-node cycles (the graph is tiny; edges
+        # number in the tens).
+        def dfs(start: str, node: str, path: list[Edge], seen: set) -> None:
+            for e in by_src.get(node, ()):
+                if e.src == e.dst:
+                    continue
+                if e.dst == start and path:
+                    cyc = path + [e]
+                    key = tuple(sorted((c.src, c.dst) for c in cyc))
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        out.append(cyc)
+                elif e.dst not in seen and len(path) < 6:
+                    dfs(start, e.dst, path + [e], seen | {e.dst})
+
+        for n in sorted({e.src for e in self.edges}):
+            dfs(n, n, [], {n})
+        return out
+
+    # -- artifact --------------------------------------------------------
+
+    def to_json(self, files: list[SourceFile] | None = None) -> dict:
+        """The committed-artifact shape.  With ``files``, each cycle
+        carries ``sanctioned``: True when every per-file finding it
+        yields is pragma-suppressed (the ok_lockorder fixture pattern) —
+        the CLI and the tier-1 artifact gate fail only on unsanctioned
+        cycles, so the documented escape hatch actually escapes."""
+        cycles = []
+        for cyc, findings in cycle_findings(self, files or []):
+            cycles.append({
+                "edges": [
+                    f"{c.src} -> {c.dst} ({c.outer_site} -> {c.inner_site})"
+                    for c in cyc
+                ],
+                "sanctioned": bool(files) and sanctioned(files, findings),
+            })
+        return {
+            "nodes": sorted(
+                {e.src for e in self.edges} | {e.dst for e in self.edges}
+            ),
+            "edges": [
+                {
+                    "from": e.src,
+                    "to": e.dst,
+                    "outer_site": e.outer_site,
+                    "inner_site": e.inner_site,
+                    "via": list(e.via),
+                    "self_chain": e.self_chain,
+                }
+                for e in self.edges
+            ],
+            "cycles": cycles,
+        }
+
+
+def cycle_findings(
+    model: LockModel, files: list[SourceFile]
+) -> list[tuple[list[Edge], list[Finding]]]:
+    """Per cycle: ALL the findings it yields — one per file the cycle's
+    acquisition sites touch, anchored at that file's lexically-last
+    site.  A changed-only/subset run then still reports the cycle for
+    the file that introduced its half of the inversion.  Pragma
+    suppression is NOT applied here (the driver does that, so used-
+    pragma accounting stays correct); use ``sanctioned`` to ask whether
+    every finding of a cycle is pragma'd."""
+    by_path = {f.path: f for f in files}
+    out: list[tuple[list[Edge], list[Finding]]] = []
+    for cyc in model.cycles():
+        sites: dict[str, int] = {}
+        for e in cyc:
+            for site in (e.outer_site, e.inner_site):
+                p, _, ln = site.rpartition(":")
+                sites[p] = max(sites.get(p, 0), int(ln))
+        findings: list[Finding] = []
+        for path in sorted(sites):
+            line = sites[path]
+            src = by_path.get(path)
+            findings.append(Finding(
+                path, line, LockOrderCycle.id,
+                "lock acquisition order cycle (potential deadlock): "
+                + render_cycle(cyc),
+                (
+                    src.lines[line - 1].strip()
+                    if src and 0 < line <= len(src.lines) else ""
+                ),
+            ))
+        out.append((cyc, findings))
+    return out
+
+
+def sanctioned(files: list[SourceFile], findings: list[Finding]) -> bool:
+    """True when every finding of a cycle is pragma-suppressed in its
+    file — the documented escape hatch for a reviewed-safe inversion."""
+    from k8s1m_tpu.lint.base import suppressed
+
+    by_path = {f.path: f for f in files}
+    return bool(findings) and all(
+        by_path.get(fd.path) is not None
+        and suppressed(by_path[fd.path], fd)
+        for fd in findings
+    )
+
+
+def render_cycle(cyc: list[Edge]) -> str:
+    """Human-readable conflicting acquisition paths for one cycle."""
+    parts = []
+    for e in cyc:
+        chain = " -> ".join(e.via) if e.via else "lexically nested"
+        parts.append(
+            f"{e.src} held at {e.outer_site} then {e.dst} at "
+            f"{e.inner_site} [{chain}]"
+        )
+    return " || ".join(parts)
+
+
+def write_artifact(
+    model: LockModel, path: str, files: list[SourceFile] | None = None
+) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(model.to_json(files), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+class LockOrderCycle(Rule):
+    id = "lock-order-cycle"
+
+    def check_tree(self, files: list[SourceFile]) -> list[Finding]:
+        model = LockModel(files)
+        out: list[Finding] = []
+        for _cyc, findings in cycle_findings(model, files):
+            out.extend(findings)
+        return sorted(out, key=lambda fd: (fd.path, fd.line))

@@ -1,0 +1,343 @@
+"""static-guarded-by: the ``@guarded_by`` contract, proven at lint time.
+
+PR 4's ``lint/guards.py`` audit enforces lock discipline at *runtime*:
+an access path no test drives is invisible to it.  This pass closes the
+gap by checking the same declarations at the AST level, on every file,
+with zero traffic:
+
+- **lock-guarded fields** (``field="_lock"``): every ``self.<field>``
+  read/write inside the declaring class must sit lexically inside a
+  ``with self.<lock>:`` block — or in a helper method reached ONLY from
+  locked call sites (one level of intra-class call-graph propagation,
+  the ``HealthController._set_state`` pattern, whose docstring says
+  "caller must hold _admit_lock"; this pass makes that sentence a
+  checked invariant).  ``__init__`` is exempt (construction is
+  single-threaded by definition — same rule the runtime auditor
+  applies), and call sites *in* ``__init__`` count as satisfied for the
+  helper analysis for the same reason.
+- **THREAD_OWNER fields**: never touched from a method that is also a
+  ``threading.Thread`` target (or a ``do_*``/``handle*`` server-handler
+  entrypoint) of the same class, nor from a nested function passed as a
+  Thread target — those run on a foreign thread by construction, so a
+  single static hit is a guaranteed runtime violation, not a maybe.
+- **unannotated-shared-state heuristic**: in a class that starts its
+  own threads, a field *written* both from a thread-entrypoint method
+  and from the ordinary (caller-thread) surface, with no ``@guarded_by``
+  annotation covering it, is flagged — the exact shape every race PR 4's
+  audit found had, caught before any test traffic exists.
+
+Scope is ``k8s1m_tpu/`` production code (tests may legitimately poke
+guarded fields cross-class to assert on them).  Condition variables
+constructed over an instance lock (``self._cond =
+threading.Condition(self._lock)``) alias to that lock.  The analysis is
+intra-class by design: the runtime auditor remains the authority for
+cross-object access, and ``racy_read`` bypasses (string field names)
+never parse as attribute access in the first place — the two halves are
+compared by tests/test_guards_static.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile, call_name
+
+THREAD_OWNER_SENTINEL = "<thread-owner>"
+
+# Server-handler entrypoints: methods the socketserver / http.server
+# machinery invokes on a per-connection thread.
+_HANDLER_NAMES = {
+    "handle", "handle_one_request", "finish_request", "process_request",
+}
+
+
+def _is_self_attr(node: ast.AST) -> str | None:
+    """'x' for a ``self.x`` attribute node, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _guard_map(cls: ast.ClassDef) -> dict[str, str] | None:
+    """field -> guard from a ``@guarded_by(...)`` decorator, or None.
+
+    A guard is either a lock-attribute name (string constant) or the
+    THREAD_OWNER sentinel (referenced by name in source).
+    """
+    for deco in cls.decorator_list:
+        if not isinstance(deco, ast.Call):
+            continue
+        if call_name(deco) != "guarded_by":
+            continue
+        guards: dict[str, str] = {}
+        for kw in deco.keywords:
+            if kw.arg is None:
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                guards[kw.arg] = v.value
+            elif (
+                isinstance(v, ast.Name) and v.id == "THREAD_OWNER"
+            ) or (
+                isinstance(v, ast.Attribute) and v.attr == "THREAD_OWNER"
+            ):
+                guards[kw.arg] = THREAD_OWNER_SENTINEL
+        return guards
+    return None
+
+
+def _thread_target_of(call: ast.Call) -> ast.AST | None:
+    """The ``target=`` value of a ``threading.Thread(...)`` call."""
+    if call_name(call) != "Thread":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+@dataclasses.dataclass
+class _Access:
+    field: str
+    line: int
+    write: bool
+    held: frozenset[str]       # lock attrs lexically held at the access
+    scope: str                 # method name, or "method.nested" for defs
+
+
+@dataclasses.dataclass
+class _MethodSummary:
+    name: str
+    accesses: list[_Access]
+    # (callee method name, locks held at the call site, in __init__?)
+    calls: list[tuple[str, frozenset, bool]]
+    # (field, scope, line) for every attribute Store outside __init__ —
+    # scope is the method name or "<method>.<nested fn>" so Thread-target
+    # closures categorize as their own entrypoint.
+    writes: list[tuple[str, str, int]]
+
+
+class _ClassModel:
+    def __init__(self, f: SourceFile, cls: ast.ClassDef, guards: dict):
+        self.f = f
+        self.cls = cls
+        self.guards = guards
+        self.methods: dict[str, _MethodSummary] = {}
+        # Lock aliasing: Condition(self._lock) -> holding the condition
+        # is holding the lock.
+        self.lock_alias: dict[str, str] = {}
+        # Methods running on a foreign thread: Thread targets + handler
+        # entrypoints; nested defs used as Thread targets get a
+        # synthetic "<method>.<fn>" entry.
+        self.thread_entrypoints: set[str] = set()
+        self.starts_threads = False
+        self._collect_aliases()
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._summarize_method(node)
+                if node.name in _HANDLER_NAMES or node.name.startswith("do_"):
+                    self.thread_entrypoints.add(node.name)
+
+    def _collect_aliases(self) -> None:
+        for node in ast.walk(self.cls):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt_attr = _is_self_attr(node.targets[0])
+            if tgt_attr is None or not isinstance(node.value, ast.Call):
+                continue
+            if call_name(node.value) == "Condition" and node.value.args:
+                src = _is_self_attr(node.value.args[0])
+                if src is not None:
+                    self.lock_alias[tgt_attr] = src
+
+    def _resolve(self, attr: str) -> str:
+        return self.lock_alias.get(attr, attr)
+
+    def _summarize_method(self, fn: ast.FunctionDef) -> None:
+        summary = _MethodSummary(fn.name, [], [], [])
+        in_init = fn.name == "__init__"
+
+        def visit(node: ast.AST, held: frozenset, scope: str) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                # Items acquire left to right: a later item's context
+                # expression (and any call in it) already runs under
+                # the earlier items' locks — `with self._lock,
+                # self._reader():` calls _reader WITH _lock held.
+                acquired: set[str] = set()
+                for item in node.items:
+                    visit(item.context_expr, held | frozenset(acquired),
+                          scope)
+                    attr = _is_self_attr(item.context_expr)
+                    if attr is not None:
+                        acquired.add(self._resolve(attr))
+                inner = held | frozenset(acquired)
+                for child in node.body:
+                    visit(child, inner, scope)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A nested def runs later, possibly on another thread:
+                # it inherits NO lexical lock context.
+                nested = f"{fn.name}.{node.name}"
+                for child in ast.iter_child_nodes(node):
+                    visit(child, frozenset(), nested)
+                return
+            if isinstance(node, ast.Lambda):
+                visit(node.body, frozenset(), f"{fn.name}.<lambda>")
+                return
+            if isinstance(node, ast.ClassDef):
+                return          # nested class: a different ``self``
+            if isinstance(node, ast.Call):
+                tgt = _thread_target_of(node)
+                if tgt is not None:
+                    self.starts_threads = True
+                    attr = _is_self_attr(tgt)
+                    if attr is not None:
+                        self.thread_entrypoints.add(attr)
+                    elif isinstance(tgt, ast.Name):
+                        self.thread_entrypoints.add(f"{fn.name}.{tgt.id}")
+                callee = None
+                if isinstance(node.func, ast.Attribute):
+                    callee = _is_self_attr(node.func)
+                if callee is not None:
+                    # Construction-exempt only from __init__'s OWN scope:
+                    # a call made inside a nested def defined there (a
+                    # Thread-target closure) runs post-construction, so
+                    # it must not launder an unguarded helper.
+                    summary.calls.append(
+                        (callee, held, in_init and scope == fn.name)
+                    )
+            attr = _is_self_attr(node)
+            if attr is not None:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                if attr in self.guards:
+                    summary.accesses.append(
+                        _Access(attr, node.lineno, write, held, scope)
+                    )
+                # __init__ writes are construction-exempt — but only in
+                # __init__'s OWN scope: a nested def defined there and
+                # handed to a Thread runs post-construction on a foreign
+                # thread, so its writes count.
+                if write and (not in_init or scope != fn.name):
+                    summary.writes.append((attr, scope, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, scope)
+
+        for child in fn.body:
+            visit(child, frozenset(), fn.name)
+        self.methods[fn.name] = summary
+
+
+class StaticGuardedBy(Rule):
+    id = "static-guarded-by"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if not f.path.startswith("k8s1m_tpu/"):
+            return []
+        out: list[Finding] = []
+        for node in f.tree.body if isinstance(f.tree, ast.Module) else []:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            guards = _guard_map(node)
+            model = _ClassModel(f, node, guards or {})
+            if guards:
+                out.extend(self._check_annotated(f, model))
+            out.extend(self._check_unannotated(f, model))
+        out.sort(key=lambda fd: fd.line)
+        return out
+
+    # -- declared guards -------------------------------------------------
+
+    def _check_annotated(self, f: SourceFile, m: _ClassModel) -> list[Finding]:
+        out: list[Finding] = []
+        # Call sites per method: (held locks, from __init__).  __init__
+        # call sites are INCLUDED — construction is single-threaded, so
+        # they count as satisfied in the locked-helper check below (a
+        # helper called only from __init__ is clean, matching the
+        # runtime auditor's construction exemption).
+        callers: dict[str, list[tuple[frozenset, bool]]] = {}
+        for ms in m.methods.values():
+            for callee, held, in_init in ms.calls:
+                callers.setdefault(callee, []).append((held, in_init))
+        for ms in m.methods.values():
+            for acc in ms.accesses:
+                # Construction is single-threaded: __init__'s OWN scope
+                # is exempt.  Accesses inside a nested def defined there
+                # (scope "__init__.<fn>") run later — possibly as a
+                # Thread target — and are checked like any other.
+                if ms.name == "__init__" and acc.scope == "__init__":
+                    continue
+                guard = m.guards[acc.field]
+                if guard == THREAD_OWNER_SENTINEL:
+                    if acc.scope in m.thread_entrypoints:
+                        out.append(self.finding(
+                            f, acc.line,
+                            f"{m.cls.name}.{acc.field} is THREAD_OWNER but "
+                            f"{acc.scope} runs on a spawned thread "
+                            f"(Thread target / handler entrypoint)",
+                        ))
+                    continue
+                if guard in acc.held:
+                    continue
+                if acc.scope != ms.name:
+                    # Inside a nested def/lambda: runs later, no lexical
+                    # lock — always a finding (pragma if deliberate).
+                    out.append(self._unguarded(f, m, acc, guard))
+                    continue
+                sites = callers.get(ms.name, [])
+                locked_helper = bool(sites) and all(
+                    in_init or guard in held for held, in_init in sites
+                )
+                if not locked_helper:
+                    out.append(self._unguarded(f, m, acc, guard))
+        return out
+
+    def _unguarded(self, f, m: _ClassModel, acc: _Access, guard: str) -> Finding:
+        mode = "write" if acc.write else "read"
+        return self.finding(
+            f, acc.line,
+            f"{m.cls.name}.{acc.field} {mode} outside 'with self.{guard}:' "
+            f"(and {acc.scope} has unlocked intra-class callers); hold the "
+            f"lock, make every caller hold it, or pragma with the reason",
+        )
+
+    # -- unannotated shared state heuristic --------------------------------
+
+    def _check_unannotated(self, f: SourceFile, m: _ClassModel) -> list[Finding]:
+        if not m.starts_threads:
+            return []
+        # Entry category per method: each thread entrypoint is its own
+        # category; everything else is the (single) caller-thread surface.
+        # A nested Thread-target def belongs to its synthetic scope name.
+        def category(scope: str) -> str:
+            return scope if scope in m.thread_entrypoints else "main"
+
+        writes: dict[str, dict[str, int]] = {}   # field -> category -> line
+        for ms in m.methods.values():
+            for field, scope, line in ms.writes:
+                if field in m.guards:
+                    continue
+                cat = category(scope)
+                prev = writes.setdefault(field, {}).get(cat)
+                if prev is None or line < prev:
+                    writes[field][cat] = line
+        out: list[Finding] = []
+        for field, cats in sorted(writes.items()):
+            if len(cats) < 2:
+                continue
+            line = min(
+                ln for cat, ln in cats.items() if cat != "main"
+            ) if any(c != "main" for c in cats) else min(cats.values())
+            names = " and ".join(sorted(cats))
+            out.append(self.finding(
+                f, line,
+                f"{m.cls.name}.{field} is written from {names} threads "
+                f"but carries no @guarded_by annotation; annotate it "
+                f"(lock or THREAD_OWNER) or pragma with the reason the "
+                f"race is benign",
+            ))
+        return out

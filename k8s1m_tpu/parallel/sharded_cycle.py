@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -257,13 +258,23 @@ def make_sharded_packed_step(
         dp = lax.axis_index("dp")
 
         full = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
-        batch = jax.tree.map(
-            lambda x: (
-                lax.dynamic_slice_in_dim(x, dp * b_local, b_local, 0)
-                if x.ndim >= 1 and x.shape[0] == b_full else x
-            ),
-            full,
-        ).replace(qkey=full.qkey)   # qkey is [Q]; stays whole on every rank
+
+        def slice_dp(x):
+            if not (x.ndim >= 1 and x.shape[0] == b_full):
+                return x
+            if isinstance(x, np.ndarray) and not x.any():
+                # Absent packed group (numpy zeros): any dp slice of an
+                # all-zeros array is zeros, so rebuild at local shape
+                # instead of dynamic-slicing with the traced dp index —
+                # slicing would turn the constant into a tracer and
+                # defeat the filter plugins' trace-time skip
+                # (plugins/filters._statically_empty) on the mesh path.
+                return np.zeros((b_local,) + x.shape[1:], x.dtype)
+            return lax.dynamic_slice_in_dim(x, dp * b_local, b_local, 0)
+
+        batch = jax.tree.map(slice_dp, full).replace(
+            qkey=full.qkey          # qkey is [Q]; stays whole on every rank
+        )
 
         stats = (
             topology.prologue(table, constraints, axis_name="sp")

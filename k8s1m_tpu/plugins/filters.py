@@ -17,6 +17,7 @@ Upstream plugin parity:
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from k8s1m_tpu.config import (
     EFFECT_NO_EXECUTE,
@@ -26,6 +27,22 @@ from k8s1m_tpu.config import (
 from k8s1m_tpu.ops.label_match import ResolvedKeys, match_expressions, resolve_query_keys
 from k8s1m_tpu.snapshot.node_table import NodeTable
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
+
+
+def _statically_empty(x) -> bool:
+    """True when ``x`` is a HOST constant with no live entries — the
+    excluded-packed-group case (``unpack_pod_batch`` materializes
+    absent groups as numpy zeros precisely so this check can see them
+    inside a trace).  Skipping the plugin then is a pure no-op on the
+    math (all-False validity masks already make it pass-through) but
+    keeps the [B, S, N] constant chain out of the program: XLA-CPU
+    otherwise constant-folds it at 26-64s per batch bucket
+    (slow_operation_alarm on plugins/filters.py), which is most of a
+    cold sched_bench/soak start.  Deliberately numpy-only: tracers must
+    trace, and probing a concrete *device* array here would force a
+    device->host sync on every eager call for nothing.
+    """
+    return isinstance(x, np.ndarray) and not x.any()
 
 
 def fits_resources(table: NodeTable, batch: PodBatch):
@@ -56,6 +73,11 @@ def tolerates_taints(table: NodeTable, batch: PodBatch):
         (table.taint_effect == EFFECT_NO_SCHEDULE)
         | (table.taint_effect == EFFECT_NO_EXECUTE)
     )
+    if _statically_empty(batch.tolerated):
+        # Tol group absent: no pod tolerates anything, so a node passes
+        # iff it carries no hard taint — same result, no [B, N, TS]
+        # gather for XLA to fold.
+        return jnp.broadcast_to(~hard.any(axis=-1)[None, :], (b, n))
     # [B, N*TS] gather of host-evaluated results, back to [B, N, TS].
     tol = jnp.take(batch.tolerated, table.taint_id.reshape(-1), axis=1)
     tol = tol.reshape(b, n, ts)
@@ -64,26 +86,38 @@ def tolerates_taints(table: NodeTable, batch: PodBatch):
 
 def node_affinity(table: NodeTable, batch: PodBatch, resolved: ResolvedKeys):
     """NodeAffinity required terms (OR of ANDed terms) + spec.nodeSelector."""
-    # nodeSelector: ANDed exact matches.
-    f = jnp.take(resolved.found, batch.sel_qidx, axis=0)   # [B, S, N]
-    v = jnp.take(resolved.val, batch.sel_qidx, axis=0)
-    sel_ok = f & (v == batch.sel_val[:, :, None])
-    sel_pass = (sel_ok | ~batch.sel_valid[:, :, None]).all(axis=1)
+    parts = []
+    if not _statically_empty(batch.sel_valid):
+        # nodeSelector: ANDed exact matches.  (All-False sel_valid is
+        # pass-through; skipped when statically absent.)
+        f = jnp.take(resolved.found, batch.sel_qidx, axis=0)   # [B, S, N]
+        v = jnp.take(resolved.val, batch.sel_qidx, axis=0)
+        sel_ok = f & (v == batch.sel_val[:, :, None])
+        parts.append((sel_ok | ~batch.sel_valid[:, :, None]).all(axis=1))
 
-    # required affinity: OR over terms.
-    term_match, has_expr = match_expressions(
-        resolved,
-        batch.req_expr_valid,
-        batch.req_qidx,
-        batch.req_op,
-        batch.req_vals,
-        batch.req_num,
-    )  # term_match: [B, T, N]
-    live = batch.req_term_valid & has_expr                 # empty term matches nothing
-    any_term = (term_match & live[:, :, None]).any(axis=1)
-    has_terms = batch.req_term_valid.any(axis=1)
-    aff_pass = jnp.where(has_terms[:, None], any_term, True)
-    return sel_pass & aff_pass
+    if not _statically_empty(batch.req_term_valid):
+        # required affinity: OR over terms.  (No live terms means
+        # aff_pass is all-True; skipped when statically absent.)
+        term_match, has_expr = match_expressions(
+            resolved,
+            batch.req_expr_valid,
+            batch.req_qidx,
+            batch.req_op,
+            batch.req_vals,
+            batch.req_num,
+        )  # term_match: [B, T, N]
+        live = batch.req_term_valid & has_expr         # empty term matches nothing
+        any_term = (term_match & live[:, :, None]).any(axis=1)
+        has_terms = batch.req_term_valid.any(axis=1)
+        parts.append(jnp.where(has_terms[:, None], any_term, True))
+
+    if not parts:
+        n = table.name_id.shape[0]
+        return jnp.ones((batch.batch, n), jnp.bool_)
+    out = parts[0]
+    for p in parts[1:]:
+        out = out & p
+    return out
 
 
 def feasible_mask(table: NodeTable, batch: PodBatch, resolved: ResolvedKeys | None = None):

@@ -878,7 +878,10 @@ class HostFeed:
                 # by the cycle thread's queue; the worker must not
                 # assign p.pod (the one write ensure_pod would do).
                 packed = encode_batch(self.encoder, pods, mutate=False)
-            except Exception:  # graftlint: disable=broad-except (worker must stage None so the inline fallback reproduces the error on the cycle thread)
+            # Broad on purpose (log.exception satisfies the lint): the
+            # worker must stage None so the inline fallback reproduces
+            # the error on the cycle thread, where it can propagate.
+            except Exception:
                 log.exception("hotfeed worker encode failed; staging None")
                 packed = None
             with self._lock:

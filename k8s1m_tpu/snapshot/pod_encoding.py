@@ -333,7 +333,13 @@ def unpack_pod_batch(
     for name, is_bool, shape in batch_field_specs(spec, table_spec):
         group = _GROUP_OF.get(name)
         if group is not None and group not in groups:
-            out[name] = jnp.zeros(shape, jnp.bool_ if is_bool else jnp.int32)
+            # NUMPY zeros on purpose: under jit these lift to the same
+            # XLA constants jnp.zeros would, but they stay statically
+            # visible to the filter plugins' _statically_empty check
+            # (a jnp.zeros inside a trace is a tracer) — which is what
+            # lets absent groups skip at trace time instead of XLA
+            # constant-folding a [B, S, N] chain for minutes on CPU.
+            out[name] = np.zeros(shape, np.bool_ if is_bool else np.int32)
             continue
         n = math.prod(shape)
         if is_bool:

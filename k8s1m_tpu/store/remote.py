@@ -190,7 +190,10 @@ class RemoteWatcher:
                 # for a broken stream.
                 log.warning("watch stream ended by server")
                 self._dropped += 1
-            self.canceled = True
+            # Monotonic shutdown latch, raced benignly by cancel(): both
+            # writers only ever set True, and the worst interleaving is a
+            # second sentinel put, which the reader loop absorbs.
+            self.canceled = True  # graftlint: disable=static-guarded-by (monotonic bool latch; both writers set True)
             # Unblock gRPC's request-consumer thread even when the stream
             # died server-side (cancel() will never enqueue the sentinel
             # once self.canceled is set).

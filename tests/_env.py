@@ -1,0 +1,32 @@
+"""Shared test-environment probes (imported by test modules, not a
+test file itself)."""
+
+from __future__ import annotations
+
+import os
+
+
+def effective_cpus() -> int:
+    """Cores this process can actually burn: scheduler affinity capped
+    by the cgroup CPU quota (a 24-core host with a 1-core quota is a
+    1-core host for subprocess tiers and timing budgets)."""
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        n = os.cpu_count() or 1
+    try:                                   # cgroup v2
+        with open("/sys/fs/cgroup/cpu.max") as fh:
+            quota, period = fh.read().split()
+        if quota != "max":
+            n = min(n, max(1, int(quota) // int(period)))
+    except (OSError, ValueError):
+        try:                               # cgroup v1
+            with open("/sys/fs/cgroup/cpu/cpu.cfs_quota_us") as fh:
+                quota = int(fh.read())
+            with open("/sys/fs/cgroup/cpu/cpu.cfs_period_us") as fh:
+                period = int(fh.read())
+            if quota > 0:
+                n = min(n, max(1, quota // period))
+        except (OSError, ValueError):
+            pass
+    return n

@@ -1,0 +1,19 @@
+"""Fixture: a ``@guarded_by`` field read outside its declared lock
+(and outside any locked caller) — the static-guarded-by true positive."""
+import threading
+
+from k8s1m_tpu.lint import guarded_by
+
+
+@guarded_by(_items="_lock")
+class BadStage:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def peek(self):
+        return self._items

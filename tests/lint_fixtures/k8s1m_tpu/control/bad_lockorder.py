@@ -1,0 +1,19 @@
+"""Fixture: an A->B / B->A lock acquisition inversion — the seeded
+deadlock pair the lock-order-cycle pass must catch."""
+import threading
+
+
+class BadOrder:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                return 2

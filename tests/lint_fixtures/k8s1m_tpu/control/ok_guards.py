@@ -1,0 +1,18 @@
+"""Pragma twin: the same unguarded read, deliberately sanctioned."""
+import threading
+
+from k8s1m_tpu.lint import guarded_by
+
+
+@guarded_by(_items="_lock")
+class OkStage:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def peek(self):
+        return self._items  # graftlint: disable=static-guarded-by (len-only monitoring peek; torn read is benign)

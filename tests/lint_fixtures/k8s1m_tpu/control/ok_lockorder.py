@@ -1,0 +1,19 @@
+"""Pragma twin: the same inversion, deliberately sanctioned (distinct
+lock names so the two fixtures' graphs stay disjoint)."""
+import threading
+
+
+class OkOrder:
+    def __init__(self):
+        self._c = threading.Lock()
+        self._d = threading.Lock()
+
+    def cd(self):
+        with self._c:
+            with self._d:
+                return 1
+
+    def dc(self):
+        with self._d:
+            with self._c:  # graftlint: disable=lock-order-cycle (fixture: documented two-phase teardown, never concurrent with cd)
+                return 2

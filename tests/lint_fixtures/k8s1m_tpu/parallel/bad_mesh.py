@@ -1,0 +1,9 @@
+"""Fixture: per-shard PRNG key folding — the exact regression PR 6
+removed (fold_mesh_key) and the mesh-purity pass must reject."""
+import jax
+from jax import lax
+
+
+def local_step(key, b_local):
+    shard = lax.axis_index("dp")
+    return jax.random.fold_in(key, shard)

@@ -1,0 +1,8 @@
+"""Pragma twin: the same fold, deliberately sanctioned."""
+import jax
+from jax import lax
+
+
+def local_step(key, b_local):
+    shard = lax.axis_index("dp")
+    return jax.random.fold_in(key, shard)  # graftlint: disable=mesh-purity (fixture: decorative stream, never feeds tie-breaks)

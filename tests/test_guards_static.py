@@ -1,0 +1,280 @@
+"""graftsan: the static half of lock discipline, proven against the
+runtime half, plus the lock-order graph gates.
+
+Layers:
+
+1. **Differential static ⊇ runtime** — the contract between
+   rules_guards.py (AST) and lint/guards.py (instrumented runtime) is
+   that anything the auditor can catch under traffic, the static pass
+   catches with zero traffic.  Checked two ways: a seeded racy class is
+   flagged by BOTH halves with the same (class, field) verdict
+   (non-vacuous agreement), and on the real tree the instrumented
+   coordinator stress run records zero violations while the static pass
+   reports zero findings — superset holds at the fixed point both
+   should be at.
+2. **Lock-order graph** — the seeded A→B / B→A inversion fixture pair
+   is caught with both conflicting paths rendered; the committed
+   ``artifacts/lockgraph.json`` matches a fresh build of the tree
+   (regenerate with ``python -m k8s1m_tpu.lint --write-lockgraph`` when
+   a PR legitimately adds an acquisition order) and is cycle-free; and
+   the interprocedural edge the graph exists for (admission lock ->
+   metrics lock through ``_set_state``) is actually present — the
+   analysis has power, it is not vacuously empty.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+import pytest
+
+from k8s1m_tpu.lint import guards
+from k8s1m_tpu.lint.base import load_file
+from k8s1m_tpu.lint.cli import repo_root, run_lint
+from k8s1m_tpu.lint.lockgraph import LockModel, render_cycle
+from k8s1m_tpu.lint.rules_guards import StaticGuardedBy
+
+# One source, two analyses: exec'd for the runtime auditor, written to
+# a scratch tree for the static pass.  The bug is ``peek`` reading a
+# lock-guarded list with no lock and no locked caller.
+_RACY_SRC = '''\
+import threading
+
+from k8s1m_tpu.lint import guarded_by
+
+
+@guarded_by(_items="_lock")
+class SeededRacy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def peek(self):
+        return self._items
+'''
+
+
+def _static_pairs(root: str) -> set[tuple[str, str]]:
+    """(class, field) pairs the static pass flags under ``root``."""
+    result = run_lint(root=root, baseline_path="",
+                      rules=(StaticGuardedBy,))
+    pairs = set()
+    for fd in result.findings:
+        m = re.match(r"(\w+)\.(\w+) ", fd.message)
+        if m:
+            pairs.add((m.group(1), m.group(2)))
+    return pairs
+
+
+def test_seeded_race_flagged_by_both_halves(tmp_path):
+    """The same defect, found statically AND at runtime, named the same
+    way — the agreement that makes the differential meaningful."""
+    pkg = tmp_path / "k8s1m_tpu"
+    pkg.mkdir()
+    (pkg / "seeded_racy.py").write_text(_RACY_SRC)
+    static = _static_pairs(str(tmp_path))
+    assert static == {("SeededRacy", "_items")}
+
+    ns: dict = {}
+    exec(compile(_RACY_SRC, "<seeded_racy>", "exec"), ns)
+    with guards.audit():
+        box = ns["SeededRacy"]()
+        box.add(1)                       # locked path: clean
+        with pytest.raises(guards.GuardViolation):
+            box.peek()                   # unguarded read: caught live
+    runtime = set()
+    for v in guards.violations():
+        m = re.match(r"(\w+)\.(\w+) ", v)
+        if m:
+            runtime.add((m.group(1), m.group(2)))
+    assert runtime == {("SeededRacy", "_items")}
+    assert runtime <= static
+
+
+def test_static_superset_of_runtime_on_the_tree():
+    """Static findings ⊇ runtime findings on the instrumented stress
+    run: the coordinator/webhook/churn stress drives every annotated
+    class under guards.audit() and must record nothing the static pass
+    does not already rule out — on a clean tree, both sides are empty,
+    and the static side being pragma-accounted is exactly the
+    repo-lints-clean bar."""
+    import test_guard_stress
+
+    from k8s1m_tpu.faultline import install_plan
+
+    try:
+        (test_guard_stress
+         .test_instrumented_coordinator_stress_zero_violations())
+    finally:
+        install_plan(None)       # the module's autouse fixture, by hand
+    runtime = set()
+    for v in guards.violations():
+        m = re.match(r"(\w+)\.(\w+) ", v)
+        if m:
+            runtime.add((m.group(1), m.group(2)))
+
+    result = run_lint(root=repo_root(), rules=(StaticGuardedBy,))
+    static = set()
+    for fd in result.new:
+        m = re.match(r"(\w+)\.(\w+) ", fd.message)
+        if m:
+            static.add((m.group(1), m.group(2)))
+    assert runtime <= static
+    assert static == set()               # the tree itself is clean
+    assert runtime == set()
+
+
+def test_helper_reached_only_from_locked_callers_passes(tmp_path):
+    """The one-level propagation case: ``_set_state`` bodies (caller
+    must hold the lock) stay clean as long as EVERY intra-class call
+    site holds it — and break the moment one does not."""
+    good = (
+        "import threading\n"
+        "from k8s1m_tpu.lint import guarded_by\n"
+        "@guarded_by(state='_lock')\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.state = 0\n"
+        "    def _bump(self):\n"
+        "        self.state += 1\n"
+        "    def tick(self):\n"
+        "        with self._lock:\n"
+        "            self._bump()\n"
+    )
+    pkg = tmp_path / "k8s1m_tpu"
+    pkg.mkdir()
+    (pkg / "helper.py").write_text(good)
+    assert _static_pairs(str(tmp_path)) == set()
+
+    (pkg / "helper.py").write_text(
+        good + "    def sneak(self):\n        self._bump()\n"
+    )
+    assert _static_pairs(str(tmp_path)) == {("C", "state")}
+
+
+def test_thread_owner_flagged_in_thread_target(tmp_path):
+    """A THREAD_OWNER field touched from a Thread-target method is a
+    guaranteed cross-thread access: one static hit, no traffic needed."""
+    pkg = tmp_path / "k8s1m_tpu"
+    pkg.mkdir()
+    (pkg / "owner.py").write_text(
+        "import threading\n"
+        "from k8s1m_tpu.lint import guarded_by, THREAD_OWNER\n"
+        "@guarded_by(queue=THREAD_OWNER)\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.queue = []\n"
+        "        self._t = threading.Thread(target=self._worker)\n"
+        "    def _worker(self):\n"
+        "        self.queue.append(1)\n"
+    )
+    assert _static_pairs(str(tmp_path)) == {("C", "queue")}
+
+
+# ---- lock-order graph -------------------------------------------------
+
+
+def _model_of(*relpaths: str) -> LockModel:
+    root = repo_root()
+    files = [load_file(root, p) for p in relpaths]
+    return LockModel([f for f in files if f is not None])
+
+
+def test_seeded_deadlock_inversion_caught_with_both_paths():
+    """The fixture pair: an A→B / B→A inversion yields exactly one
+    cycle whose rendering names BOTH acquisition paths (the two stacks
+    an incident responder needs)."""
+    fix = os.path.join("tests", "lint_fixtures")
+    f = load_file(
+        os.path.join(repo_root(), fix), "k8s1m_tpu/control/bad_lockorder.py"
+    )
+    model = LockModel([f])
+    cycles = model.cycles()
+    assert len(cycles) == 1
+    text = render_cycle(cycles[0])
+    assert "BadOrder._a" in text and "BadOrder._b" in text
+    assert text.count("held at") == 2     # both conflicting paths shown
+
+
+def test_interprocedural_edge_is_live():
+    """The admission-lock -> metrics-lock edge (tick holds _admit_lock,
+    _set_state increments a Counter) must be in the graph: proof the
+    call-graph propagation works, so an inversion reached through a
+    helper would be caught too."""
+    model = _model_of(
+        "k8s1m_tpu/loadshed/controller.py", "k8s1m_tpu/obs/metrics.py"
+    )
+    edges = {(e.src, e.dst): e for e in model.edges}
+    key = (
+        "k8s1m_tpu/loadshed/controller.py::HealthController._admit_lock",
+        "k8s1m_tpu/obs/metrics.py::Metric._lock",
+    )
+    assert key in edges
+    assert any("_set_state" in step for step in edges[key].via)
+    assert model.cycles() == []
+
+
+def test_committed_lockgraph_artifact_is_current_and_cycle_free():
+    """artifacts/lockgraph.json == a fresh build of the tree: a PR that
+    adds an acquisition order must regenerate the artifact (the diff IS
+    the review surface), and the committed graph must be cycle-free."""
+    root = repo_root()
+    from k8s1m_tpu.lint.base import iter_py_files
+    from k8s1m_tpu.lint.cli import DEFAULT_SUBDIRS
+
+    files = [
+        f for f in (
+            load_file(root, p)
+            for p in iter_py_files(root, DEFAULT_SUBDIRS)
+        )
+        if f is not None
+    ]
+    model = LockModel(files)
+    fresh = model.to_json(files)
+    # Pragma-sanctioned cycles are allowed (the documented escape
+    # hatch); anything unsanctioned fails.
+    assert [c for c in fresh["cycles"] if not c["sanctioned"]] == []
+    with open(
+        os.path.join(root, "artifacts", "lockgraph.json"),
+        encoding="utf-8",
+    ) as fh:
+        committed = json.load(fh)
+    assert committed == fresh, (
+        "lockgraph drift: regenerate with "
+        "`python -m k8s1m_tpu.lint --write-lockgraph`"
+    )
+
+
+def test_lock_kind_gates_self_loops():
+    """Re-acquiring the SAME non-reentrant Lock through a self call is
+    flagged; the identical shape on an RLock is legal and is not."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.{kind}()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._lock:\n"
+        "            return 1\n"
+    )
+    import tempfile
+
+    for kind, ncycles in (("Lock", 1), ("RLock", 0)):
+        with tempfile.TemporaryDirectory() as d:
+            pkg = os.path.join(d, "k8s1m_tpu")
+            os.makedirs(pkg)
+            with open(os.path.join(pkg, "loop.py"), "w") as fh:
+                fh.write(src.format(kind=kind))
+            f = load_file(d, "k8s1m_tpu/loop.py")
+            model = LockModel([f])
+            assert len(model.cycles()) == ncycles, kind

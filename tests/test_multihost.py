@@ -21,9 +21,35 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = os.path.join(REPO, "tests", "multihost_child.py")
+
+
+def _cpu_multiprocess_unsupported() -> str | None:
+    """Why 2-process jax.distributed cannot run HERE, or None.
+
+    Keyed on the actual condition, not a blanket skip: the child
+    processes ALWAYS run on the CPU backend (``cleaned_cpu_env`` pins
+    them there regardless of the parent's accelerators), and jax < 0.5
+    raises "Multiprocess computations aren't implemented on the CPU
+    backend" at the first collective.  A jax new enough to route CPU
+    collectives through gloo runs the test for real.
+    """
+    import jax
+
+    try:
+        major, minor = (int(x) for x in jax.__version__.split(".")[:2])
+    except ValueError:
+        return None                      # unparseable: let the test run
+    if (major, minor) >= (0, 5):
+        return None
+    return (
+        f"jax {jax.__version__}: multiprocess computations not "
+        f"implemented on the CPU backend the children are pinned to "
+        f"(needs jax>=0.5)"
+    )
 
 
 def _free_port() -> int:
@@ -72,6 +98,9 @@ def _reference_digest():
 
 
 def test_two_process_distributed_step_matches_single_process():
+    reason = _cpu_multiprocess_unsupported()
+    if reason is not None:
+        pytest.skip(reason)
     from k8s1m_tpu.envboot import cleaned_cpu_env
 
     ref_digest, ref_bound = _reference_digest()

@@ -4,10 +4,45 @@
 #   tools/lint.sh                 # full tree, baseline honored, drift-checked
 #   tools/lint.sh --no-baseline   # every finding, grandfathered included
 #   tools/lint.sh path/to/file.py # one file
+#   tools/lint.sh --changed-only  # only files changed vs HEAD (pre-commit
+#                                 # fast path; the full-tree run stays the
+#                                 # tier-1/CI mode)
 #
 # Exit 0 = clean (every finding fixed, pragma'd, or baselined and the
 # committed lint_baseline.txt matches the tree exactly); nonzero fails
 # the build.  tests/test_lint.py runs the identical gate in tier-1.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m k8s1m_tpu.lint --check-baseline "$@"
+
+args=()
+changed_only=0
+for a in "$@"; do
+  if [[ "$a" == "--changed-only" ]]; then
+    changed_only=1
+  else
+    args+=("$a")
+  fi
+done
+
+if [[ "$changed_only" == 1 ]]; then
+  # Staged + unstaged + untracked .py files under the linted slice;
+  # deletions excluded (nothing to lint).  Baseline entries for files
+  # outside the subset are ignored by the driver, so this composes
+  # with --check-baseline.
+  mapfile -t files < <(
+    {
+      git diff --name-only --diff-filter=d HEAD -- '*.py'
+      git ls-files --others --exclude-standard -- '*.py'
+    } | sort -u | grep -E '^(k8s1m_tpu|tests)/' | grep -v '/lint_fixtures/' \
+      || true
+  )
+  if [[ ${#files[@]} -eq 0 ]]; then
+    echo "graftlint: no changed .py files (use the bare tools/lint.sh for"\
+         "the full tree)"
+    exit 0
+  fi
+  exec python -m k8s1m_tpu.lint --check-baseline "${args[@]+"${args[@]}"}" \
+    "${files[@]}"
+fi
+
+exec python -m k8s1m_tpu.lint --check-baseline "${args[@]+"${args[@]}"}"

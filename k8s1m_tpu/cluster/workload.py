@@ -3,9 +3,22 @@
 The reference floods the cluster with uniform pods carrying an owner-ref
 chain and schedulerName dist-scheduler (reference kwok/make_pods/main.go:109-172).
 Here a workload is a generator of PodInfo lists sized to the engine's batch.
+
+**Tenant dimension** (ROADMAP item 2): "millions of users" is thousands
+of tenants, not one queue.  ``zipf_weights`` / ``tenant_assignments``
+turn a pod-index sequence into a seed-deterministic tenant stream with
+zipf-skewed tenant sizes and three arrival shapes — ``steady`` (the
+mix is constant), ``diurnal`` (each tenant's offered rate follows a
+phase-shifted day curve), ``flash`` (tenant 0 flash-crowds to 10x its
+weight for the middle fifth of the sequence).  The paced producers in
+sched_bench/soak emit pods in index order, so position in the sequence
+IS arrival time and the schedules reproduce exactly by seed.
 """
 
 from __future__ import annotations
+
+import math
+import random
 
 from k8s1m_tpu.config import (
     SEL_OP_IN,
@@ -24,6 +37,82 @@ from k8s1m_tpu.snapshot.pod_encoding import (
     SelectorRequirement,
     SpreadConstraintRef,
 )
+
+
+TENANT_SCHEDULES = ("steady", "diurnal", "flash")
+
+
+def zipf_weights(tenants: int, skew: float = 1.0) -> list[float]:
+    """Zipf-skewed tenant sizes: weight of tenant t is 1/(t+1)^skew,
+    normalized to sum 1.  skew=0 is uniform; skew ~1 is the classic
+    heavy-head shape real multi-tenant traffic shows."""
+    if tenants < 1:
+        raise ValueError("tenants must be >= 1")
+    w = [1.0 / (t + 1) ** skew for t in range(tenants)]
+    s = sum(w)
+    return [x / s for x in w]
+
+
+def tenant_rate_multipliers(
+    schedule: str, frac: float, tenants: int
+) -> list[float]:
+    """Per-tenant offered-rate multiplier at position ``frac`` in [0,1)
+    of the sequence (multiplies the zipf base weight):
+
+    - ``steady``  — 1.0 everywhere.
+    - ``diurnal`` — 1 + 0.8*sin(2*pi*(2*frac + t/T)): two "days" over
+      the sequence, each tenant's peak phase-shifted, so tenant mixes
+      rotate the way timezone-spread user bases do.
+    - ``flash``   — tenant 0 jumps to 10x for frac in [0.4, 0.6): the
+      flash-crowd the weighted-fair admission must contain.
+    """
+    if schedule == "steady":
+        return [1.0] * tenants
+    if schedule == "diurnal":
+        return [
+            1.0 + 0.8 * math.sin(2.0 * math.pi * (2.0 * frac + t / tenants))
+            for t in range(tenants)
+        ]
+    if schedule == "flash":
+        m = [1.0] * tenants
+        if 0.4 <= frac < 0.6:
+            m[0] = 10.0
+        return m
+    raise ValueError(f"unknown tenant schedule {schedule!r} "
+                     f"(want one of {TENANT_SCHEDULES})")
+
+
+def tenant_assignments(
+    count: int,
+    tenants: int,
+    *,
+    skew: float = 1.0,
+    seed: int = 0,
+    schedule: str = "steady",
+) -> list[int]:
+    """Tenant id per pod index — deterministic by (seed, shape args).
+
+    The producers emit pods in index order at their paced rate, so the
+    index axis is the arrival-time axis: a diurnal mix or a flash crowd
+    lands in the right part of the run without any wall clock."""
+    base = zipf_weights(tenants, skew)
+    rng = random.Random(seed ^ 0x7E4A47)
+    ids = list(range(tenants))
+    out: list[int] = []
+    # Re-derive the mixture every 256 pods: plenty of resolution for
+    # schedules that vary over the whole sequence — and ONE weighted
+    # draw of the whole block (random.choices rebuilds its cumulative-
+    # weight table per call, so per-pod draws would cost
+    # O(count x tenants)).
+    step = 256
+    for off in range(0, count, step):
+        frac = off / max(count, 1)
+        mult = tenant_rate_multipliers(schedule, frac, tenants)
+        weights = [b * m for b, m in zip(base, mult)]
+        out.extend(rng.choices(
+            ids, weights=weights, k=min(step, count - off)
+        ))
+    return out
 
 
 def uniform_pods(

@@ -135,6 +135,13 @@ from k8s1m_tpu.snapshot.node_table import (
     scatter_rows,
 )
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
+from k8s1m_tpu.tenancy.gang import note_gang
+from k8s1m_tpu.tenancy.policy import gang_of_labels, tenant_of_key, tenant_of_pod
+from k8s1m_tpu.tenancy.preempt import (
+    Victim,
+    note_eviction,
+    select_preemption,
+)
 from k8s1m_tpu.store.native import (
     BIND_INVALID,
     POD_CANONICAL,
@@ -287,6 +294,14 @@ class PendingPod:
     # Earliest perf_counter() time this pod may re-enter a batch after a
     # retry (RetryPolicy backoff; 0 = immediately eligible).
     not_before: float = 0.0
+    # spec.priority — admission/preemption only (never encoded).  0 for
+    # native fast-lane pods: the canonical label-less shape cannot carry
+    # a priority, so the hot path needs no decode to know it.
+    priority: int = 0
+    # Gang membership (tenancy/gang.py): namespace-qualified gang id and
+    # declared size; "" / 0 = not a gang pod.
+    gang_id: str = ""
+    gang_size: int = 0
 
     def peek_pod(self) -> PodInfo:
         """The PodInfo WITHOUT caching it on the record — the hotfeed
@@ -326,6 +341,28 @@ def splice_node_name(raw: bytes, node_name: str) -> bytes | None:
     )
 
 
+_UNSPLICE_MARK = b'"spec":{"nodeName":"'
+
+
+def unsplice_node_name(raw: bytes) -> bytes | None:
+    """Inverse of ``splice_node_name``: remove the spliced spec.nodeName,
+    restoring the pre-bind bytes EXACTLY — the eviction path's byte-
+    identity half (an evicted pod's stored object equals its pre-bind
+    encoding, so evict+rebind replays are bytewise checkable).  None if
+    the object isn't in the spliced canonical shape (escaped name,
+    nodeName written elsewhere) — the caller falls back to the JSON
+    path."""
+    idx = raw.find(_UNSPLICE_MARK)
+    if idx < 0:
+        return None
+    start = idx + 8                    # keep b'"spec":{'
+    i = idx + len(_UNSPLICE_MARK)      # first byte of the name
+    j = raw.find(b'"', i)
+    if j < 0 or raw[j + 1 : j + 2] != b"," or b"\\" in raw[i:j]:
+        return None
+    return raw[:start] + raw[j + 2:]
+
+
 @guarded_by(
     # Webhook-thread <-> cycle-thread boundary: the staging list is the
     # ONLY coordinator state server threads may touch, and only under
@@ -341,6 +378,11 @@ def splice_node_name(raw: bytes, node_name: str) -> bytes | None:
     _dirty_rows=THREAD_OWNER,
     _dirty_caps=THREAD_OWNER,
     _midflight_rows=THREAD_OWNER,
+    # Tenancy state (gang staging/parking, per-bind priority metadata):
+    # cycle-thread-owned like the queue it feeds.
+    _gang_staging=THREAD_OWNER,
+    _gang_parked=THREAD_OWNER,
+    _bind_meta=THREAD_OWNER,
 )
 class Coordinator:
     """Single-process scheduling coordinator over an in-process store."""
@@ -380,6 +422,15 @@ class Coordinator:
         # scheduler while open.  None (the default) = none of that runs.
         loadshed: HealthController | None = None,
         breaker: CircuitBreaker | None = None,
+        # Tenancy (k8s1m_tpu/tenancy.TenancyController): weighted-fair
+        # per-tenant admission at submit_external (replacing loadshed's
+        # global priority floor), priority preemption (evict + requeue
+        # lower-priority bound pods when a high-priority pod finds no
+        # feasible row), and all-or-none gang scheduling.  When set
+        # without an explicit ``loadshed``, its HealthController is
+        # adopted as the loadshed controller too — one state machine
+        # drives degraded knobs and per-tenant gates.
+        tenancy=None,
         # Host feed (snapshot/hotfeed.py): encode batch N+1 in a worker
         # thread while batch N's wave is in flight, so encode_packed
         # leaves the cycle's serial section whenever the queue is deep
@@ -466,6 +517,21 @@ class Coordinator:
         # mode switch is a cached-executable swap, never a reconfigure
         # (warm both modes before a latency-sensitive window — each is
         # its own compiled step).
+        self.tenancy = tenancy
+        if tenancy is not None:
+            if loadshed is None:
+                loadshed = tenancy.controller
+            elif loadshed is not tenancy.controller:
+                # A second controller would never be ticked: its
+                # _admitted_since_tick would grow forever and hard-fail
+                # every admission with "cap" once it crossed queue_cap,
+                # while its state stayed HEALTHY so per-tenant fairness
+                # silently never engaged.
+                raise ValueError(
+                    "tenancy and loadshed must share one "
+                    "HealthController: pass loadshed=tenancy.controller "
+                    "or omit loadshed"
+                )
         self.loadshed = loadshed
         self.breaker = breaker
         if loadshed is not None:
@@ -598,6 +664,27 @@ class Coordinator:
         # keys stay in _queued_keys so watch echoes don't re-add them.
         self._backoff: list[tuple[float, int, PendingPod]] = []
         self._backoff_seq = 0
+        # Gang staging (tenancy/gang.py): gid -> (declared size, members
+        # by key).  Members enter the queue contiguously only when the
+        # whole gang is present; incomplete gangs hold no capacity.
+        self._gang_staging: dict[str, tuple[int, dict[str, PendingPod]]] = {}
+        # Gangs waiting out a whole-group retry backoff:
+        # (not_before, seq, members) min-heap, released contiguously.
+        self._gang_parked: list[tuple[float, int, list[PendingPod]]] = []
+        self._gang_oversize: set[str] = set()
+        # Per-bound-pod preemption metadata:
+        # key -> (priority, bind seq, tenant, gang id).  Parallel to
+        # _bound (same insert/delete sites) so victim selection never
+        # decodes stored objects; the tenant is captured at bind time
+        # (the label override would otherwise be lost for pods whose
+        # PodInfo is not retained), and a nonempty gang id marks the
+        # pod unpreemptable — evicting one member would strand the rest
+        # of its gang bound, the exact state gangs exist to prevent.
+        self._bind_meta: dict[str, tuple[int, int, str, str]] = {}
+        self._bind_seq = 0
+        # Replayable preemption evidence (populated only when
+        # tenancy.policy.log_preemptions; bounded).
+        self.preempt_log: list[dict] = []
         # Seeded jitter stream so a replayed fault plan replays the same
         # backoff schedule (determinism-by-seed, faultline contract).
         self._retry_rng = random.Random(seed ^ 0xFA017)
@@ -742,6 +829,12 @@ class Coordinator:
         zone, region = int(self.host.zone[row]), int(self.host.region[row])
         keep = pod if self._constraintful(pod) else None
         self._bound[pod.key] = (node_name, pod.cpu_milli, pod.mem_kib, zone, region, keep)
+        self._bind_seq += 1
+        gang = gang_of_labels(pod.labels, pod.namespace)
+        self._bind_meta[pod.key] = (
+            pod.priority, self._bind_seq, tenant_of_pod(pod),
+            gang[0] if gang is not None else "",
+        )
         if external and keep is not None and self.constraints is not None:
             # An externally bound pod contributes to domain counts exactly
             # like upstream's cache AddPod feeds plugin pre-state.
@@ -798,19 +891,31 @@ class Coordinator:
             # would have been placed against inflated usage meanwhile).
             return
         self._queued_keys.add(pod.key)
-        self.queue.append(
+        self._stage_or_queue(
             PendingPod(
                 pod, mod_revision, time.perf_counter(),
                 cpu_milli=pod.cpu_milli, mem_kib=pod.mem_kib,
                 key_str=pod.key, raw=data,
                 key_bytes=key or pod_key(pod.namespace, pod.name),
-            )
+                priority=pod.priority,
+            ),
+            pod,
         )
 
     def _on_pod_delete(self, key: bytes) -> None:
         pod_key_str = key[len(PODS_PREFIX):].decode()
         self._queued_keys.discard(pod_key_str)
         self._orphan_bound.pop(pod_key_str, None)
+        self._bind_meta.pop(pod_key_str, None)
+        if self._gang_staging:
+            # A deleted member must leave gang staging too: a leaked
+            # record would count into the load signal forever and, if
+            # the gang later completed, ride a wave as a dead pod.
+            for gid, (_size, members) in list(self._gang_staging.items()):
+                if members.pop(pod_key_str, None) is not None:
+                    if not members:
+                        del self._gang_staging[gid]
+                    break
         bound = self._bound.pop(pod_key_str, None)
         if bound is not None:
             node_name, cpu, mem, zone, region, keep = bound
@@ -1325,11 +1430,21 @@ class Coordinator:
         ``admitted=True`` is the webhook's already-ran-admission marker
         (it checks pre-response so it can answer 429) — one pod must
         never draw, and count, two admission decisions.
+
+        With a tenancy controller installed, admission is the
+        weighted-fair per-tenant form (tenancy/admission.py): the
+        global priority floor is replaced by token buckets, so overload
+        degrades the over-share tenant instead of the cluster.
         """
-        if not admitted and self.loadshed is not None:
-            self.loadshed.check_admit(
-                pod_priority_of(obj), point="coordinator"
-            )
+        if not admitted:
+            if self.tenancy is not None:
+                self.tenancy.admission.check_admit_obj(
+                    obj, point="coordinator"
+                )
+            elif self.loadshed is not None:
+                self.loadshed.check_admit(
+                    pod_priority_of(obj), point="coordinator"
+                )
         with self._external_lock:
             self._external.append(obj)
 
@@ -1361,14 +1476,383 @@ class Coordinator:
             if pod.key in self._queued_keys or pod.key in self._bound:
                 continue
             self._queued_keys.add(pod.key)
-            self.queue.append(
+            self._stage_or_queue(
                 PendingPod(
                     pod, None, time.perf_counter(),
                     cpu_milli=pod.cpu_milli, mem_kib=pod.mem_kib,
                     key_str=pod.key,
                     key_bytes=pod_key(pod.namespace, pod.name),
-                )
+                    priority=pod.priority,
+                ),
+                pod,
             )
+
+    # ---- tenancy: gang staging, eviction, preemption --------------------
+
+    def _stage_or_queue(self, rec: PendingPod, pod: PodInfo | None) -> None:
+        """Queue a decoded intake pod — via gang staging when it carries
+        gang labels and tenancy is on.  A gang's members enter the queue
+        contiguously only once ALL are present; until then they hold no
+        queue slot and no capacity.  Oversize gangs (bigger than one
+        wave) degrade to plain scheduling, counted once per gang."""
+        tn = self.tenancy
+        if tn is not None and tn.policy.gang_enabled and pod is not None:
+            g = gang_of_labels(pod.labels, pod.namespace)
+            if g is not None:
+                gid, size = g
+                if size > self.pod_spec.batch:
+                    if gid not in self._gang_oversize:
+                        if len(self._gang_oversize) >= 1024:
+                            # Bounded dedup memory: gang ids churn with
+                            # namespaces; resetting just re-counts a
+                            # repeat offender once more.
+                            self._gang_oversize.clear()
+                        self._gang_oversize.add(gid)
+                        note_gang("oversize")
+                        log.warning(
+                            "gang %s size %d exceeds wave batch %d; "
+                            "scheduling its pods as plain",
+                            gid, size, self.pod_spec.batch,
+                        )
+                else:
+                    rec.gang_id, rec.gang_size = gid, size
+                    st = self._gang_staging.get(gid)
+                    if st is None:
+                        st = self._gang_staging[gid] = (size, {})
+                    st[1][rec.key_str] = rec
+                    if len(st[1]) >= st[0]:
+                        del self._gang_staging[gid]
+                        self.queue.extend(st[1].values())
+                    return
+        self.queue.append(rec)
+
+    def _gang_staged(self) -> int:
+        """Pods parked in gang staging (counts toward the load signal —
+        they are demand the cluster has accepted but not yet queued)."""
+        return sum(len(st[1]) for st in self._gang_staging.values())
+
+    def _evict_bound(
+        self,
+        key_str: str,
+        *,
+        into: PendingPod | None = None,
+        adjust: bool = True,
+        count_eviction: bool = True,
+    ) -> PendingPod | None:
+        """CAS a bound pod's stored object back to pending and undo its
+        host-mirror accounting — the eviction half of preemption and of
+        gang all-or-none release.
+
+        The byte-level inverse of the bind: a spliced object is
+        un-spliced (stored bytes return EXACTLY to their pre-bind
+        encoding), anything else takes the JSON path.  The freed row is
+        marked dirty so the next sync re-uploads host truth — in-flight
+        waves keep their pipedream guarantees (a reclaimed row is never
+        aliased: rows are not removed here, only their usage shrinks,
+        which is the conservative direction for any wave in flight).
+
+        Returns ``(evicted, rec)``: ``evicted`` reports whether the
+        bind was actually reverted (callers MUST account on this flag —
+        a post-eviction deletion still reverted the bind even though no
+        requeue record exists); ``rec`` is the requeue-ready PendingPod
+        at the post-eviction revision (``into`` refreshed in place when
+        given), or None when there is nothing left to requeue (already
+        unbound, deleted, or a persistent concurrent writer — the watch
+        stream settles whatever remains).  The CAS retries a few times
+        against fresh revisions so a racing status writer cannot leave
+        a gang member half-released.  ``adjust=False`` is for
+        wave-local gang release, where the caller rolls the device
+        constraint commit back through the wave's own failed-mask
+        instead.
+        """
+        rec = self._bound.get(key_str)
+        if rec is None:
+            return False, None
+        node_name, cpu, mem, zone, region, keep = rec
+        ns, name = key_str.split("/", 1)
+        kb = pod_key(ns, name)
+        ok = False
+        for _attempt in range(3):
+            cur = self.store.get(kb)
+            if cur is None:
+                return False, None
+            value = unsplice_node_name(cur.value)
+            if value is None:
+                try:
+                    obj = json.loads(cur.value)
+                except Exception:
+                    _DECODE_ERRORS.inc(kind="pod")
+                    log.exception(
+                        "undecodable bound pod at eviction; skipping"
+                    )
+                    return False, None
+                obj.get("spec", {}).pop("nodeName", None)
+                value = json.dumps(obj, separators=(",", ":")).encode()
+            ok, _, _ = self.store.cas(kb, value, required_mod=cur.mod_revision)
+            if ok:
+                break
+        if not ok:
+            return False, None
+        self._bound.pop(key_str, None)
+        self._bind_meta.pop(key_str, None)
+        if node_name in self.host._row_of:
+            self.host.remove_pod(node_name, cpu, mem)
+            self._dirty_rows.add(self.host.row_of(node_name))
+        if adjust and keep is not None and self.constraints is not None:
+            self._pending_adjusts.append((keep, node_name, zone, region, -1))
+        if count_eviction:
+            note_eviction()
+        fresh = self.store.get(kb)
+        if fresh is None:
+            # Deleted between the CAS and the re-get: the bind WAS
+            # reverted; there is just nothing to requeue.
+            return True, None
+        p = into
+        if p is None:
+            pod = decode_pod(fresh.value, self.tracker)
+            p = PendingPod(
+                pod, fresh.mod_revision, time.perf_counter(),
+                cpu_milli=pod.cpu_milli, mem_kib=pod.mem_kib,
+                key_str=key_str, raw=fresh.value, key_bytes=kb,
+                priority=pod.priority,
+            )
+        else:
+            p.mod_revision = fresh.mod_revision
+            p.raw = fresh.value
+        self._queued_keys.add(key_str)
+        return True, p
+
+    def _preempt_eligible(self, p: PendingPod) -> bool:
+        """Cheap gates before any preemption work happens for a pod."""
+        tn = self.tenancy
+        return (
+            tn is not None
+            and tn.policy.preempt_enabled
+            and p.priority >= tn.policy.preempt_min_priority
+            and p.attempts + 1 >= tn.policy.preempt_after_attempts
+        )
+
+    def _victims_index(self) -> dict[int, list[Victim]]:
+        """All preemptable bound pods grouped by row — built at most
+        ONCE per wave (the O(bound pods) scan must not repeat per
+        failing preemptor; select_preemption applies the per-preemptor
+        priority filter itself).  Gang-bound pods are excluded: evicting
+        one member would strand its gang bound — the exact partial
+        state gangs exist to prevent."""
+        victims_by_row: dict[int, list[Victim]] = {}
+        row_of = self.host._row_of
+        for key, rec in self._bound.items():
+            meta = self._bind_meta.get(key)
+            if meta is None:
+                prio, seq, tenant, gang = 0, 0, tenant_of_key(key), ""
+            else:
+                prio, seq, tenant, gang = meta
+            if gang:
+                continue
+            node_name = rec[0]
+            row = row_of.get(node_name)
+            if row is None:
+                continue
+            victims_by_row.setdefault(row, []).append(Victim(
+                key, node_name, row, rec[1], rec[2], prio, seq, tenant,
+            ))
+        return victims_by_row
+
+    def _try_preempt(
+        self, p: PendingPod, victims_by_row: dict[int, list[Victim]]
+    ) -> bool:
+        """Preemption for a pod the wave found no feasible row for:
+        select victims (tenancy/preempt.py — lowest priority first,
+        other-tenant before same-tenant, newest bind first; gang-bound
+        pods never selected), evict them through the store CAS +
+        dirty-row machinery, bind the preemptor host-side on the
+        cleared node (argmax-free: the selected node IS the placement,
+        a pure function of the host mirror, which is what makes the
+        drill's byte-identical replay possible), and requeue every
+        victim.  ``victims_by_row`` is the caller's per-wave index
+        (_victims_index); successfully evicted victims are removed from
+        it so later preemptors in the same wave see current state.
+        Returns True when the preemptor bound."""
+        tn = self.tenancy
+        pod = p.ensure_pod()
+        tenant = tenant_of_pod(pod)
+        nodes = self._fallback_nodes()
+        if not nodes:
+            return False
+        host = self.host
+        usage = {
+            row: (
+                int(host.cpu_req[row]), int(host.mem_req[row]),
+                int(host.pods_req[row]),
+            )
+            for row, _ in nodes
+        }
+        choice = select_preemption(
+            pod, tenant, p.priority, nodes, usage, victims_by_row,
+        )
+        if choice is None:
+            return False
+        if tn.policy.log_preemptions and len(self.preempt_log) < 1024:
+            self.preempt_log.append({
+                "pod": p.key_str,
+                "priority": p.priority,
+                "tenant": tenant,
+                "node": choice.node,
+                "row": choice.row,
+                "victims": [v.key for v in choice.victims],
+                "usage": {str(r): list(u) for r, u in usage.items()},
+                "candidates": {
+                    str(r): [dataclasses.astuple(v) for v in vs]
+                    for r, vs in victims_by_row.items()
+                },
+            })
+        for v in choice.victims:
+            evicted, rec = self._evict_bound(v.key)
+            if not evicted:
+                # A persistent concurrent writer beat the eviction CAS:
+                # abort this attempt (capacity already freed stays
+                # freed — the requeued victims rebind elsewhere); the
+                # preemptor retries through the normal path.
+                return False
+            if rec is not None:
+                self.queue.append(rec)
+            # Keep the caller's per-wave index current for the next
+            # preemptor: this pod is no longer bound.
+            vs = victims_by_row.get(v.row)
+            if vs is not None:
+                victims_by_row[v.row] = [x for x in vs if x.key != v.key]
+        if not self._bind(p, choice.node):
+            return False
+        _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
+        # The device never committed this bind: same repair contract as
+        # the breaker fallback — dirty the row, queue the constraint
+        # correction a device commit would have applied.
+        self._dirty_rows.add(choice.row)
+        if self.constraints is not None:
+            rec = self._bound.get(p.key_str)
+            if rec is not None and rec[5] is not None:
+                self._pending_adjusts.append(
+                    (rec[5], rec[0], rec[3], rec[4], 1)
+                )
+        return True
+
+    def _wave_fail(self, p: PendingPod) -> None:
+        """Per-pod wave failure: gang members defer to the gang's
+        all-or-none settlement (_resolve_gangs requeues the group as a
+        unit); everything else takes the normal retry/backoff path."""
+        if self.tenancy is not None and p.gang_id:
+            return
+        self._retry(p)
+
+    def _resolve_gangs(self, batch_pods, bound_ok, rows, failed) -> int:
+        """All-or-none gang settlement at wave retire: a gang with every
+        member bound is admitted; any failure releases every provisional
+        bind (store CAS back to pending, host accounting undone) and
+        requeues the gang as a unit — partial capacity never survives
+        the wave-epoch window this wave retired in.  Returns the number
+        of reverted binds (the caller subtracts them from its bound
+        count so drivers' ledgers stay truthful).
+
+        ``rows`` distinguishes device-committed binds (row >= 0: the
+        wave's constraint commit is rolled back via ``failed``) from
+        host-side preemption binds (row < 0: rolled back through the
+        queued-adjust path, mirroring the +1 the preempt bind queued).
+        """
+        if self.tenancy is None or not self.tenancy.policy.gang_enabled:
+            return 0
+        gangs: dict[str, list[int]] = {}
+        for i, p in enumerate(batch_pods):
+            if p.gang_id:
+                gangs.setdefault(p.gang_id, []).append(i)
+        reverted = 0
+        for idxs in gangs.values():
+            if all(bound_ok[i] for i in idxs):
+                note_gang("bound")
+                continue
+            members = []
+            for i in idxs:
+                p = batch_pods[i]
+                if bound_ok[i]:
+                    device_committed = bool(rows[i] >= 0)
+                    evicted, _rec = self._evict_bound(
+                        p.key_str, into=p,
+                        adjust=not device_committed,
+                        count_eviction=False,
+                    )
+                    if evicted:
+                        # Settle on the FLAG, not the requeue record: a
+                        # member deleted right after the eviction CAS
+                        # still had its bind (and constraint commit)
+                        # reverted and must not stay counted as bound.
+                        reverted += 1
+                        bound_ok[i] = False
+                        if device_committed:
+                            failed[i] = True
+                    elif p.key_str in self._bound:
+                        # Eviction persistently lost: the member stays
+                        # bound — keep it OUT of the requeue so the
+                        # all-or-none contract degrades loudly instead
+                        # of double-scheduling a still-bound pod.
+                        log.warning(
+                            "gang member %s could not be released "
+                            "(eviction CAS lost); leaving it bound",
+                            p.key_str,
+                        )
+                        continue
+                members.append(p)
+            self._requeue_gang(members)
+        return reverted
+
+    def _requeue_gang(self, members: list[PendingPod]) -> None:
+        """Requeue a failed gang as a unit: refresh every member from
+        the store (same contract as _retry — a stale revision or an
+        external bind must not ride into the next wave), then either
+        park the whole gang unschedulable (retry budget spent) or heap
+        it for a shared backoff and contiguous re-entry."""
+        alive: list[PendingPod] = []
+        for p in members:
+            p.attempts += 1
+            cur = self.store.get(p.key_bytes)
+            if cur is None:
+                self._queued_keys.discard(p.key_str)
+                continue
+            fresh = decode_pod(cur.value, self.tracker)
+            if fresh.node_name:
+                # Bound externally while we were settling: theirs now.
+                self._queued_keys.discard(p.key_str)
+                continue
+            p.pod = fresh
+            p.cpu_milli = fresh.cpu_milli
+            p.mem_kib = fresh.mem_kib
+            p.mod_revision = cur.mod_revision
+            p.raw = cur.value
+            p.priority = fresh.priority
+            alive.append(p)
+        if not alive:
+            return
+        pol = self.retry_policy
+        worst = max(p.attempts for p in alive)
+        if worst >= pol.max_attempts:
+            for p in alive:
+                _PODS_SCHEDULED.inc(outcome="unschedulable")
+                note_give_up("coordinator.bind")
+                self.unschedulable[p.key_str] = p.ensure_pod()
+                # Keys stay held: the eviction echo of a released
+                # provisional bind must not resurrect a parked gang
+                # member as a plain pod (deletion still clears the key).
+                self._queued_keys.add(p.key_str)
+            note_gang("parked")
+            return
+        for p in alive:
+            _PODS_SCHEDULED.inc(outcome="retry")
+            note_retry("coordinator.bind")
+            self._queued_keys.add(p.key_str)
+        self._backoff_seq += 1
+        heapq.heappush(self._gang_parked, (
+            time.perf_counter() + pol.delay_for(worst, self._retry_rng),
+            self._backoff_seq, alive,
+        ))
+        note_gang("requeued")
 
     def _encoder_for(self, n: int) -> PodBatchHost:
         """Smallest power-of-two batch bucket holding n pods (clamped to
@@ -1396,21 +1880,31 @@ class Coordinator:
         return enc
 
     def _release_backoff(self) -> None:
-        """Move retrying pods whose backoff has expired into the queue."""
-        if not self._backoff:
+        """Move retrying pods (and whole parked gangs) whose backoff has
+        expired into the queue; gang members re-enter contiguously so
+        they still ride one wave."""
+        if not self._backoff and not self._gang_parked:
             return
         now = time.perf_counter()
         while self._backoff and self._backoff[0][0] <= now:
             _, _, p = heapq.heappop(self._backoff)
             self.queue.append(p)
+        while self._gang_parked and self._gang_parked[0][0] <= now:
+            _, _, members = heapq.heappop(self._gang_parked)
+            self.queue.extend(members)
 
     def backoff_wait_s(self) -> float | None:
-        """Seconds until the earliest parked retry is due (None when no
-        pod is backing off) — drivers idle-wait on this instead of
-        spinning cycles against an empty queue."""
-        if not self._backoff:
+        """Seconds until the earliest parked retry (pod or gang) is due
+        (None when nothing is backing off) — drivers idle-wait on this
+        instead of spinning cycles against an empty queue."""
+        heads = []
+        if self._backoff:
+            heads.append(self._backoff[0][0])
+        if self._gang_parked:
+            heads.append(self._gang_parked[0][0])
+        if not heads:
             return None
-        return max(0.0, self._backoff[0][0] - time.perf_counter())
+        return max(0.0, min(heads) - time.perf_counter())
 
     def _take_batch(self):
         """Pop and encode up to one batch of pending pods; (None, None)
@@ -1422,8 +1916,21 @@ class Coordinator:
         if not self.queue:
             return None, None
         batch_pods: list[PendingPod] = []
+        cur_gang = ""
         while self.queue and len(batch_pods) < self.pod_spec.batch:
+            head = self.queue[0]
+            if (
+                head.gang_id
+                and head.gang_id != cur_gang
+                and head.gang_size > self.pod_spec.batch - len(batch_pods)
+            ):
+                # A gang never splits across a batch boundary: close the
+                # batch early and let the gang open the next wave whole.
+                break
+            cur_gang = head.gang_id
             batch_pods.append(self.queue.popleft())
+        if not batch_pods:
+            return None, None
         # graftlint: disable=hotfeed-no-per-pod-python (O(pods) set bookkeeping for popped keys)
         for p in batch_pods:
             self._queued_keys.discard(p.key_str)
@@ -1512,14 +2019,27 @@ class Coordinator:
         conflicts = _PODS_SCHEDULED.value(outcome="conflict")
         resyncs = _RESYNCS.value()
         ls.tick(Signals(
-            queue_depth=len(self.queue) + self._external_pending(),
-            backoff_depth=len(self._backoff),
+            # Staged gang members are accepted demand too — a thousand
+            # half-assembled gangs must register as load, not hide.
+            queue_depth=(
+                len(self.queue) + self._external_pending()
+                + self._gang_staged()
+            ),
+            backoff_depth=(
+                len(self._backoff)
+                + sum(len(m) for _, _, m in self._gang_parked)
+            ),
             conflicts=int(conflicts - self._sig_conflicts),
             resyncs=int(resyncs - self._sig_resyncs),
             cycle_s=self._last_cycle_s,
         ))
         self._sig_conflicts = conflicts
         self._sig_resyncs = resyncs
+        if self.tenancy is not None:
+            # Refill the per-tenant admission buckets: this cycle's
+            # admit budget is one wave's worth of pods, split by weight
+            # over the tenants that actually offered load.
+            self.tenancy.admission.tick(capacity=self.pod_spec.batch)
 
     def _requeue_front(self, batch_pods) -> None:
         """Put an un-launched batch back at the head of the queue (the
@@ -1536,7 +2056,29 @@ class Coordinator:
         cycles where the system is already struggling."""
         self._release_backoff()
         pods: list[PendingPod] = []
+        cur_gang = ""
+        rotated: set[str] = set()
         while self.queue and len(pods) < n:
+            head = self.queue[0]
+            if (
+                head.gang_id
+                and head.gang_id != cur_gang
+                and head.gang_size > n - len(pods)
+            ):
+                if pods or head.gang_id in rotated:
+                    break
+                # Emergency lane: a gang that can NEVER fit this cap
+                # (fallback_batch < gang size) must not wedge the queue
+                # behind it for the whole breaker-open window — rotate
+                # it to the back intact and keep draining.  Once per
+                # gang per call, so a gang-only queue still terminates.
+                rotated.add(head.gang_id)
+                moved: list[PendingPod] = []
+                while self.queue and self.queue[0].gang_id == head.gang_id:
+                    moved.append(self.queue.popleft())
+                self.queue.extend(moved)
+                continue
+            cur_gang = head.gang_id
             p = self.queue.popleft()
             self._queued_keys.discard(p.key_str)
             pods.append(p)
@@ -1600,8 +2142,9 @@ class Coordinator:
             self.profile.taint_toleration, self.profile.node_affinity,
         )
         nbound = 0
+        bound_ok = np.zeros(len(take), bool)
         with _CYCLE_TIME.time(stage="fallback"):
-            for p in take:
+            for pi, p in enumerate(take):
                 pod = p.ensure_pod()
                 best_row, best_score, best_name = -1, -1, None
                 for row, nd in nodes:
@@ -1619,9 +2162,10 @@ class Coordinator:
                     if s > best_score:
                         best_row, best_score, best_name = row, s, nd.name
                 if best_name is None or not self._bind(p, best_name):
-                    self._retry(p)
+                    self._wave_fail(p)
                     continue
                 nbound += 1
+                bound_ok[pi] = True
                 FALLBACK_BINDS.inc()
                 _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
                 # The device table never committed this bind: dirty the
@@ -1635,6 +2179,13 @@ class Coordinator:
                         self._pending_adjusts.append(
                             (rec[5], rec[0], rec[3], rec[4], 1)
                         )
+            # Fallback binds are host-side (no device commit): gang
+            # settlement releases through the queued-adjust path.
+            nbound -= self._resolve_gangs(
+                take, bound_ok,
+                np.full(len(take), -1, np.int64),
+                np.zeros(len(take), bool),
+            )
         return nbound
 
     def _complete(self, inflight: Wave) -> int:
@@ -1652,6 +2203,9 @@ class Coordinator:
 
         nbound = 0
         failed = np.zeros(batch.batch, bool)
+        # Per-pod settled outcome (True = the bind stuck), consumed by
+        # the gang all-or-none settlement after the bind stage.
+        bound_ok = np.zeros(batch.batch, bool)
         bind_batch = getattr(self.store, "bind_batch", None)
         host = self.host
         with self._stage("bind"):
@@ -1665,8 +2219,11 @@ class Coordinator:
             nb = len(batch_pods)
             rows = node_row[:nb]
             bound_idx = np.nonzero(rows >= 0)[0]
-            for i in np.nonzero(rows < 0)[0].tolist():
-                self._retry(batch_pods[i])
+            # No-feasible-row pods are settled AFTER the wave's binds
+            # land in the host mirror (below): preemption's usage
+            # snapshot must include this wave's own placements, or the
+            # preemptor can overcommit a node the wave is about to fill.
+            nofit = np.nonzero(rows < 0)[0].tolist()
             brows = rows[bound_idx]
             # Rows tombstoned while this wave was in flight: the node is
             # gone (quarantine guarantees no reuse before this retire, so
@@ -1679,7 +2236,7 @@ class Coordinator:
                 if not alive.all():
                     for i in bound_idx[~alive].tolist():
                         failed[i] = True
-                        self._retry(batch_pods[i])
+                        self._wave_fail(batch_pods[i])
                     bound_idx = bound_idx[alive]
                     brows = brows[alive]
             nbytes = self._node_name_bytes()
@@ -1710,7 +2267,7 @@ class Coordinator:
                         name = nbytes[ids_l[j]].decode()
                         self._dirty_rows.add(host.row_of(name))
                         failed[i] = True
-                        self._retry(p)
+                        self._wave_fail(p)
                         continue
                     wave_j.append(j)
                     entries.append((p.key_bytes, p.mod_revision, nbytes[ids_l[j]]))
@@ -1718,6 +2275,7 @@ class Coordinator:
                 name = nbytes[ids_l[j]].decode()
                 if self._bind(p, name):
                     nbound += 1
+                    bound_ok[i] = True
                     _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
                     if brows_l[j] in self._midflight_rows:
                         # A mid-flight full scatter erased this wave's
@@ -1733,7 +2291,7 @@ class Coordinator:
                 # rolled back below in one signed scatter.
                 self._dirty_rows.add(host.row_of(name))
                 failed[i] = True
-                self._retry(p)
+                self._wave_fail(p)
             if entries:
                 if self._bind_excludes:
                     results = self.store.bind_batch(
@@ -1752,6 +2310,7 @@ class Coordinator:
                     i = bound_l[j]
                     p = batch_pods[i]
                     if rev > 0:
+                        bound_ok[i] = True
                         ok_rows.append(brows_l[j])
                         ok_cpu.append(p.cpu_milli)
                         ok_mem.append(p.mem_kib)
@@ -1765,10 +2324,23 @@ class Coordinator:
                             nv[ids_l[j]], p.cpu_milli, p.mem_kib,
                             zones[j], regions[j], keep,
                         )
+                        self._bind_seq += 1
+                        # bind_batch takes ANY pod with an observed
+                        # revision, decoded or not: a decoded PodInfo
+                        # supplies the label-aware tenant; the true
+                        # fast-lane (pod=None) is label-less canonical,
+                        # so its key namespace IS the tenant.
+                        self._bind_meta[p.key_str] = (
+                            p.priority, self._bind_seq,
+                            tenant_of_pod(p.pod) if p.pod is not None
+                            else tenant_of_key(p.key_str),
+                            p.gang_id,
+                        )
                         continue
                     name = nbytes[ids_l[j]].decode()
                     if rev == BIND_INVALID and self._bind(p, name):
                         nbound += 1
+                        bound_ok[i] = True
                         _BIND_LATENCY.observe(now - p.enqueued_at)
                         if brows_l[j] in self._midflight_rows:
                             self._dirty_rows.add(brows_l[j])
@@ -1777,7 +2349,7 @@ class Coordinator:
                         _PODS_SCHEDULED.inc(outcome="conflict")
                     self._dirty_rows.add(host.row_of(name))
                     failed[i] = True
-                    self._retry(p)
+                    self._wave_fail(p)
                 if ok_rows:
                     # Duplicate rows (two pods on one node) accumulate
                     # correctly under np.add.at.
@@ -1796,6 +2368,28 @@ class Coordinator:
                             rr for rr in ok_rows
                             if rr in self._midflight_rows
                         )
+            # Preemption pass — after every CAS bind above, so the host
+            # mirror (and so the feasibility snapshot) reflects this
+            # wave's placements.  The victims index is built lazily, at
+            # most once per wave, and kept current across this wave's
+            # preemptions.
+            vindex = None
+            for i in nofit:
+                p = batch_pods[i]
+                if self._preempt_eligible(p):
+                    if vindex is None:
+                        vindex = self._victims_index()
+                    if self._try_preempt(p, vindex):
+                        bound_ok[i] = True
+                        nbound += 1
+                        continue
+                self._wave_fail(p)
+            # Gang all-or-none settlement — inside the wave-epoch window
+            # (before this retire returns): partially-bound gangs release
+            # every provisional bind and requeue whole.  Runs before the
+            # failed-mask rollback below so released device-committed
+            # binds ride the same signed constraint scatter.
+            nbound -= self._resolve_gangs(batch_pods, bound_ok, rows, failed)
         if failed.any() and self.constraints is not None:
             m = jnp.asarray(failed)
             self.constraints = self._adjust(
@@ -2138,6 +2732,7 @@ class Coordinator:
         p.cpu_milli = fresh.cpu_milli
         p.mem_kib = fresh.mem_kib
         p.key_str = fresh.key
+        p.priority = fresh.priority
         p.mod_revision = cur.mod_revision
         # Refresh the splice-source bytes too — stale raw at the new
         # revision would CAS the OLD object body back in, silently
@@ -2174,10 +2769,11 @@ class Coordinator:
             n = self.step()
             total += n
             if not self.queue and not self._inflights:
-                if self._backoff:
-                    # Retrying pods are parked on a timer, not idle:
-                    # wait out the earliest backoff instead of burning
-                    # empty cycles (or worse, exiting with work pending).
+                if self._backoff or self._gang_parked:
+                    # Retrying pods (and parked gangs) are on a timer,
+                    # not idle: wait out the earliest backoff instead of
+                    # burning empty cycles (or worse, exiting with work
+                    # pending).
                     time.sleep(min(self.backoff_wait_s() or 0.0, 0.05))
                     idle = 0
                     continue

@@ -41,6 +41,7 @@ from k8s1m_tpu.config import (
     TOPO_REGION,
     TOPO_ZONE,
 )
+from k8s1m_tpu.ops.priority import pod_priority_of
 from k8s1m_tpu.snapshot.constraints import ConstraintTracker
 from k8s1m_tpu.snapshot.node_table import NodeInfo, Taint
 from k8s1m_tpu.snapshot.pod_encoding import (
@@ -527,6 +528,13 @@ def encode_pod(pod: PodInfo, *, scheduler_name: str | None = None,
         spec["affinity"] = affinity
     if raw_spread:
         spec["topologySpreadConstraints"] = list(raw_spread)
+    if pod.priority:
+        # Appended after the canonical fields: spec still OPENS with
+        # schedulerName, so the bind splice landmark is unchanged; the
+        # extra key makes the object non-canonical for the byte-scan
+        # fast parsers, which is correct — priority-bearing pods belong
+        # on the full decode path where admission/preemption read it.
+        spec["priority"] = int(pod.priority)
     obj = {
         "apiVersion": "v1",
         "kind": "Pod",
@@ -686,6 +694,9 @@ def decode_pod_obj(obj: dict, tracker: ConstraintTracker | None = None) -> PodIn
         # (webhook.go:102-125).
         scheduler_name=spec.get("schedulerName", K8S_DEFAULT_SCHEDULER),
         node_name=spec.get("nodeName"),
+        # Same forgiving parse as ops/priority.pod_priority_of: a pod
+        # with a garbage priority schedules at 0, it is not rejected.
+        priority=pod_priority_of(obj),
         node_selector=dict(spec.get("nodeSelector", {})),
         tolerations=[
             Toleration(

@@ -135,13 +135,21 @@ class WebhookServer:
                     and spec.get("schedulerName") == outer.scheduler_name
                     and not spec.get("nodeName")
                 )
-                if (
-                    claimed
-                    and outer.controller is not None
-                    and not outer.controller.admit(
-                        pod_priority_of(obj), point="webhook"
-                    )
-                ):
+                if claimed and outer.controller is not None:
+                    # Tenancy-aware controllers (tenancy.FairAdmission)
+                    # derive the tenant from the object and shed per
+                    # tenant; the plain HealthController keeps the
+                    # priority-only global form.
+                    admit_obj = getattr(outer.controller, "admit_obj", None)
+                    if admit_obj is not None:
+                        allowed = admit_obj(obj, point="webhook")
+                    else:
+                        allowed = outer.controller.admit(
+                            pod_priority_of(obj), point="webhook"
+                        )
+                else:
+                    allowed = True
+                if not allowed:
                     # Overload shed: explicit backpressure with a retry
                     # hint (the kube-apiserver priority-and-fairness
                     # answer), never a hang or a silent drop.

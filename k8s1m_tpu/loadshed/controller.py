@@ -156,6 +156,7 @@ class LoadshedConfig:
     _floor="_admit_lock",
     _prio_lo="_admit_lock",
     _prio_hi="_admit_lock",
+    _prio_seen="_admit_lock",
     state="_admit_lock",
 )
 class HealthController:
@@ -180,9 +181,13 @@ class HealthController:
         # Adaptive priority floor: pods with priority < floor are shed
         # while SHEDDING.  Bounds track the priorities actually offered,
         # so the floor can always climb high enough to bite and never
-        # chases values nobody submits.
+        # chases values nobody submits.  The bounds (and the floor) are
+        # seeded by the FIRST offered priority, not by 0 — a workload
+        # submitting only negative priorities must not find itself shed
+        # entirely by a floor stuck at a level nobody ever offered.
         self._prio_lo = 0
         self._prio_hi = 0
+        self._prio_seen = False
         self._floor = 0
         self.ticks = 0
         # Recent cycle wall times (newest latency_window samples).
@@ -265,29 +270,59 @@ class HealthController:
         with self._admit_lock:
             return self.state != HEALTHY
 
+    def current_state(self) -> int:
+        """Locked state read for composing layers (tenancy's weighted-
+        fair admission keys its enforcement on it; a bare ``.state``
+        read would violate the declared lock discipline)."""
+        with self._admit_lock:
+            return self.state
+
     # ---- admission -----------------------------------------------------
 
     def try_admit(
-        self, priority: int = 0, point: str = "coordinator"
+        self, priority: int = 0, point: str = "coordinator",
+        *, floor: bool = True,
     ) -> str | None:
         """Admission predicate: None = admitted, else the rejection
         reason (``"cap"`` = hard queue bound, any priority; ``"priority"``
         = under the shedding floor — the client's cue to raise its
         PriorityClass rather than just back off).  Counts every accept
         against the load sampled at the last tick so ``queue_cap`` is a
-        hard bound, not a per-tick approximation."""
+        hard bound, not a per-tick approximation.
+
+        ``floor=False`` keeps the hard cap but skips the adaptive
+        priority floor — the tenancy layer's form (k8s1m_tpu/tenancy):
+        it sheds proportionally by tenant instead of globally by
+        priority, and priority's job moves to preemption."""
         with self._admit_lock:
             # Bounds tracking moved under the lock: concurrent admissions
             # used to lose min/max updates (the shedding floor could then
             # never climb high enough to bite) — found by the guard audit.
-            self._prio_lo = min(self._prio_lo, priority)
-            self._prio_hi = max(self._prio_hi, priority)
+            if not self._prio_seen:
+                # First offer seeds the band AND — outside SHEDDING —
+                # the floor (floor at the observed minimum = admit
+                # everything, the same level recovery resets to).
+                self._prio_seen = True
+                self._prio_lo = self._prio_hi = priority
+                if self.state < SHEDDING:
+                    self._floor = priority
+            else:
+                if priority < self._prio_lo:
+                    self._prio_lo = priority
+                    # The floor tracks the observed MINIMUM until a
+                    # shedding episode actually escalates it: a high-
+                    # priority first pod must not pre-arm the floor so
+                    # that entering SHEDDING instantly sheds everything
+                    # below it instead of one level per tick.
+                    if self.state < SHEDDING:
+                        self._floor = priority
+                self._prio_hi = max(self._prio_hi, priority)
             if (
                 self._load + self._admitted_since_tick
                 >= self.config.queue_cap
             ):
                 reason = "cap"
-            elif self.state == SHEDDING and priority < self._floor:
+            elif floor and self.state == SHEDDING and priority < self._floor:
                 reason = "priority"
             else:
                 self._admitted_since_tick += 1

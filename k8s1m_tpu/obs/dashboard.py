@@ -47,6 +47,10 @@ ROWS = [
     ("Mesh (dp x sp sharded cycle)", ("mesh_",)),
     ("Overload control", ("loadshed_", "admission_", "breaker_",
                           "degraded_")),
+    # Multi-tenant fairness (k8s1m_tpu/tenancy): per-class admitted
+    # throughput and debt, preemption evictions, gang all-or-none
+    # settlement outcomes.
+    ("Multi-tenant fairness", ("tenant_", "preemption_", "gang_")),
     # Fault injection + the one shared RetryPolicy (k8s1m_tpu/faultline).
     ("Resilience (faultline)", ("faultline_", "retry_")),
     ("Store (mem-etcd)", ("memstore_",)),
@@ -169,6 +173,7 @@ def main() -> None:
     import k8s1m_tpu.loadshed  # noqa: F401
     import k8s1m_tpu.store.etcd_server  # noqa: F401
     import k8s1m_tpu.store.watch_cache  # noqa: F401
+    import k8s1m_tpu.tenancy  # noqa: F401
 
     print(json.dumps(build_dashboard(), indent=1))
 

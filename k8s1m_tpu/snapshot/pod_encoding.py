@@ -124,6 +124,12 @@ class PodInfo:
     spread_incs: list[tuple[int, int]] = dataclasses.field(default_factory=list)
     ipa_incs: list[tuple[int, int]] = dataclasses.field(default_factory=list)
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    # spec.priority (PriorityClass value).  Host-side only: consumed by
+    # admission shedding and tenancy preemption, never encoded into the
+    # device batch.  A nonzero priority makes the stored object
+    # non-canonical (the native fast lane is for the plain-pod
+    # firehose; priority-bearing pods take the full decode path).
+    priority: int = 0
 
     @property
     def key(self) -> str:

@@ -52,15 +52,43 @@ def parse_args(argv=None):
     ap.add_argument("--namespace", default="default")
     ap.add_argument("--cpu", type=int, default=100, help="milliCPU request")
     ap.add_argument("--mem-mib", type=int, default=200)
+    ap.add_argument(
+        "--tenants", type=int, default=0,
+        help="spread pods over N tenant namespaces (tenant-0..tenant-N-1) "
+        "with zipf-skewed tenant sizes (cluster/workload.py); 0 = the "
+        "single --namespace",
+    )
+    ap.add_argument("--tenant-skew", type=float, default=1.0,
+                    help="zipf skew of tenant sizes (0 = uniform)")
+    ap.add_argument(
+        "--tenant-schedule", default="steady",
+        choices=("steady", "diurnal", "flash"),
+        help="arrival-shape of the tenant mix along the index sequence "
+        "(flash: tenant-0 crowds 10x in the middle fifth)",
+    )
+    ap.add_argument("--seed", type=int, default=0,
+                    help="tenant-assignment seed (deterministic stream)")
     return ap.parse_args(argv)
 
 
 async def amain(args) -> dict:
     reporter = RateReporter("pods created", quiet=args.quiet)
+    tenant_of = None
+    if args.tenants > 0:
+        from k8s1m_tpu.cluster.workload import tenant_assignments
+
+        tenant_of = tenant_assignments(
+            args.count, args.tenants, skew=args.tenant_skew,
+            seed=args.seed, schedule=args.tenant_schedule,
+        )
 
     async def work(client, i):
+        ns = (
+            args.namespace if tenant_of is None
+            else f"tenant-{tenant_of[i]}"
+        )
         pod = build_pod(
-            args.start + i, prefix=args.prefix, namespace=args.namespace,
+            args.start + i, prefix=args.prefix, namespace=ns,
             cpu_milli=args.cpu, mem_kib=args.mem_mib << 10,
         )
         await client.put(pod_key(pod.namespace, pod.name), encode_pod(pod))

@@ -78,7 +78,8 @@ def parse_args(argv=None):
     return args
 
 
-def _mk_cluster(args, *, loadshed=None, breaker=None, ns="default"):
+def _mk_cluster(args, *, loadshed=None, breaker=None, tenancy=None,
+                ns="default"):
     """Store + coordinator of the drill shape (caller owns both)."""
     from k8s1m_tpu.config import PodSpec, TableSpec
     from k8s1m_tpu.control.coordinator import Coordinator
@@ -99,6 +100,7 @@ def _mk_cluster(args, *, loadshed=None, breaker=None, ns="default"):
         Profile(topology_spread=0, interpod_affinity=0),
         chunk=args.chunk, k=4, with_constraints=False, seed=args.seed,
         score_pct=args.score_pct, loadshed=loadshed, breaker=breaker,
+        tenancy=tenancy,
     )
     coord.bootstrap()
     return store, coord
@@ -387,6 +389,97 @@ def run_breaker(args) -> dict:
     }
 
 
+def run_tenant_asym(args) -> dict:
+    """Two-tenant asymmetric overload (tenancy/admission.py): equal
+    weights, the heavy tenant offering 10x the light tenant's rate, the
+    aggregate a sustained overload.  The weighted-fair buckets must hold
+    the light tenant's ADMITTED share within 10% of its weight share
+    (0.5) for the whole enforcement window — the exact starvation the
+    global priority floor could not prevent (both tenants submit at the
+    same priority)."""
+    import json as _json
+
+    from k8s1m_tpu.control.objects import encode_pod, pod_key
+    from k8s1m_tpu.loadshed import HEALTHY, LoadshedConfig, Overloaded
+    from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+    from k8s1m_tpu.tenancy import TenancyController, TenancyPolicy
+
+    b = args.batch
+    cfg = LoadshedConfig(
+        queue_degraded=2 * b, queue_shed=4 * b, queue_cap=64 * b,
+        queue_recover=b // 2, recover_cycles=3,
+        degraded_score_pct=args.degraded_score_pct,
+    )
+    tn = TenancyController(
+        TenancyPolicy(weights={"heavy": 1, "light": 1}),
+        loadshed_config=cfg, name="tenant_asym",
+    )
+    store, coord = _mk_cluster(args, tenancy=tn)
+    # Light saturates just past its fair share (0.55 x capacity), heavy
+    # offers 10x that: ~6x aggregate overload, every tenant saturating,
+    # so admitted throughput should track weight shares exactly.
+    light_per_tick = max(1, int(0.55 * b))
+    heavy_per_tick = 10 * light_per_tick
+    total = light_per_tick + heavy_per_tick
+    seq = 0
+    enforce_base = None
+    enforce_ticks = 0
+    try:
+        for tick in range(args.healthy_ticks + 6 * args.overload_ticks):
+            # Bresenham-interleaved arrivals: light pods spread through
+            # the heavy flood (a bursty arrival order would test the
+            # queue cap, not the fairness layer).
+            acc = 0
+            for i in range(total):
+                acc += light_per_tick
+                tenant = "light" if acc >= total else "heavy"
+                if acc >= total:
+                    acc -= total
+                pod = PodInfo(
+                    f"t{tick:03d}-{i:05d}", namespace=tenant,
+                    cpu_milli=10, mem_kib=1 << 10,
+                )
+                obj = _json.loads(encode_pod(pod))
+                try:
+                    coord.submit_external(obj)
+                except Overloaded:
+                    continue
+                store.put(pod_key(tenant, pod.name), encode_pod(pod))
+                seq += 1
+            coord.step()
+            state = tn.controller.current_state()
+            if state != HEALTHY:
+                if enforce_base is None:
+                    # Enforcement just engaged: measure shares from here
+                    # (the pre-pressure ticks legitimately admit all).
+                    enforce_base = tn.admission.counters()["admitted"]
+                else:
+                    enforce_ticks += 1
+    finally:
+        counters = tn.admission.counters()
+        coord.close()
+        store.close()
+    adm = counters["admitted"]
+    base = enforce_base or {}
+    adm_l = adm.get("light", 0) - base.get("light", 0)
+    adm_h = adm.get("heavy", 0) - base.get("heavy", 0)
+    share_l = adm_l / max(adm_l + adm_h, 1)
+    weight_share = 0.5
+    return {
+        "offered_per_tick": {"heavy": heavy_per_tick, "light": light_per_tick},
+        "enforce_ticks": enforce_ticks,
+        "admitted_under_enforcement": {"light": adm_l, "heavy": adm_h},
+        "light_admitted_share": round(share_l, 4),
+        "light_weight_share": weight_share,
+        "rejected": counters["rejected"],
+        "passed": bool(
+            enforce_ticks >= 5
+            and adm_l > 0
+            and abs(share_l - weight_share) <= 0.10 * weight_share
+        ),
+    }
+
+
 def _snapshot_usage(coord) -> dict[int, tuple[int, int, int]]:
     """Per-row (cpu, mem, pods) requested usage, copied host-side."""
     h = coord.host
@@ -400,12 +493,16 @@ def main(argv=None) -> dict:
     args = parse_args(argv)
     overload = run_overload(args)
     breaker = run_breaker(args)
+    tenant_asym = run_tenant_asym(args)
     result = {
         "metric": "overload_drill" + ("_smoke" if args.smoke else ""),
         "value": overload["throughput_ratio"],
         "unit": "degraded/healthy binds ratio",
         "vs_baseline": None,
-        "passed": bool(overload["passed"] and breaker["passed"]),
+        "passed": bool(
+            overload["passed"] and breaker["passed"]
+            and tenant_asym["passed"]
+        ),
         "seed": args.seed,
         "shape": {
             "nodes": args.nodes, "batch": args.batch, "chunk": args.chunk,
@@ -415,6 +512,7 @@ def main(argv=None) -> dict:
         },
         "overload": overload,
         "breaker": breaker,
+        "tenant_asym": tenant_asym,
     }
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)

@@ -157,6 +157,25 @@ def parse_args(argv=None):
     )
     ap.add_argument("--overload-seconds", type=float, default=300.0)
     ap.add_argument("--overload-factor", type=float, default=5.0)
+    ap.add_argument(
+        "--tenants", type=int, default=0,
+        help="spread the pod population over N tenant namespaces with "
+        "zipf-skewed tenant sizes (cluster/workload.py tenant_assignments"
+        "; seed-deterministic).  0 = the historical single-namespace "
+        "load",
+    )
+    ap.add_argument("--tenant-skew", type=float, default=1.0,
+                    help="zipf skew of tenant sizes (0 = uniform)")
+    ap.add_argument(
+        "--tenant-schedule", default="steady",
+        choices=("steady", "diurnal", "flash"),
+        help="tenant-mix arrival shape along the emission sequence "
+        "(diurnal: phase-shifted day curves; flash: tenant-0 crowds "
+        "10x in the middle fifth — pair with --rate for wall-clock "
+        "arrival schedules)",
+    )
+    ap.add_argument("--seed", type=int, default=0,
+                    help="tenant-assignment seed")
     args = ap.parse_args(argv)
     if args.overload_at and not args.rate:
         ap.error("--overload-at requires --rate (the paced producer)")
@@ -212,6 +231,18 @@ def _encode_profile_detail(enabled: bool) -> dict:
         "staged_depth": int(
             REGISTRY.get("hotfeed_staged_depth").value()
         ),
+    }}
+
+
+def _tenant_detail(args) -> dict:
+    """Tenant-load shape for the report (empty without --tenants)."""
+    if not args.tenants:
+        return {}
+    return {"tenant_load": {
+        "tenants": args.tenants,
+        "skew": args.tenant_skew,
+        "schedule": args.tenant_schedule,
+        "seed": args.seed,
     }}
 
 
@@ -550,12 +581,30 @@ def main(argv=None):
     bootstrap_s = time.perf_counter() - t0
 
     # Pre-encode pod values (the writer's cost, not the scheduler's).
+    # With --tenants the population spreads over tenant namespaces
+    # (zipf sizes, scheduled mix) — emission is in index order, so the
+    # paced producer below turns the index axis into arrival time.
+    if args.tenants > 0:
+        from k8s1m_tpu.cluster.workload import tenant_assignments
+
+        tenant_ids = tenant_assignments(
+            args.pods, args.tenants, skew=args.tenant_skew,
+            seed=args.seed, schedule=args.tenant_schedule,
+        )
+        namespaces = [f"tenant-{t}" for t in tenant_ids]
+    else:
+        namespaces = ["default"] * args.pods
     values = [
-        encode_pod(PodInfo(f"bench-{i}", cpu_milli=10, mem_kib=1024))
+        encode_pod(PodInfo(
+            f"bench-{i}", namespace=namespaces[i],
+            cpu_milli=10, mem_kib=1024,
+        ))
         for i in range(args.pods)
     ]
-    keys = [pod_key("default", f"bench-{i}") for i in range(args.pods)]
-    key_strs = [f"default/bench-{i}" for i in range(args.pods)]
+    keys = [
+        pod_key(namespaces[i], f"bench-{i}") for i in range(args.pods)
+    ]
+    key_strs = [f"{namespaces[i]}/bench-{i}" for i in range(args.pods)]
 
     # Warm the compile cache outside the measured window.
     store.put(keys[0], values[0])
@@ -711,6 +760,7 @@ def main(argv=None):
                     node_churn,
                 ),
                 **_mesh_detail(coord, feed_depth_samples),
+                **_tenant_detail(args),
                 **_encode_profile_detail(args.encode_profile),
                 **_resilience_detail(),
             },
@@ -804,6 +854,7 @@ def main(argv=None):
                 coord, quiesce_base, overlap_base, depth_samples, node_churn,
             ),
             **_mesh_detail(coord, feed_depth_samples),
+            **_tenant_detail(args),
             **_encode_profile_detail(args.encode_profile),
             **_resilience_detail(),
         },

@@ -168,6 +168,16 @@ def parse_args(argv=None):
                     "tier-1 overload_drill (0 = off)")
     ap.add_argument("--overload-seconds", type=float, default=300.0)
     ap.add_argument("--overload-factor", type=float, default=5.0)
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="tenant-aware churn load: the churn bench "
+                    "spreads its pods over N tenant namespaces with "
+                    "zipf-skewed sizes (sched_bench --tenants)")
+    ap.add_argument("--tenant-skew", type=float, default=1.0)
+    ap.add_argument("--tenant-schedule", default="steady",
+                    choices=("steady", "diurnal", "flash"),
+                    help="tenant-mix arrival shape over the churn "
+                    "window (diurnal day curves / a tenant-0 flash "
+                    "crowd mid-window)")
     args = ap.parse_args(argv)
     if args.overload_at and (
         args.overload_at + args.overload_seconds >= args.seconds
@@ -404,6 +414,12 @@ async def amain(args) -> dict:
                 "--overload-at", str(args.overload_at),
                 "--overload-seconds", str(args.overload_seconds),
                 "--overload-factor", str(args.overload_factor),
+            ]
+        if args.tenants:
+            bench_cmd += [
+                "--tenants", str(args.tenants),
+                "--tenant-skew", str(args.tenant_skew),
+                "--tenant-schedule", args.tenant_schedule,
             ]
         bench_proc = subprocess.Popen(
             bench_cmd, env=fault_env, stdout=subprocess.PIPE, text=True,

@@ -23,6 +23,13 @@ component             operations
 ``coordinator.cycle`` ``dispatch`` (the device-wave launch; ``stall``
                       opens the circuit breaker, ``slow_cycle`` shapes
                       overload latency)
+``coordinator.lease`` ``tick/<identity>`` (the HA replica's election+
+                      schedule tick, control/leader.HACoordinator:
+                      ``kill_process`` SIGKILLs the replica — no lease
+                      release, no flush, takeover on expiry;
+                      ``pause`` SIGSTOPs it between the leadership
+                      check and its writes — the split-brain window
+                      lease-epoch fencing exists for)
 ``shardset.lease``    ``heartbeat/<shard>`` ``rebalance``
 ====================  =====================================================
 
@@ -53,6 +60,17 @@ Fault kinds and their contract at the hook sites:
                      but takes ``delay_s`` longer — feeds the health
                      controller's cycle-p99 signal without failing
                      anything (k8s1m_tpu/loadshed/controller.py).
+- ``pause``          SIGSTOP-style freeze for ``delay_s``: the process
+                     keeps all its in-memory beliefs (leadership!)
+                     while the world moves on.  Only the
+                     ``coordinator.lease`` hook applies it; drills may
+                     install ``HACoordinator.on_pause`` to advance the
+                     other replicas deterministically during the freeze.
+- ``kill_process``   SIGKILL-style death of the HA replica at the
+                     ``coordinator.lease`` hook: no lease release, no
+                     watch teardown beyond what a dead process's
+                     connections get, in-flight waves die unretired —
+                     the standby takes over on lease expiry.
 
 The injector is process-global (``install_plan`` / ``active_injector``)
 so subsystems need no plumbing, and seeded per spec so determinism
@@ -76,7 +94,7 @@ log = logging.getLogger("k8s1m.faultline")
 
 FAULT_KINDS = (
     "drop", "delay", "disconnect", "err5xx", "partial_write",
-    "stale_revision", "stall", "slow_cycle",
+    "stale_revision", "stall", "slow_cycle", "pause", "kill_process",
 )
 
 _INJECTED = Counter(

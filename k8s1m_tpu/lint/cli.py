@@ -45,6 +45,7 @@ from k8s1m_tpu.lint.lockgraph import (
 )
 from k8s1m_tpu.lint.rules_clock import NoWallClock
 from k8s1m_tpu.lint.rules_except import BroadExcept
+from k8s1m_tpu.lint.rules_fence import FencedStoreWrite
 from k8s1m_tpu.lint.rules_guards import StaticGuardedBy
 from k8s1m_tpu.lint.rules_hotfeed import HotfeedNoPerPodPython
 from k8s1m_tpu.lint.rules_jax import HotPathHostSync, TraceTimeBranch
@@ -63,6 +64,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     StaticGuardedBy,
     LockOrderCycle,
     MeshPurity,
+    FencedStoreWrite,
 )
 
 # The linted slice of the repo (everything else is docs/artifacts).

@@ -1,0 +1,87 @@
+"""fenced-store-write: coordinator store writes flow through the fence.
+
+ISSUE 9's fencing contract is only as strong as its coverage: ONE
+bind/evict/preempt path writing to the store directly re-opens the
+classic fencing-token gap (a deposed leader's in-flight wave landing a
+write behind the new leader's takeover).  This rule keeps the funnel
+airtight statically: inside ``k8s1m_tpu/control/``, any call to a store
+write method (``cas`` / ``put`` / ``put_batch`` / ``delete`` /
+``bind_batch`` / ``put_frame`` / ``bind_frame``) on a receiver whose
+dotted name ends in ``store`` must sit inside one of the designated
+fenced helpers (``_fenced_cas`` / ``_fenced_bind_batch``) — everything
+else is a finding.
+
+``control/leader.py`` is exempt wholesale: the lease CAS there IS the
+fence's arbiter (an election write cannot gate on the election it
+implements).  ``control/shardset.py``'s shard-lease heartbeat and
+rebalance writes predate the epoch fence and are fenced by their own
+shard-lease CAS — grandfathered in the baseline until shardset grows
+epoch fencing of its own.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import (
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+    walk_no_nested_functions,
+)
+
+SCOPE = "k8s1m_tpu/control/"
+EXEMPT_PATHS = ("k8s1m_tpu/control/leader.py",)
+FENCED_FUNCS = {"_fenced_cas", "_fenced_bind_batch"}
+WRITE_METHODS = {
+    "cas", "put", "put_batch", "delete", "bind_batch", "put_frame",
+    "bind_frame",
+}
+
+
+def _store_write(call: ast.Call) -> str | None:
+    """The write-method name when ``call`` is ``<...>.store.<write>(...)``
+    or ``store.<write>(...)``, else None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    if len(parts) < 2 or parts[-1] not in WRITE_METHODS:
+        return None
+    if parts[-2] != "store" and not parts[-2].endswith("_store"):
+        return None
+    return parts[-1]
+
+
+class FencedStoreWrite(Rule):
+    id = "fenced-store-write"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if not f.path.startswith(SCOPE) or f.path in EXEMPT_PATHS:
+            return []
+        out: list[Finding] = []
+        scopes: list[tuple[str, ast.AST]] = [("<module>", f.tree)]
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node.name, node))
+        for fname, scope in scopes:
+            if fname in FENCED_FUNCS:
+                continue
+            for node in walk_no_nested_functions(
+                scope, descend_lambdas=True
+            ):
+                if not isinstance(node, ast.Call):
+                    continue
+                method = _store_write(node)
+                if method is None:
+                    continue
+                out.append(self.finding(
+                    f, node,
+                    f"direct store.{method} on a coordinator path; "
+                    "route through the epoch-fenced helper "
+                    "(_fenced_cas / _fenced_bind_batch) so a deposed "
+                    "reign's writes can never land behind a takeover "
+                    "(ISSUE 9 fencing contract)",
+                ))
+        return out

@@ -51,6 +51,11 @@ ROWS = [
     # throughput and debt, preemption evictions, gang all-or-none
     # settlement outcomes.
     ("Multi-tenant fairness", ("tenant_", "preemption_", "gang_")),
+    # Coordinator failover (control/leader.py): takeover counts and
+    # recovery seconds by warm/cold mode, lease-epoch fence rejections
+    # by write path, the standby mirror's watch lag, and reconcile
+    # repairs at takeover.
+    ("Failover", ("failover_", "fencing_", "standby_")),
     # Fault injection + the one shared RetryPolicy (k8s1m_tpu/faultline).
     ("Resilience (faultline)", ("faultline_", "retry_")),
     ("Store (mem-etcd)", ("memstore_",)),

@@ -429,7 +429,8 @@ def parse_pod_events(
 
 
 def list_prefix(
-    store, prefix: bytes, *, page: int = 5000, keys_only: bool = False
+    store, prefix: bytes, *, page: int = 5000, keys_only: bool = False,
+    revision: int = 0,
 ):
     """Consistent paginated list of a prefix: (kvs, revision).
 
@@ -442,11 +443,19 @@ def list_prefix(
     Restarts the scan from the current revision if the pinned revision
     is compacted mid-scan (the reflector-on-410-Gone rule), up to 3
     attempts.
+
+    ``revision`` > 0 pins the whole list at a CALLER-CHOSEN revision —
+    the follow-mode relist a promoting warm standby uses to diff its
+    mirror against the store as of the lease-acquire revision
+    (control/coordinator.Coordinator._reconcile_at).  A pinned list that
+    hits compaction raises instead of restarting (silently listing a
+    different revision would defeat the diff); the caller owns the
+    fallback.
     """
     for _ in range(3):
         start, end = prefix, prefix_end(prefix)
         out: list = []
-        rev = 0
+        rev = revision
         try:
             while True:
                 res = store.range(
@@ -459,6 +468,8 @@ def list_prefix(
                     return out, rev
                 start = res.kvs[-1].key + b"\x00"
         except CompactedError:
+            if revision:
+                raise
             continue
     raise CompactedError()
 

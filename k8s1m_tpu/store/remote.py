@@ -95,6 +95,12 @@ class RemoteWatcher:
         self._queue_cap = queue_cap if queue_cap > 0 else 10_000
         self._dropped = 0
         self.canceled = False
+        # Follow-mode bookkeeping (ISSUE 9): highest mod_revision this
+        # stream has buffered.  A warm-standby mirror promoting over the
+        # wire reads it to judge whether its drained view already covers
+        # the lease-acquire revision, or whether the pinned
+        # relist-from-revision diff must carry the gap.
+        self.seen_revision = 0
         # The request side must stay open for the watch's lifetime — a
         # finite iterator half-closes the stream and the server cancels
         # the watch.  Requests flow through a queue; cancel() enqueues a
@@ -163,6 +169,8 @@ class RemoteWatcher:
                     continue
                 with self._lock:
                     for ev in resp.events:
+                        if ev.kv.mod_revision > self.seen_revision:
+                            self.seen_revision = ev.kv.mod_revision
                         if len(self._events) >= self._queue_cap:
                             self._dropped += 1
                             continue

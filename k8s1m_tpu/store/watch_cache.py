@@ -64,6 +64,14 @@ _INVALIDATIONS = Counter(
     "watchcache_invalidations_total",
     "upstream watch breaks that canceled every client for relist", ()
 )
+_REPLAYS = Counter(
+    "watchcache_replays_total",
+    "follower resume-from-revision requests, by outcome: resumed = the "
+    "history window reached the requested revision (warm-standby "
+    "follow mode rides this); compact_relist = the window fell short "
+    "and the client was told to relist",
+    ("outcome",),
+)
 
 _DEFAULT_WINDOW = 65536
 
@@ -288,7 +296,9 @@ class WatchCache:
         if start_revision <= 0:
             return None
         if start_revision < self.replayable_from:
+            _REPLAYS.inc(outcome="compact_relist")
             return self.replayable_from
+        _REPLAYS.inc(outcome="resumed")
         for ev in self.history:
             if ev.mod_revision >= start_revision and w.matches(ev.key):
                 w.push(ev)

@@ -59,6 +59,17 @@ shed-and-recover counterpart of the deterministic tier-1
 ``tools/overload_drill.py``.  Composes with ``--fault-plan`` and the
 tier SIGKILL, so one soak exercises faults, failover, and overload in
 the same run.
+
+**Coordinator-failover phase** (``--kill-coordinator-at``, ISSUE 9):
+the kill drills above exercise the STORE side of the control plane (a
+watch-cache tier replica dies; canaries resume on the survivor).  This
+phase kills the *scheduler*: at the given second of the churn window
+the composed ``tools/failover_drill`` runs alongside the soak —
+kill-active-mid-wave (warm standby promote vs cold boot) and the
+paused-leader split-brain, gated on 0 lost pods / 0 double-binds /
+fencing rejects observed — so one soak covers both halves of "kill any
+control-plane process and nothing is lost".  Its result is merged as
+``coordinator_failover`` and folds into the run's pass gate.
 """
 
 from __future__ import annotations
@@ -155,6 +166,13 @@ def parse_args(argv=None):
                     help="SIGKILL the last tier replica this many "
                     "seconds into the churn window (0 = no kill; "
                     "requires --tier-replicas >= 2)")
+    ap.add_argument("--kill-coordinator-at", type=float, default=0.0,
+                    help="run the coordinator-failover drill "
+                    "(tools/failover_drill --smoke: mid-wave SIGKILL "
+                    "with warm-standby takeover + paused-leader "
+                    "split-brain under fencing) alongside the soak, "
+                    "launched this many seconds into the churn window "
+                    "(0 = off)")
     ap.add_argument("--wal-mode", default="buffered",
                     choices=["none", "buffered", "fsync"],
                     help="store WAL durability for the soak (the "
@@ -192,6 +210,8 @@ def parse_args(argv=None):
                  "bench and idle population need a survivor)")
     if args.kill_tier_at and args.kill_tier_at >= args.seconds:
         ap.error("--kill-tier-at must fall inside the churn window")
+    if args.kill_coordinator_at and args.kill_coordinator_at >= args.seconds:
+        ap.error("--kill-coordinator-at must fall inside the churn window")
     if args.out is None:
         args.out = ("artifacts/soak_faultline.json" if args.fault_plan
                     else "artifacts/soak_secured_tier.json")
@@ -436,6 +456,7 @@ async def amain(args) -> dict:
         canary_written = 0
         tick = 0
         kill_info = None
+        failover_proc = None
         t0 = time.monotonic()
         next_compact = t0 + args.compact_every
         while bench_proc.poll() is None:
@@ -458,6 +479,21 @@ async def amain(args) -> dict:
                     canary_written += 1
             except Exception:  # graftlint: disable=broad-except
                 pass        # ledger writes pause while the store restarts
+            if (
+                args.kill_coordinator_at
+                and failover_proc is None
+                and time.monotonic() - t0 >= args.kill_coordinator_at
+            ):
+                # The coordinator-failover phase rides its own process
+                # (tick-driven, deterministic, own in-process store) so
+                # the soak's wire ledger stays untouched while the
+                # scheduler-kill scenarios run to their own gates.
+                failover_proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "k8s1m_tpu.tools.failover_drill", "--smoke"],
+                    env=env, stdout=subprocess.PIPE, text=True,
+                )
+                procs.append(failover_proc)
             if (
                 args.kill_tier_at
                 and kill_info is None
@@ -497,6 +533,38 @@ async def amain(args) -> dict:
             )
         bench_line = json.loads(bench_out.strip().splitlines()[-1])
         soak_s = time.monotonic() - t0
+
+        failover_info = None
+        if failover_proc is not None:
+            # Bound the wait WELL below the smoke test's 420s budget so
+            # a slow/wedged drill reports as a failed gate instead of
+            # timing out the whole soak (which would destroy both runs'
+            # evidence); the drill itself is ~1-2 min at --smoke scale.
+            try:
+                fo_out, _ = failover_proc.communicate(timeout=240)
+                fo = json.loads(fo_out.strip().splitlines()[-1])
+                failover_info = {
+                    "at_s": round(args.kill_coordinator_at, 1),
+                    "passed": bool(fo.get("passed")),
+                    "recovery_warm_s": fo["evidence"]["recovery_warm_s"],
+                    "recovery_cold_s": fo["evidence"]["recovery_cold_s"],
+                    "fencing_rejected": fo["evidence"]["split_brain"][
+                        "fencing_rejected"],
+                    "lost": max(
+                        fo["evidence"][k]["lost"]
+                        for k in ("mid_wave_kill_warm", "mid_wave_kill_cold",
+                                  "split_brain")
+                    ),
+                }
+            # A failed/hung drill must FAIL the gate, not destroy the
+            # soak's own evidence.
+            except Exception as e:  # graftlint: disable=broad-except
+                failover_proc.kill()
+                failover_info = {
+                    "at_s": round(args.kill_coordinator_at, 1),
+                    "passed": False,
+                    "error": repr(e),
+                }
 
         # Liveness probe: every canary stream must deliver a fresh write.
         base = canary_delivered()
@@ -581,6 +649,7 @@ async def amain(args) -> dict:
                 rss_flat and canceled == 0 and stalls == 0
                 and event_loss == 0
                 and (kill_info is None or kill_info["caught_up"])
+                and (failover_info is None or failover_info["passed"])
             ),
             "rss_flat": rss_flat,
             "rss_growth": growth,
@@ -592,6 +661,7 @@ async def amain(args) -> dict:
             "wal_mode": args.wal_mode,
             "tier_replicas": args.tier_replicas,
             "tier_kill": kill_info,
+            "coordinator_failover": failover_info,
             "fault_plan": (
                 {"seed": plan.seed, "specs": [f.to_obj() for f in plan.faults]}
                 if plan else None

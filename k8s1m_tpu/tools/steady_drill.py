@@ -29,6 +29,15 @@ fault retried with zero give-ups, and the host feed actually staging
 (``staged_used`` grew) — the individually-proven subsystems proven
 *simultaneously*.
 
+**benchtrue part 3** (``--mesh DPxSP``): the same composed shape over
+the dp x sp sharded cycle — the table's rows shard over ``sp`` devices
+and the pod batch over ``dp`` (parallel/sharded_cycle), with the
+per-dp-shard host feed staging behind in-flight sharded waves.  Run on
+CPU with the virtual device mesh::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python -m k8s1m_tpu.tools.steady_drill --smoke --mesh 2x4
+
     python -m k8s1m_tpu.tools.steady_drill --smoke \
         --out artifacts/steady_state_drill.json
 """
@@ -61,6 +70,10 @@ def parse_args(argv=None):
                     help="faultline: force a bind-CAS conflict every Nth "
                     "CAS attempt")
     ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument("--mesh", default=None,
+                    help="run the composed drill over the dp x sp "
+                    "sharded cycle (benchtrue part 3), e.g. '2x4' on "
+                    "the 8-device CPU mesh; default: single-device")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 shape: tiny cluster, same gates")
     ap.add_argument("--out", default=None)
@@ -71,6 +84,10 @@ def parse_args(argv=None):
         args.steady_ticks, args.overload_ticks = 8, 8
         args.recover_ticks = 40
         args.churn_per_tick = 16
+        if args.mesh:
+            # Mesh divisibility at smoke scale: rows-per-sp-shard must
+            # be a chunk multiple (256/4 = 64, chunk 32).
+            args.nodes, args.chunk = 256, 32
     return args
 
 
@@ -125,6 +142,8 @@ def run(args) -> dict:
     quiesce = REGISTRY.get("pipeline_quiesce_total")
     q0 = {r: quiesce.value(reason=r) for r in ("structural", "resync")}
     staged0 = REGISTRY.get("hotfeed_staged_used_total").value()
+    mesh_scatter = REGISTRY.get("mesh_sharded_scatter_total")
+    ms0 = {c: mesh_scatter.value(cols=c) for c in ("full", "cap")}
     giveups = REGISTRY.get("retry_give_ups_total")
     giveup0 = giveups.value(component="coordinator.bind")
 
@@ -143,6 +162,7 @@ def run(args) -> dict:
         PodSpec(batch=b), Profile(topology_spread=0, interpod_affinity=0),
         chunk=args.chunk, k=4, with_constraints=False, seed=args.seed,
         score_pct=50, pipeline=True, depth=args.depth, tenancy=tn,
+        mesh=args.mesh or "none",
     )
 
     seq = 0
@@ -238,8 +258,13 @@ def run(args) -> dict:
     )
     give_ups = giveups.value(component="coordinator.bind") - giveup0
     faults = sum(fired.values()) if fired else 0
+    mesh_scatters = {
+        c: int(mesh_scatter.value(cols=c) - ms0[c]) for c in ms0
+    }
     return {
         "weights": weights,
+        "mesh": args.mesh,
+        "mesh_sharded_scatters": mesh_scatters,
         "admitted": len(admitted),
         "rejected": rejected,
         "admitted_by_tenant": counters["admitted"],
@@ -263,6 +288,10 @@ def run(args) -> dict:
             and faults > 0
             and give_ups == 0
             and staged_used > 0
+            # Mesh lane (benchtrue part 3): the capacity churn must
+            # actually have flowed through the sharded mid-flight
+            # scatter, not a fallen-back single-device path.
+            and (not args.mesh or mesh_scatters["cap"] > 0)
         ),
     }
 
@@ -271,7 +300,9 @@ def main(argv=None) -> dict:
     args = parse_args(argv)
     evidence = run(args)
     result = {
-        "metric": "steady_state_drill" + ("_smoke" if args.smoke else ""),
+        "metric": "steady_state_drill"
+        + ("_mesh" if args.mesh else "")
+        + ("_smoke" if args.smoke else ""),
         "value": evidence["sustained_inflight_depth"],
         "unit": "sustained in-flight depth under composed load",
         "vs_baseline": None,
@@ -281,7 +312,7 @@ def main(argv=None) -> dict:
             "nodes": args.nodes, "batch": args.batch, "depth": args.depth,
             "tenants": args.tenants, "tenant_skew": args.tenant_skew,
             "factor": args.factor, "churn_per_tick": args.churn_per_tick,
-            "conflict_every": args.conflict_every,
+            "conflict_every": args.conflict_every, "mesh": args.mesh,
         },
         "evidence": evidence,
     }

@@ -1,0 +1,12 @@
+"""Fixture: fenced-store-write true positive — a coordinator bind path
+CASing the store directly instead of through the epoch-fenced funnel."""
+
+
+class MiniCoordinator:
+    def __init__(self, store, fence=None):
+        self.store = store
+        self.fence = fence
+
+    def _bind(self, key, value, rev):
+        ok, _, _ = self.store.cas(key, value, required_mod=rev)
+        return ok

@@ -1,0 +1,12 @@
+"""Fixture: fenced-store-write pragma twin — the same direct CAS behind
+a justified disable."""
+
+
+class MiniCoordinator:
+    def __init__(self, store, fence=None):
+        self.store = store
+        self.fence = fence
+
+    def _bind(self, key, value, rev):
+        ok, _, _ = self.store.cas(key, value, required_mod=rev)  # graftlint: disable=fenced-store-write (fixture twin: justified direct write)
+        return ok

@@ -96,6 +96,86 @@ def test_reacquire_own_lease_after_restart(store):
     assert a2.tick(1.0)
 
 
+# ---- LeaderElector edges (ISSUE 9 satellite: fencing depends on these) --
+
+
+def test_expired_lease_steal_race_single_winner(store):
+    """Two candidates both observe the SAME expired lease and race the
+    acquisition CAS: the store arbitrates exactly one winner; the
+    loser's stale-revision CAS fails and it must not believe leadership."""
+    a = LeaderElector(store, "a")
+    assert a.tick(0.0)              # then a dies; lease expires at 15
+    b = LeaderElector(store, "b")
+    c = LeaderElector(store, "c")
+    # Both observe the expired record before either writes (the race).
+    b._observe()
+    c._observe()
+    assert c.tick(16.0)             # c wins the CAS
+    # b's acquisition against its STALE observation: the CAS must lose
+    # (the store is the single arbiter) and the failure must re-observe.
+    stale = b._observed
+    assert not b._try_write(
+        LeaseRecord("b", 16.0, 16.0, b.lease_duration_s,
+                    stale.transitions + 1)
+    )
+    assert not b.is_leader
+    assert b._observed.holder == "c"   # re-read the truth, not assumed
+    # The ordinary tick path agrees: c's lease is fresh, no steal.
+    assert not b.tick(16.5)
+    assert LeaseRecord.decode(store.get(b.key).value).holder == "c"
+
+
+def test_release_fast_handover_bumps_epoch(store):
+    """Clean release hands over without waiting out the duration, and
+    every acquisition (steal, handover, re-acquire) bumps
+    leaseTransitions — the fence's epoch source."""
+    a = LeaderElector(store, "a")
+    assert a.tick(0.0)
+    e0 = a.current_epoch()
+    a.release()
+    b = LeaderElector(store, "b")
+    assert b.tick(2.5)              # no 15s wait
+    assert b.current_epoch() == e0 + 1
+    # a's old-reign fence must now refuse writes.
+    assert a.current_epoch() == -1
+
+
+def test_clock_skew_regression(store):
+    """now going BACKWARDS (skewed clock) must neither crash the
+    holder nor let a standby steal a fresh lease (negative elapsed
+    times are not 'expired')."""
+    a = LeaderElector(store, "a")
+    assert a.tick(100.0)
+    assert a.tick(50.0)             # holder's clock jumped back: no renew,
+    assert a.is_leader              # no stepdown
+    b = LeaderElector(store, "b")
+    assert not b.tick(60.0)         # b's clock behind renew_time: the
+    assert not b.is_leader          # lease reads fresh, never expired
+    # Forward skew far past the duration IS expiry, regardless of path.
+    assert b.tick(200.0)
+
+
+def test_lease_transitions_monotonic(store):
+    """leaseTransitions increases on EVERY acquisition across steal,
+    release-handover, and same-identity restart — fencing's epoch
+    ordering depends on it."""
+    seen = []
+    a = LeaderElector(store, "a")
+    assert a.tick(0.0)
+    seen.append(a.current_epoch())
+    b = LeaderElector(store, "b")
+    assert b.tick(16.0)             # steal after expiry
+    seen.append(b.current_epoch())
+    b.release()
+    a2 = LeaderElector(store, "a")
+    assert a2.tick(18.0)            # fast handover
+    seen.append(a2.current_epoch())
+    a3 = LeaderElector(store, "a")  # same identity, fresh process
+    assert a3.tick(19.0)
+    seen.append(a3.current_epoch())
+    assert seen == sorted(seen) and len(set(seen)) == len(seen)
+
+
 # ---- HACoordinator failover --------------------------------------------
 
 

@@ -44,6 +44,7 @@ EXPECTED = {
     "static-guarded-by": "k8s1m_tpu/control/bad_guards.py",
     "lock-order-cycle": "k8s1m_tpu/control/bad_lockorder.py",
     "mesh-purity": "k8s1m_tpu/parallel/bad_mesh.py",
+    "fenced-store-write": "k8s1m_tpu/control/bad_fenced_write.py",
 }
 
 

@@ -132,6 +132,10 @@ def test_soak_smoke_secured_tier():
             sys.executable, "-m", "k8s1m_tpu.tools.soak",
             "--seconds", "12", "--idle", "150", "--rate", "80",
             "--nodes", "4096", "--canaries", "8",
+            # Fold the ISSUE 9 coordinator-failover phase in: the drill
+            # (mid-wave kill + split-brain under fencing) runs alongside
+            # the churn window and its gates ride the soak's pass bit.
+            "--kill-coordinator-at", "3",
             "--out", "",            # no artifact from the smoke
         ],
         timeout=420,
@@ -141,6 +145,11 @@ def test_soak_smoke_secured_tier():
     assert out["churn"]["bound"] > 0
     assert out["churn"]["deleted"] > 0
     assert out["samples"] >= 2
+    fo = out["coordinator_failover"]
+    assert fo is not None and fo["passed"], fo
+    assert fo["lost"] == 0
+    assert fo["fencing_rejected"] > 0
+    assert fo["recovery_warm_s"] < fo["recovery_cold_s"]
     # rss_flat is NOT asserted: a 12s window is all startup transient.
 
 

@@ -134,6 +134,17 @@ from k8s1m_tpu.snapshot.node_table import (
     RowsExhausted,
     scatter_rows,
 )
+from k8s1m_tpu.snapshot.packing import (
+    PackingOverflow,
+    build_packing_spec,
+    donation_inplace,
+    donation_probe,
+    hbm_bytes,
+    is_packed,
+    pack_row_delta,
+    pack_table_host,
+    resolve_packing,
+)
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
 from k8s1m_tpu.tenancy.gang import note_gang
 from k8s1m_tpu.tenancy.policy import gang_of_labels, tenant_of_key, tenant_of_pod
@@ -264,6 +275,30 @@ _MESH_FEED_DEPTH.set_function(
         c._feed.depth() for c in _LIVE
         if isinstance(getattr(c, "_feed", None), ShardedHostFeed)
     )
+)
+
+# ---- device memory (devicestate): packed snapshot + donation evidence --
+_TABLE_BYTES = Gauge(
+    "device_table_bytes",
+    "HBM bytes of the device node table by layout (snapshot/packing.py; "
+    "the packed production layout holds the cold columns bit/byte-packed "
+    "so more nodes fit per chip)",
+    ("layout",),
+)
+_DONATION = Counter(
+    "commit_donation_total",
+    "Per-wave table commits through the donating executable, split by "
+    "whether the runtime honored the donation in place (inplace=no means "
+    "the buffers were copied — e.g. another live reference pinned them)",
+    ("inplace",),
+)
+_PACKING_FALLBACK = Counter(
+    "device_packing_fallback_total",
+    "Fail-closed packed-layout rebuilds, by reason (field that overflowed "
+    "its static bit budget — vocab drift — or 'mesh' for the unsupported "
+    "mesh composition); the coordinator falls back to a wider layout, "
+    "never truncates",
+    ("reason",),
 )
 
 # ---- failover (ISSUE 9): fencing + warm-standby evidence ---------------
@@ -478,6 +513,16 @@ class Coordinator:
         # in-flight waves drain to requeue, never to the store.  None
         # (standalone coordinators, tests) = writes always admitted.
         fence=None,
+        # Device-snapshot layout (snapshot/packing.py): "packed" holds
+        # the cold node-table columns bit/byte-packed in HBM (labels
+        # fused, taint effects + validity in one meta word, narrow
+        # zone/region/pods planes) and decodes per chunk on device —
+        # byte-identical binds, >=2x less cold-column HBM.  None defers
+        # to the K8S1M_PACKING env var ("off" default).  Fail-closed:
+        # vocab drift past the static bit budget rebuilds under a wider
+        # layout (device_packing_fallback_total); the mesh path does not
+        # compose with packing yet and falls back to "off" with a log.
+        packing: str | None = None,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -595,6 +640,26 @@ class Coordinator:
         self._fallback_cache: tuple[int, list] | None = None
         self._node_gen = 0
 
+        # Packed snapshot mode; the PackingSpec itself is built lazily at
+        # first table upload so the label-fusion fail-closed decision
+        # sees the bootstrap vocab, not an empty one.
+        self._packing_mode = resolve_packing(packing)
+        if self._packing_mode == "packed" and mesh is not None:
+            log.warning(
+                "packed snapshot does not compose with the mesh path yet; "
+                "falling back to the unpacked layout (packing=off)"
+            )
+            _PACKING_FALLBACK.inc(reason="mesh")
+            self._packing_mode = "off"
+        self._packing_spec = None
+        # Buffer donation: the single-device step and dirty-row scatter
+        # donate the table (and constraint) buffers so per-wave commits
+        # are in-place in HBM; the mesh step keeps copy-on-write (its
+        # out_shardings-pinned executables predate donation).
+        self._donate = mesh is None
+        self._donation_inplace: bool | None = None
+        self._packing_rebuilding = False
+
         self.host = NodeTableHost(table_spec)
         self.tracker = ConstraintTracker(table_spec)
         # One shape-keyed template cache shared by every encoder this
@@ -659,7 +724,9 @@ class Coordinator:
             empty_constraints(table_spec) if with_constraints else None
         )
         self._table_sharding = None
-        self._scatter = _scatter_rows
+        # Single-device scatters donate (in-place dirty-row updates);
+        # the mesh override below pins sharding instead.
+        self._scatter = _scatter_rows_donated
         self._adjust = adjust_constraints
         if mesh is not None:
             from jax.sharding import NamedSharding
@@ -690,7 +757,7 @@ class Coordinator:
                 # Same drift guard as _scatter: out-of-step constraint
                 # corrections (deletes, CAS rollbacks) must hand the
                 # state back sharded, or every later wave reshards it.
-                self._adjust = jax.jit(
+                self._adjust = jax.jit(  # graftlint: disable=undonated-device-update (mesh donation deferred; sharding pinned)
                     adjust_constraints_impl, static_argnames=("sign",),
                     out_shardings=cons_shardings,
                 )
@@ -858,7 +925,7 @@ class Coordinator:
                 start_revision=pod_rev + 1, queue_cap=self.watch_queue_cap,
             )
             self._bind_excludes = isinstance(self._pods_watch, Watcher)
-            self.table = self.host.to_device(self._table_sharding)
+            self.table = self._table_to_device()
 
     # ---- watch delta application --------------------------------------
 
@@ -1425,12 +1492,21 @@ class Coordinator:
         if not pods:
             return False
         batch = self.encoder.encode_packed(pods)
+        # The production executable donates its inputs: warm it against
+        # throwaway COPIES so the live mirror table (and constraint
+        # state) survive this discarded dispatch.
+        tbl, cons = self.table, self.constraints
+        if self._donate:
+            tbl = jax.tree.map(jnp.array, tbl)
+            if cons is not None:
+                cons = jax.tree.map(jnp.array, cons)
         _t, _c, _asg, rows_dev = schedule_batch_packed(
-            self.table, batch, jax.random.key(0),
-            profile=self.profile, constraints=self.constraints,
+            tbl, batch, jax.random.key(0),
+            profile=self.profile, constraints=cons,
             chunk=self.chunk, k=self.k, backend=self.backend,
             sample_rows=self._sample_rows, sample_offset=0,
             row_mask=self._row_mask_dev, mesh=self.mesh,
+            donate=self._donate,
         )
         jax.block_until_ready(rows_dev)
         self._warmed = True
@@ -1711,25 +1787,32 @@ class Coordinator:
         waves can repair the assumes the upload erased (see _complete).
         """
         if self.table is None:
-            self.table = self.host.to_device(self._table_sharding)
+            self.table = self._table_to_device()
             self._dirty_rows.clear()
             self._dirty_caps.clear()
             return
+        if self._packing_rebuilding:
+            # Mid-rebuild retires re-enter here; the wholesale re-upload
+            # at the end of _packing_rebuild subsumes every dirty row.
+            return
         if not self._dirty_rows and not self._dirty_caps:
             return
-        h = self.host
         with self._stage("sync"):
             if self._dirty_rows:
                 # A row needing the full upload supersedes its
                 # capacity-only entry (the full delta includes CAP cols).
                 self._dirty_caps -= self._dirty_rows
-                if self._inflights:
-                    self._midflight_rows.update(self._dirty_rows)
                 rows = self._pad_rows(
                     np.fromiter(self._dirty_rows, np.int32)
                 )
+                try:
+                    delta = self._row_delta(rows, ALL_COLUMNS)
+                except PackingOverflow as e:
+                    self._packing_rebuild(e)
+                    return
+                if self._inflights:
+                    self._midflight_rows.update(self._dirty_rows)
                 self._dirty_rows.clear()
-                delta = {c: getattr(h, c)[rows] for c in ALL_COLUMNS}
                 self.table = self._scatter(self.table, rows, delta)
                 if self.mesh is not None:
                     _MESH_SCATTER.inc(cols="full")
@@ -1737,11 +1820,117 @@ class Coordinator:
                 rows = self._pad_rows(
                     np.fromiter(self._dirty_caps, np.int32)
                 )
+                try:
+                    delta = self._row_delta(rows, CAP_COLUMNS)
+                except PackingOverflow as e:
+                    self._packing_rebuild(e)
+                    return
                 self._dirty_caps.clear()
-                delta = {c: getattr(h, c)[rows] for c in CAP_COLUMNS}
                 self.table = self._scatter(self.table, rows, delta)
                 if self.mesh is not None:
                     _MESH_SCATTER.inc(cols="cap")
+
+    # ---- device-snapshot layout (snapshot/packing.py) ------------------
+
+    def _table_to_device(self):
+        """Build (or rebuild) the device table under the active layout,
+        recording the HBM evidence gauge."""
+        if self._packing_mode == "packed":
+            if self._packing_spec is None:
+                # Built against the CURRENT vocab so the label-fusion
+                # fail-closed decision is made with real ids in view.
+                self._packing_spec = build_packing_spec(
+                    self.table_spec, self.host.vocab
+                )
+                if self._packing_spec is None:
+                    # taint_slots too wide for the meta word.
+                    _PACKING_FALLBACK.inc(reason="taint_slots")
+                    self._packing_mode = "off"
+            if self._packing_spec is not None:
+                try:
+                    table = pack_table_host(
+                        self.host, self._packing_spec, self._table_sharding
+                    )
+                    self._note_table_bytes(table)
+                    return table
+                except PackingOverflow as e:
+                    self._packing_fallback(e)
+                    if self._packing_mode == "packed":
+                        # Widened (label words split) — one retry.  A
+                        # SECOND overflow on another field (e.g. a node
+                        # past the int16 pods budget in the same rebuild
+                        # window) must also fail closed to unpacked, not
+                        # escape into the cycle loop.
+                        try:
+                            table = pack_table_host(
+                                self.host, self._packing_spec,
+                                self._table_sharding,
+                            )
+                            self._note_table_bytes(table)
+                            return table
+                        except PackingOverflow as e2:
+                            self._packing_fallback(e2)
+        table = self.host.to_device(self._table_sharding)
+        self._note_table_bytes(table)
+        return table
+
+    @property
+    def donation_inplace(self) -> bool | None:
+        """Whether the runtime honored per-wave buffer donation in place
+        (None until the first donating wave's probe runs; stays None on
+        the mesh path, which never donates).  The public read for bench/
+        report surfaces — `commit_donation_total{inplace}` is the
+        per-wave counter."""
+        return self._donation_inplace
+
+    def _note_table_bytes(self, table) -> None:
+        layout = "packed" if is_packed(table) else "unpacked"
+        other = "unpacked" if layout == "packed" else "packed"
+        _TABLE_BYTES.set(hbm_bytes(table), layout=layout)
+        _TABLE_BYTES.set(0, layout=other)
+
+    def _row_delta(self, rows, columns) -> dict:
+        """Dirty-row scatter payload under the live table's layout.
+        Raises PackingOverflow when a packed width no longer fits
+        (vocab drift) — the caller rebuilds fail-closed."""
+        if is_packed(self.table):
+            return pack_row_delta(self.host, rows, self.table.spec, columns)
+        return {c: getattr(self.host, c)[rows] for c in columns}
+
+    def _packing_fallback(self, e: PackingOverflow) -> None:
+        """Fail-closed layout widening (the vocab-drift gate, hotfeed's
+        shape): label overflow splits the fused words (still packed);
+        anything else drops to the unpacked layout.  Never truncates —
+        the cost is one recompile under the wider layout."""
+        _PACKING_FALLBACK.inc(reason=e.field)
+        if (
+            e.field in ("label_key", "label_val")
+            and self._packing_spec is not None
+            and self._packing_spec.fuse_labels
+        ):
+            log.warning("packed snapshot: %s; splitting label words", e)
+            self._packing_spec = dataclasses.replace(
+                self._packing_spec, fuse_labels=False
+            )
+        else:
+            log.warning("packed snapshot: %s; falling back to unpacked", e)
+            self._packing_mode = "off"
+            self._packing_spec = None
+
+    def _packing_rebuild(self, e: PackingOverflow) -> None:
+        """A dirty-row delta no longer fits the packed layout: widen the
+        layout, retire the pipeline (the host mirror is authoritative
+        for everything EXCEPT the in-flight assume chain, so the waves
+        must land before a wholesale re-upload), and rebuild."""
+        self._packing_fallback(e)
+        self._packing_rebuilding = True
+        try:
+            self._quiesce("packing")
+        finally:
+            self._packing_rebuilding = False
+        self._dirty_rows.clear()
+        self._dirty_caps.clear()
+        self.table = self._table_to_device()
 
     # ---- the cycle -----------------------------------------------------
 
@@ -2360,6 +2549,15 @@ class Coordinator:
                     raise faultline.InjectedFault(d)
         profile, sample_rows = self._active_knobs()
         self.key, subkey = jax.random.split(self.key)
+        probe_ptr = None
+        if self._donate and self._donation_inplace is None:
+            # One-time donation probe (first wave): did the runtime alias
+            # the donated hot planes in place?  Reading the output
+            # pointers below syncs on that wave once — never again.
+            try:
+                probe_ptr = donation_probe(self.table)
+            except Exception:  # graftlint: disable=broad-except (probe is evidence-only; any exotic array type just reports inplace=no)
+                self._donation_inplace = False
         with _CYCLE_TIME.time(stage="device"):
             self.table, self.constraints, asg, rows_dev = schedule_batch_packed(
                 self.table, batch, subkey,
@@ -2371,6 +2569,18 @@ class Coordinator:
                 ),
                 row_mask=self._row_mask_dev,
                 mesh=self.mesh,
+                donate=self._donate,
+            )
+        if probe_ptr is not None:
+            try:
+                self._donation_inplace = donation_inplace(
+                    self.table, probe_ptr
+                )
+            except Exception:  # graftlint: disable=broad-except (probe is evidence-only)
+                self._donation_inplace = False
+        if self._donate:
+            _DONATION.inc(
+                inplace="yes" if self._donation_inplace else "no"
             )
         # Start the device->host copy of the bind decision now: by the
         # time _complete runs (a drain + encode later), the bytes are
@@ -3206,6 +3416,10 @@ class Coordinator:
         return total
 
 
-# Single-device dirty-row scatter (snapshot/node_table.scatter_rows);
-# the mesh path swaps in parallel.sharded_cycle.make_sharded_scatter.
-_scatter_rows = jax.jit(scatter_rows)
+# Single-device dirty-row scatter (snapshot/node_table.scatter_rows),
+# DONATING: the coordinator always reassigns self.table from the
+# return, so the churn scatter updates HBM in place instead of
+# copy-on-write.  The mesh path swaps in
+# parallel.sharded_cycle.make_sharded_scatter; a replay caller that
+# keeps its input table alive must jit its own non-donating wrapper.
+_scatter_rows_donated = jax.jit(scatter_rows, donate_argnums=(0,))

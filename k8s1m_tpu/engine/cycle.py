@@ -52,6 +52,7 @@ from k8s1m_tpu.snapshot.constraints import (
     slice_constraints,
 )
 from k8s1m_tpu.snapshot.node_table import NodeTable, commit_binds
+from k8s1m_tpu.snapshot.packing import is_packed, mask_rows_packed, unpack_chunk
 from k8s1m_tpu.snapshot.pod_encoding import PodBatch
 
 
@@ -159,9 +160,24 @@ def commit_fields_of(batch: PodBatch) -> CommitFields:
 
 
 def _slice_table(table: NodeTable, start, chunk: int) -> NodeTable:
-    return jax.tree.map(
+    """Chunk slice of the node table; a PACKED table decodes here, inside
+    the jitted scan body, so HBM holds only the packed planes and the
+    i32-wide decode lives in the same fused pass as the plugins
+    (snapshot/packing.py — the devicestate layout contract)."""
+    sliced = jax.tree.map(
         lambda a: lax.dynamic_slice_in_dim(a, start, chunk, axis=0), table
     )
+    return unpack_chunk(sliced) if is_packed(sliced) else sliced
+
+
+def _prologue_stats(table, constraints):
+    """topology.prologue over either layout: the prologue needs only the
+    full valid/zone/region columns, which a packed table decodes ONCE per
+    wave (global domain statistics don't belong in a chunk decode)."""
+    from k8s1m_tpu.plugins import topology
+
+    view = table.domain_view() if is_packed(table) else table
+    return topology.prologue(view, constraints)
 
 
 def topk_by_argmax(prio, k: int):
@@ -292,9 +308,7 @@ def filter_score_topk(
         # Single-device convenience: build the batch prologue here.  Under
         # shard_map callers MUST pass stats from topology.prologue(...,
         # axis_name=...) — the auto-built one would be shard-local.
-        from k8s1m_tpu.plugins import topology
-
-        stats = topology.prologue(table, constraints)
+        stats = _prologue_stats(table, constraints)
 
     # ONE scalar threefry draw per wave; per-element jitter comes from the
     # separable hash over (pod row, view-local node column) — the same
@@ -429,7 +443,9 @@ def adjust_constraints_impl(
     )
 
 
-adjust_constraints = jax.jit(
+# Correction path, not the per-wave hot loop: callers (tests, the
+# coordinator's rollback batches) may replay against the same state.
+adjust_constraints = jax.jit(  # graftlint: disable=undonated-device-update (replayable correction path; per-wave commits donate via _jitted_schedule_packed)
     adjust_constraints_impl, static_argnames=("sign",)
 )
 
@@ -458,9 +474,7 @@ def _schedule_batch_impl(
         # mask (mask_rows) must narrow candidate selection, never the
         # skew baseline, or shards would disagree on feasibility.  The
         # sampling path below applies the same rule.
-        from k8s1m_tpu.plugins import topology
-
-        stats = topology.prologue(table, constraints)
+        stats = _prologue_stats(table, constraints)
     if backend == "pallas":
         from k8s1m_tpu.ops.pallas_topk import pallas_candidates
 
@@ -497,7 +511,10 @@ def _jitted_schedule(
             table, batch, key, None, profile, chunk, k, backend,
             with_affinity=with_affinity,
         )
-    return jax.jit(fn)
+    # schedule_batch is the unpacked replay/test surface (differential
+    # suites re-run one table); the production path is schedule_batch_
+    # packed with donate=True.
+    return jax.jit(fn)  # graftlint: disable=undonated-device-update (replay surface; production donates via _jitted_schedule_packed)
 
 
 def schedule_batch(
@@ -568,6 +585,8 @@ def mask_rows(table, row_mask):
     infeasible on both backends: ``valid`` feeds the XLA filter chain and
     ``pods_alloc == 0`` is the fused kernel's row-validity convention.
     Commit state is untouched — binds land in the unmasked table."""
+    if is_packed(table):
+        return mask_rows_packed(table, row_mask)
     return table.replace(
         valid=table.valid & row_mask,
         pods_alloc=jnp.where(row_mask, table.pods_alloc, 0),
@@ -579,6 +598,7 @@ def _jitted_schedule_packed(
     profile: Profile, chunk: int, k: int, with_constraints: bool,
     backend: str, pod_spec, table_spec, groups: frozenset,
     sample_rows: int | None, with_mask: bool = False,
+    donate: bool = False,
 ):
     from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
 
@@ -615,12 +635,11 @@ def _jitted_schedule_packed(
                 if constraints is not None:
                     # Same composition rule as the XLA branch below:
                     # global domain statistics, window-local node cols.
-                    from k8s1m_tpu.plugins import topology
                     from k8s1m_tpu.snapshot.constraints import (
                         slice_constraints,
                     )
 
-                    p_stats = topology.prologue(table, constraints)
+                    p_stats = _prologue_stats(table, constraints)
                     view_cons = slice_constraints(
                         constraints, offset, sample_rows
                     )
@@ -637,12 +656,11 @@ def _jitted_schedule_packed(
                     # are GLOBAL reductions over the full count tables
                     # (the prologue never depended on the scan window);
                     # only the per-node count columns follow the window.
-                    from k8s1m_tpu.plugins import topology
                     from k8s1m_tpu.snapshot.constraints import (
                         slice_constraints,
                     )
 
-                    stats = topology.prologue(table, constraints)
+                    stats = _prologue_stats(table, constraints)
                     view_cons = slice_constraints(
                         constraints, offset, sample_rows
                     )
@@ -676,7 +694,20 @@ def _jitted_schedule_packed(
         fn = lambda table, ints, bools, key, offset: impl(
             table, ints, bools, key, offset, None, None
         )
-    return jax.jit(fn)
+    if donate:
+        # The production (coordinator) executable: the input table's —
+        # and constraint state's — buffers are donated, so the wave's
+        # commit_binds/constraint commit update HBM in place instead of
+        # copy-on-write.  Callers MUST drop their reference (the
+        # coordinator reassigns self.table from the return): a donated
+        # array is deleted, and stale host references raise.
+        donate_idx = (0, 6) if (with_constraints and with_mask) else (
+            (0, 5) if with_constraints else (0,)
+        )
+        return jax.jit(fn, donate_argnums=donate_idx)
+    # Replay/differential callers (tests, oracle comparisons, bench A/B
+    # lanes) re-run the same input table; donation would delete it.
+    return jax.jit(fn)  # graftlint: disable=undonated-device-update (non-donating replay variant; production passes donate=True)
 
 
 def schedule_batch_packed(
@@ -693,6 +724,7 @@ def schedule_batch_packed(
     sample_offset: int = 0,
     row_mask=None,
     mesh=None,
+    donate: bool = False,
 ):
     """schedule_batch over a PackedPodBatch: the pod features cross the
     host->device boundary as two buffers and the bind decision comes back
@@ -723,6 +755,18 @@ def schedule_batch_packed(
     ownership is a mask, rebalancing flips mask bits instead of moving
     table data.  Traced, so reassignment never recompiles.
 
+    ``donate=True`` donates the table's (and constraint state's) buffers
+    to the step so the per-wave commit is in-place in HBM instead of
+    copy-on-write — the production coordinator path.  The caller's input
+    references are DEAD afterwards (reassign from the return value);
+    replay/differential callers that re-run the same table must keep the
+    default.  Single-device only (the mesh step never donates).
+
+    ``table`` may be a snapshot.packing.PackedNodeTable (the packed
+    production layout): chunks decode on-device inside the scan slice on
+    both backends, and binds are byte-identical to the unpacked layout
+    (tests/test_packing.py differential gate).
+
     Returns (new_table, new_constraints, Assignment, rows).
     """
     if backend == "pallas" and constraints is None:
@@ -752,7 +796,7 @@ def schedule_batch_packed(
     step = _jitted_schedule_packed(
         profile, chunk, k, constraints is not None, backend,
         packed.spec, packed.table_spec, packed.groups, sample_rows,
-        row_mask is not None,
+        row_mask is not None, donate,
     )
     offset = np.int32(sample_offset)
     args = (table, packed.ints, packed.bools, key, offset)

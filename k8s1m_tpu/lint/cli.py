@@ -44,6 +44,7 @@ from k8s1m_tpu.lint.lockgraph import (
     write_artifact,
 )
 from k8s1m_tpu.lint.rules_clock import NoWallClock
+from k8s1m_tpu.lint.rules_donate import UndonatedDeviceUpdate
 from k8s1m_tpu.lint.rules_except import BroadExcept
 from k8s1m_tpu.lint.rules_fence import FencedStoreWrite
 from k8s1m_tpu.lint.rules_guards import StaticGuardedBy
@@ -65,6 +66,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     LockOrderCycle,
     MeshPurity,
     FencedStoreWrite,
+    UndonatedDeviceUpdate,
 )
 
 # The linted slice of the repo (everything else is docs/artifacts).

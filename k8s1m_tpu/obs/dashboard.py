@@ -45,6 +45,11 @@ ROWS = [
     # The dp x sp sharded execution path (parallel/): mesh axis sizes,
     # sharded dirty-row scatters by column class, per-dp-shard feed depth.
     ("Mesh (dp x sp sharded cycle)", ("mesh_",)),
+    # Packed device snapshot + buffer donation (snapshot/packing.py,
+    # ISSUE 10 devicestate): table HBM bytes by layout, per-wave commit
+    # donations split by whether the runtime honored them in place, and
+    # fail-closed packed-layout rebuilds by overflow reason.
+    ("Device memory", ("device_", "commit_donation_")),
     ("Overload control", ("loadshed_", "admission_", "breaker_",
                           "degraded_")),
     # Multi-tenant fairness (k8s1m_tpu/tenancy): per-class admitted

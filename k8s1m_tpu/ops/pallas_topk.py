@@ -148,17 +148,26 @@ def _kernel(
     w_ipa: int,
     with_aff: bool,
     with_cons: bool,
+    pack: tuple | None = None,
 ):
     """Base refs (always):
         seed_ref   i32[1, 3] SMEM — (seed, pod hash base, node hash base)
         cpu_alloc, mem_alloc, pods_alloc,
         cpu_req, mem_req, pods_req, name_id   i32[1, C]
+          (packed layout: pods_alloc is int16[1, C], decoded in-kernel)
         taint_id, taint_eff                    i32[TS, C]
+          (packed layout: taint_id int16[TS, C]; taint_eff replaced by
+           the meta word i32[1, C] — bit 0 row validity, bits 1+2t..2+2t
+           the 2-bit effect of taint slot t; see snapshot/packing.py)
         p_cpu, p_mem, p_valid, p_nnid          i32[TB, 1]
         untol      f32[TB, M]  1.0 where pod does NOT tolerate taint id m
     Affinity refs (with_aff only):
         lkey, lval, lnum                       i32[L, C]  node label slots
+          (packed+fused layout: lkey holds the fused val<<kb|key words
+           and the lval ref is ABSENT — keys/values decode in-kernel)
         qkey       i32[Q, 1]   batch query-key table
+    ``pack`` is the static packing config (fuse_labels, key_bits) or
+    None for the plain i32 layout.
         sel_valid, sel_qidx, sel_val           i32[TB, S]
         req_tv     i32[TB, T]
         req_ev, req_qidx, req_op, req_num      i32[TB, T*E]
@@ -176,12 +185,17 @@ def _kernel(
         out_idx, out_prio  i32[TB, K] accumulator outputs
         run_prio, run_idx  i32[TB, 128] VMEM scratch (lane-aligned top-k)
     """
+    fused_labels = bool(pack and pack[0])
     it = iter(refs)
     nxt = lambda: next(it)
     (seed_ref, cpu_alloc, mem_alloc, pods_alloc, cpu_req, mem_req,
      pods_req, name_id, taint_id, taint_eff) = (nxt() for _ in range(10))
     if with_aff:
-        lkey, lval, lnum, qkey = (nxt() for _ in range(4))
+        if fused_labels:
+            lkey, lnum, qkey = (nxt() for _ in range(3))
+            lval = None
+        else:
+            lkey, lval, lnum, qkey = (nxt() for _ in range(4))
     if with_cons:
         (zone_c, region_c, sn, tn, on_,
          sz, sr, tz, tr, oz, orr) = (nxt() for _ in range(11))
@@ -211,7 +225,7 @@ def _kernel(
     # ---- NodeResourcesFit (+ row validity via pods_alloc==0 on dead rows).
     free_cpu = cpu_alloc[:] - cpu_req[:]              # [1, C]
     free_mem = mem_alloc[:] - mem_req[:]
-    free_pods = pods_alloc[:] - pods_req[:]
+    free_pods = pods_alloc[:].astype(jnp.int32) - pods_req[:]
     fits = (
         (p_cpu[:] <= free_cpu)                        # [TB, C]
         & (p_mem[:] <= free_mem)
@@ -222,8 +236,17 @@ def _kernel(
     nn_ok = (p_nnid[:] == NONE_ID) | (p_nnid[:] == name_id[:])
 
     # ---- TaintToleration via one-hot matmul (see module doc).
-    tid = taint_id[:]                                 # [TS, C]
-    teff = taint_eff[:]
+    tid = taint_id[:].astype(jnp.int32)               # [TS, C]
+    if pack is not None:
+        # Packed layout: decode the 2-bit per-slot effects out of the
+        # meta word, per chunk in VMEM — HBM only ever holds the word.
+        meta_row = taint_eff[:]                       # [1, C] i32
+        teff = jnp.concatenate(
+            [(meta_row >> (1 + 2 * t)) & 3 for t in range(taint_id.shape[0])],
+            axis=0,
+        )                                             # [TS, C]
+    else:
+        teff = taint_eff[:]
     live = tid != NONE_ID
     hard = live & (
         (teff == EFFECT_NO_SCHEDULE) | (teff == EFFECT_NO_EXECUTE)
@@ -274,10 +297,18 @@ def _kernel(
         nhi = jnp.zeros((q, c), jnp.float32)
         nlo = jnp.zeros((q, c), jnp.float32)
         for l in range(lkey.shape[0]):
-            lk = lkey[l : l + 1, :]                   # [1, C]
+            if fused_labels:
+                # Fused word: val << key_bits | key (snapshot/packing.py).
+                # Decoded per chunk in VMEM; the bit budget keeps the
+                # word non-negative so the shifts are exact.
+                w = lkey[l : l + 1, :]
+                lk = w & ((1 << pack[1]) - 1)         # [1, C]
+                lv = w >> pack[1]
+            else:
+                lk = lkey[l : l + 1, :]               # [1, C]
+                lv = lval[l : l + 1, :]
             eq = (kq == lk) & (lk != NONE_ID)         # [Q, C]
             found = jnp.where(eq, 1.0, found)
-            lv = lval[l : l + 1, :]
             vhi = jnp.where(eq, (lv >> 16).astype(jnp.float32), vhi)
             vlo = jnp.where(eq, (lv & 0xFFFF).astype(jnp.float32), vlo)
             ln = lnum[l : l + 1, :]
@@ -552,6 +583,10 @@ def _kernel(
     )
     jitter = _hash_jitter(seed_ref[0, 0], rows_n, cols_n)
     mask = fits & nn_ok & taint_ok & (p_valid[:] != 0)
+    if pack is not None:
+        # Packed layout carries row validity explicitly (meta bit 0) —
+        # matching the XLA filter chain's table.valid term exactly.
+        mask = mask & ((taint_eff[:] & 1) != 0)
     if with_aff:
         mask = mask & (sel_pass > 0) & (aff_pass > 0)
     if with_cons:
@@ -601,7 +636,7 @@ def _kernel(
     jax.jit,
     static_argnames=(
         "chunk", "k", "w_la", "w_ba", "w_tt", "w_na", "w_ts", "w_ipa",
-        "with_aff", "with_cons", "interpret",
+        "with_aff", "with_cons", "interpret", "pack",
     ),
 )
 def _call(
@@ -623,6 +658,7 @@ def _call(
     with_aff: bool,
     with_cons: bool,
     interpret: bool,
+    pack: tuple | None = None,
 ):
     n = cpu_alloc.shape[0]
     b = p_cpu.shape[0]
@@ -661,7 +697,9 @@ def _call(
     in_specs = [
         pl.BlockSpec((1, 3), lambda bi, ci: (0, 0), memory_space=pltpu.SMEM),
         col, col, col, col, col, col, col,
-        taint, taint,
+        # Packed layout: taint_eff_t is the [1, N] meta word, a col
+        # plane; plain layout streams the full [TS, N] effect plane.
+        taint, taint if pack is None else col,
     ]
     args = [
         seed.reshape(1, 3),
@@ -669,24 +707,34 @@ def _call(
         pods_alloc.reshape(1, n),
         cpu_req.reshape(1, n), mem_req.reshape(1, n), pods_req.reshape(1, n),
         name_id.reshape(1, n),
-        taint_id_t, taint_eff_t,
+        taint_id_t,
+        taint_eff_t if pack is None else taint_eff_t.reshape(1, n),
     ]
     if with_aff:
-        (lkey_t, lval_t, lnum_t, qkey,
-         sel_valid, sel_qidx, sel_val,
-         req_tv, req_ev, req_qidx, req_op, req_num, req_vals,
-         pref_tv, pref_w, pref_ev, pref_qidx, pref_op, pref_num,
-         pref_vals) = aff_args
+        if pack and pack[0]:
+            # Fused label words: one [L, N] plane instead of key+value.
+            (lkey_t, lnum_t, qkey,
+             sel_valid, sel_qidx, sel_val,
+             req_tv, req_ev, req_qidx, req_op, req_num, req_vals,
+             pref_tv, pref_w, pref_ev, pref_qidx, pref_op, pref_num,
+             pref_vals) = aff_args
+            label_planes = [lkey_t, lnum_t]
+        else:
+            (lkey_t, lval_t, lnum_t, qkey,
+             sel_valid, sel_qidx, sel_val,
+             req_tv, req_ev, req_qidx, req_op, req_num, req_vals,
+             pref_tv, pref_w, pref_ev, pref_qidx, pref_op, pref_num,
+             pref_vals) = aff_args
+            label_planes = [lkey_t, lval_t, lnum_t]
         l = lkey_t.shape[0]
         label = pl.BlockSpec(
             (l, chunk), lambda bi, ci: (0, ci), memory_space=pltpu.VMEM
         )
         qn = qkey.shape[0]
-        in_specs += [
-            label, label, label,
+        in_specs += [label] * len(label_planes) + [
             pl.BlockSpec((qn, 1), lambda bi, ci: (0, 0), memory_space=pltpu.VMEM),
         ]
-        args += [lkey_t, lval_t, lnum_t, qkey.reshape(qn, 1)]
+        args += label_planes + [qkey.reshape(qn, 1)]
     if with_cons:
         (zone, region, sn, tn, on_, sz, sr, tz, tr, oz, orr,
          cons_pod) = cons_args
@@ -724,7 +772,7 @@ def _call(
     kernel = functools.partial(
         _kernel, chunk=chunk, k=k,
         w_la=w_la, w_ba=w_ba, w_tt=w_tt, w_na=w_na, w_ts=w_ts, w_ipa=w_ipa,
-        with_aff=with_aff, with_cons=with_cons,
+        with_aff=with_aff, with_cons=with_cons, pack=pack,
     )
     idx, prio = pl.pallas_call(
         kernel,
@@ -798,13 +846,28 @@ def fused_topk(
     n = table.num_rows
     if n % chunk:
         raise ValueError(f"table rows {n} not divisible by chunk {chunk}")
+    from k8s1m_tpu.snapshot.packing import is_packed
+
+    # Packed snapshot (snapshot/packing.py): the kernel streams the
+    # packed planes and decodes per chunk in VMEM — same HBM layout as
+    # the XLA scan path, byte-identical candidates.
+    pack = None
+    if is_packed(table):
+        pack = (table.spec.fuse_labels, table.spec.key_bits)
     if with_affinity:
         _check_slots(batch)
         b = batch.batch
+        label_planes = (
+            (jnp.transpose(table.label_key), jnp.transpose(table.label_num))
+            if pack and pack[0] else
+            (
+                jnp.transpose(table.label_key),
+                jnp.transpose(table.label_val),
+                jnp.transpose(table.label_num),
+            )
+        )
         aff_args = (
-            jnp.transpose(table.label_key),
-            jnp.transpose(table.label_val),
-            jnp.transpose(table.label_num),
+            *label_planes,
             batch.qkey,
             batch.sel_valid, batch.sel_qidx, batch.sel_val,
             batch.req_term_valid,
@@ -860,7 +923,9 @@ def fused_topk(
         ]
         c = constraints
         cons_args = (
-            table.zone, table.region,
+            # Packed layout: the constraint stage's one-hot domain planes
+            # need i32 ids (two full-column casts per wave, fused by XLA).
+            table.zone.astype(i32), table.region.astype(i32),
             c.spread_node.astype(i32), c.tgt_node.astype(i32),
             c.own_node.astype(i32),
             c.spread_zone, c.spread_region, c.tgt_zone, c.tgt_region,
@@ -877,7 +942,9 @@ def fused_topk(
         ]),
         table.cpu_alloc, table.mem_alloc, table.pods_alloc,
         table.cpu_req, table.mem_req, table.pods_req, table.name_id,
-        jnp.transpose(table.taint_id), jnp.transpose(table.taint_effect),
+        jnp.transpose(table.taint_id),
+        # Packed: the meta word replaces the [N, TS] effect plane.
+        table.meta if pack is not None else jnp.transpose(table.taint_effect),
         batch.cpu, batch.mem, batch.valid, batch.node_name_id,
         1.0 - batch.tolerated.astype(jnp.float32),
         aff_args,
@@ -892,6 +959,7 @@ def fused_topk(
         with_aff=with_affinity,
         with_cons=with_cons,
         interpret=interpret,
+        pack=pack,
     )
 
 
@@ -941,8 +1009,10 @@ def pallas_candidates(
         cpu=jnp.take(free_cpu, safe),
         mem=jnp.take(free_mem, safe),
         pods=jnp.take(free_pods, safe),
-        zone=jnp.take(table.zone, safe),
-        region=jnp.take(table.region, safe),
+        # astype: the packed layout's narrow zone/region planes widen to
+        # the i32 candidate payload (no-op on the plain layout).
+        zone=jnp.take(table.zone, safe).astype(jnp.int32),
+        region=jnp.take(table.region, safe).astype(jnp.int32),
     )
 
 

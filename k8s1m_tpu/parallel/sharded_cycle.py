@@ -94,7 +94,10 @@ def make_sharded_scatter(table_sharding):
     Safe to enqueue while waves are in flight: it consumes the latest
     table future, so it executes after every dispatched wave (see the
     module doc's pipelined-mutation note)."""
-    return jax.jit(scatter_rows, out_shardings=table_sharding)
+    # Mesh donation is deferred: these executables pin out_shardings and
+    # predate buffer donation (single-device commits donate — see
+    # engine/cycle._jitted_schedule_packed and the coordinator scatter).
+    return jax.jit(scatter_rows, out_shardings=table_sharding)  # graftlint: disable=undonated-device-update (mesh donation deferred; sharding pinned)
 
 
 def mesh_offsets(table, b_local: int):
@@ -192,7 +195,7 @@ def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int):
             out_specs=(table_specs(table), cons_specs, asg_specs),
         )(table, batch, key, constraints)
 
-    return jax.jit(step)
+    return jax.jit(step)  # graftlint: disable=undonated-device-update (mesh donation deferred)
 
 
 @functools.lru_cache(maxsize=64)
@@ -339,4 +342,4 @@ def make_sharded_packed_step(
         )
         return fn(table, ints, bools, key, offset)
 
-    return jax.jit(step)
+    return jax.jit(step)  # graftlint: disable=undonated-device-update (mesh donation deferred)

@@ -43,6 +43,94 @@ def variants() -> dict[str, Profile]:
     }
 
 
+def profile_stages(
+    table,
+    enc,
+    *,
+    chunk: int,
+    k: int = 4,
+    steps: int = 3,
+    backend: str = "xla",
+    only: set[str] | None = None,
+) -> dict:
+    """Per-stage ms/batch via the plugin-knockout DCE trick, reusable
+    from sched_bench's ``--kernel-profile`` lane.
+
+    Zeroed plugin weights are static arguments, so a disabled scorer is
+    dead-code-eliminated from the trace; ``full - no-X`` is X's cost and
+    ``filter-only`` is the irreducible filter+top-k floor.  ``table``
+    may be either snapshot layout (packed tables decode in the chunk
+    slice, so the probe measures the production decode cost too); ``enc``
+    is a PodBatchHost-compatible encoder sharing the table's vocab.
+
+    Returns {"backend", "ms_per_batch": {variant: ms}, "stages":
+    {plugin: ms-delta}} — deltas can be slightly negative at small
+    shapes (timing noise); they are reported raw, not clamped.
+    """
+    import functools as _ft
+
+    from k8s1m_tpu.engine.cycle import filter_score_topk
+    from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
+
+    pods = uniform_pods(enc.spec.batch)
+    picked = variants()
+    if only:
+        picked = {n: p for n, p in picked.items() if n in only}
+    ms: dict[str, float] = {}
+
+    if backend == "pallas":
+        batch = enc.encode(pods)
+
+        def run(prof, i):
+            idx, _ = fused_topk(
+                table, batch, jnp.int32(i), prof,
+                chunk=chunk, k=k, with_affinity=False,
+            )
+            return idx
+    else:
+        packed = enc.encode_packed(pods)
+        keys = list(jax.random.split(jax.random.key(0), steps + 1))
+
+        @_ft.lru_cache(maxsize=None)
+        def _fn(prof):
+            def fn(table, ints, bools, key):
+                b = unpack_pod_batch(
+                    ints, bools, packed.spec, packed.table_spec,
+                    packed.groups,
+                )
+                return filter_score_topk(
+                    table, b, key, prof, chunk=chunk, k=k
+                ).idx
+
+            return jax.jit(fn)
+
+        def run(prof, i):
+            return _fn(prof)(table, packed.ints, packed.bools, keys[i])
+
+    for name, prof in picked.items():
+        idx = run(prof, 0)
+        jax.device_get(idx)      # compile + settle
+        t0 = time.perf_counter()
+        for i in range(steps):
+            idx = run(prof, i + 1)
+        jax.device_get(idx)      # the relay needs a fetch (module doc)
+        ms[name] = round((time.perf_counter() - t0) / steps * 1e3, 3)
+
+    stages: dict[str, float] = {}
+    if "full" in ms:
+        for knock, label in (
+            ("no-least-allocated", "least_allocated"),
+            ("no-balanced-allocation", "balanced_allocation"),
+            ("no-taint-toleration", "taint_toleration"),
+        ):
+            if knock in ms:
+                # full - knocked-out = the zeroed plugin's cost.
+                stages[label] = round(ms["full"] - ms[knock], 3)
+        if "filter-only" in ms:
+            stages["filter_topk_floor"] = ms["filter-only"]
+    return {"backend": backend, "ms_per_batch": ms, "stages": stages}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description="pallas kernel component probe")
     ap.add_argument("--nodes", type=int, default=13 * 4096,
@@ -60,79 +148,55 @@ def main(argv=None):
         "(engine/cycle.py) — the decomposition tool for whichever "
         "backend is under investigation",
     )
+    ap.add_argument(
+        "--packing", choices=("off", "packed"), default=None,
+        help="device-snapshot layout (snapshot/packing.py): 'packed' "
+        "probes the bit/byte-packed production layout, so the per-chunk "
+        "decode cost shows up in every variant's ms.  Unset defers to "
+        "K8S1M_PACKING — same resolution as bench.py/sched_bench, so "
+        "one env var keeps the whole evidence pipeline on one layout",
+    )
     args = ap.parse_args(argv)
+    from k8s1m_tpu.snapshot.packing import resolve_packing
+
+    args.packing = resolve_packing(args.packing)
 
     spec = TableSpec(max_nodes=args.nodes)
     host = NodeTableHost(spec)
     populate_kwok_nodes(host, args.nodes)
-    table = host.to_device()
+    from k8s1m_tpu.snapshot.packing import is_packed, pack_table_auto
+
+    if args.packing == "packed":
+        table = pack_table_auto(host, spec)
+    else:
+        table = host.to_device()
     enc = PodBatchHost(PodSpec(batch=args.batch), spec, host.vocab)
-    batch = enc.encode(uniform_pods(args.batch))
 
-    if args.backend == "xla":
-        import functools
-
-        from k8s1m_tpu.engine.cycle import filter_score_topk
-        from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
-
-        # The PRODUCTION path: packed buffers + trace-time field groups,
-        # so selector-free waves prune the affinity machinery exactly
-        # like the coordinator's step does.  (Probing with a plain
-        # PodBatch keeps all-NONE selector arrays as runtime inputs XLA
-        # cannot DCE — ~45s/wave of dead label resolution on CPU.)
-        packed = enc.encode_packed(uniform_pods(args.batch))
-
-        @functools.lru_cache(maxsize=None)
-        def _xla_fn(prof):
-            # One jit wrapper per profile — rebuilding it per step would
-            # recompile every step.
-            def fn(table, ints, bools, key):
-                b = unpack_pod_batch(
-                    ints, bools, packed.spec, packed.table_spec,
-                    packed.groups,
-                )
-                return filter_score_topk(
-                    table, b, key, prof, chunk=args.chunk, k=args.k
-                ).idx
-
-            return jax.jit(fn)
-
-        def run_xla(prof, key):
-            return _xla_fn(prof)(table, packed.ints, packed.bools, key)
-
+    only = (
+        {n.strip() for n in args.only.split(",")} if args.only else None
+    )
     picked = variants()
-    if args.only:
-        names = {n.strip() for n in args.only.split(",")}
-        picked = {n: p for n, p in picked.items() if n in names}
-    for name, prof in picked.items():
-        if args.backend == "xla":
-            keys = list(jax.random.split(jax.random.key(0), args.steps + 1))
-            idx = run_xla(prof, keys[0])
-            jax.device_get(idx)  # compile + settle
-            t0 = time.perf_counter()
-            for i in range(args.steps):
-                idx = run_xla(prof, keys[i + 1])
-            jax.device_get(idx)
-        else:
-            idx, _ = fused_topk(
-                table, batch, jnp.int32(0), prof,
-                chunk=args.chunk, k=args.k, with_affinity=False,
-            )
-            jax.device_get(idx)      # compile + settle
-            t0 = time.perf_counter()
-            for i in range(args.steps):
-                idx, _ = fused_topk(
-                    table, batch, jnp.int32(i + 1), prof,
-                    chunk=args.chunk, k=args.k, with_affinity=False,
-                )
-            # the relay needs a fetch, not block_until_ready
-            jax.device_get(idx)
-        dt = (time.perf_counter() - t0) / args.steps
+    for name in picked:
+        if only and name not in only:
+            continue
+        # One variant per profile_stages call so each JSON line lands as
+        # soon as its variant finishes (serial on-chip runs recompile
+        # per variant, ~15-30s each).
+        res = profile_stages(
+            table, enc, chunk=args.chunk, k=args.k, steps=args.steps,
+            backend=args.backend, only={name},
+        )
+        dt_ms = res["ms_per_batch"][name]
         print(json.dumps({
             "variant": name,
             "backend": args.backend,
-            "ms_per_batch": round(dt * 1e3, 2),
-            "binds_per_sec_equiv": round(args.batch / dt, 1),
+            # The mode actually in effect: pack_table_auto falls back
+            # to unpacked when taint_slots outgrow the meta word.
+            "packing": "packed" if is_packed(table) else "off",
+            "ms_per_batch": dt_ms,
+            "binds_per_sec_equiv": (
+                round(args.batch / (dt_ms / 1e3), 1) if dt_ms else None
+            ),
             "nodes": args.nodes,
             "batch": args.batch,
         }), flush=True)

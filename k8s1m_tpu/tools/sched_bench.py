@@ -55,7 +55,14 @@ def parse_args(argv=None):
     ap.add_argument("--pods", type=int, default=50_000)
     ap.add_argument("--batch", type=int, default=4096)
     ap.add_argument("--chunk", type=int, default=None)
-    ap.add_argument("--backend", choices=("xla", "pallas"), default="pallas")
+    ap.add_argument(
+        "--backend", choices=("auto", "xla", "pallas"), default="auto",
+        help="filter+score+top-k backend.  'auto' (default) picks the "
+        "fused pallas kernel only when the jax backend is a real TPU "
+        "and the XLA scan path otherwise — on CPU envs the kernel runs "
+        "INTERPRETED, orders of magnitude slower, so an unconditional "
+        "pallas default silently produced misleading numbers",
+    )
     ap.add_argument("--target", default=None,
                     help="remote store addr (default: in-process store)")
     ap.add_argument("--ca-pem", default=None,
@@ -176,6 +183,22 @@ def parse_args(argv=None):
     )
     ap.add_argument("--seed", type=int, default=0,
                     help="tenant-assignment seed")
+    ap.add_argument(
+        "--packing", choices=("off", "packed"), default=None,
+        help="device-snapshot layout (snapshot/packing.py): 'packed' "
+        "holds the cold node-table columns bit/byte-packed in HBM "
+        "(byte-identical binds, >=2x less cold-column HBM).  Unset "
+        "defers to K8S1M_PACKING.  Layout + donation evidence lands in "
+        "the report's device_state detail",
+    )
+    ap.add_argument(
+        "--kernel-profile", action="store_true",
+        help="after the measured window, decompose the device step via "
+        "the plugin-knockout DCE trick (tools/kernel_probe.py): per-"
+        "stage ms/batch and bytes/node land in the report's "
+        "kernel_profile detail (each variant compiles once — budget "
+        "seconds on CPU, tens of seconds on TPU)",
+    )
     args = ap.parse_args(argv)
     if args.overload_at and not args.rate:
         ap.error("--overload-at requires --rate (the paced producer)")
@@ -244,6 +267,54 @@ def _tenant_detail(args) -> dict:
         "schedule": args.tenant_schedule,
         "seed": args.seed,
     }}
+
+
+def _device_state_detail(coord) -> dict:
+    """Device-snapshot layout + donation evidence (ISSUE 10): table
+    layout, HBM bytes/node (total and cold-column, with the reduction
+    ratio vs the plain i32 layout), whether per-wave commit donation ran
+    in place, and any fail-closed layout rebuilds."""
+    if coord.table is None:
+        return {}
+    from k8s1m_tpu.obs.metrics import REGISTRY
+    from k8s1m_tpu.snapshot.packing import FALLBACK_REASONS, bytes_report
+
+    fb = REGISTRY.get("device_packing_fallback_total")
+    return {"device_state": {
+        **bytes_report(coord.table, coord.table_spec),
+        "donation_inplace": coord.donation_inplace,
+        "packing_fallbacks": {
+            r: int(fb.value(reason=r))
+            for r in FALLBACK_REASONS if fb.value(reason=r)
+        },
+    }}
+
+
+def _kernel_profile_detail(args, coord) -> dict:
+    """Per-stage device-step decomposition for the report (opt-in:
+    --kernel-profile; each plugin-knockout variant is its own compile).
+    Runs over the coordinator's LIVE table — layout, request columns and
+    vocab exactly as the measured window left them."""
+    if not args.kernel_profile or coord.table is None:
+        return {}
+    if coord.mesh is not None:
+        # profile_stages runs the SINGLE-DEVICE step; over a sharded
+        # table it would time an unintended resharded/gathered run (or
+        # error at report-write time, losing the whole run).  Same
+        # deferred-composition stance as packing+mesh.
+        print("# --kernel-profile does not compose with --mesh yet; "
+              "skipping the profile lane", file=sys.stderr)
+        return {}
+    from k8s1m_tpu.snapshot.packing import bytes_report
+    from k8s1m_tpu.tools.kernel_probe import profile_stages
+
+    prof = profile_stages(
+        coord.table, coord.encoder, chunk=args.chunk, k=coord.k,
+        steps=3, backend=args.backend,
+    )
+    prof["bytes_per_node"] = bytes_report(coord.table, coord.table_spec)
+    prof["batch"] = coord.pod_spec.batch
+    return {"kernel_profile": prof}
 
 
 def _resilience_detail() -> dict:
@@ -519,6 +590,13 @@ def main(argv=None):
     # interrogated without being killed.
     install_signal_dump()
     args = parse_args(argv)
+    if args.backend == "auto":
+        # The fused kernel is only a win compiled on real TPU silicon;
+        # everywhere else it runs interpreted (orders of magnitude
+        # slower), so auto picks the XLA scan path off-TPU.
+        import jax
+
+        args.backend = "pallas" if jax.default_backend() == "tpu" else "xla"
     if args.chunk is None:
         args.chunk = (1 << 12) if args.backend == "pallas" else (1 << 14)
     if args.stress_watchers and not args.target:
@@ -575,6 +653,7 @@ def main(argv=None):
         # Already resolved above (env included): a built Mesh, or
         # "none" so the Coordinator does NOT re-read K8S1M_MESH.
         mesh=mesh if mesh is not None else "none",
+        packing=args.packing,
     )
     t0 = time.perf_counter()
     coord.bootstrap()
@@ -740,6 +819,7 @@ def main(argv=None):
             "detail": {
                 "rate": args.rate,
                 "mesh": args.mesh,
+                "backend": args.backend,
                 "score_pct": args.score_pct,
                 "overload": (
                     {"at_s": args.overload_at,
@@ -762,6 +842,8 @@ def main(argv=None):
                 **_mesh_detail(coord, feed_depth_samples),
                 **_tenant_detail(args),
                 **_encode_profile_detail(args.encode_profile),
+                **_device_state_detail(coord),
+                **_kernel_profile_detail(args, coord),
                 **_resilience_detail(),
             },
         }, args.out)
@@ -841,6 +923,7 @@ def main(argv=None):
         "detail": {
             "score_pct": args.score_pct,
             "mesh": args.mesh,
+            "backend": args.backend,
             "pods": args.pods,
             "bound": bound,
             "deleted": deleted,
@@ -856,6 +939,8 @@ def main(argv=None):
             **_mesh_detail(coord, feed_depth_samples),
             **_tenant_detail(args),
             **_encode_profile_detail(args.encode_profile),
+            **_device_state_detail(coord),
+            **_kernel_profile_detail(args, coord),
             **_resilience_detail(),
         },
     }, args.out)

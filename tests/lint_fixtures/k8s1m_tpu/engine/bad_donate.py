@@ -1,0 +1,13 @@
+"""undonated-device-update true positive: a jitted table update without
+buffer donation — every wave pays a copy-on-write table in HBM."""
+
+import jax
+
+from k8s1m_tpu.snapshot.node_table import scatter_rows
+
+
+def update_table(table, rows, delta):
+    return scatter_rows(table, rows, delta)
+
+
+jitted_update = jax.jit(update_table)

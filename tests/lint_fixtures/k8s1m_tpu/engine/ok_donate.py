@@ -1,0 +1,13 @@
+"""undonated-device-update pragma twin: the same non-donating jit,
+suppressed with a stated reason (a replay surface keeps inputs alive)."""
+
+import jax
+
+from k8s1m_tpu.snapshot.node_table import scatter_rows
+
+
+def update_table(table, rows, delta):
+    return scatter_rows(table, rows, delta)
+
+
+jitted_update = jax.jit(update_table)  # graftlint: disable=undonated-device-update (replay surface: callers re-run the same table)

@@ -45,6 +45,7 @@ EXPECTED = {
     "lock-order-cycle": "k8s1m_tpu/control/bad_lockorder.py",
     "mesh-purity": "k8s1m_tpu/parallel/bad_mesh.py",
     "fenced-store-write": "k8s1m_tpu/control/bad_fenced_write.py",
+    "undonated-device-update": "k8s1m_tpu/engine/bad_donate.py",
 }
 
 
@@ -62,6 +63,42 @@ def test_every_rule_has_a_true_positive_fixture(fixture_result):
 
 def test_rule_ids_cover_expectations():
     assert {r.id for r in ALL_RULES} == set(EXPECTED)
+
+
+def test_donate_rule_covers_decorator_spellings():
+    """undonated-device-update must catch the decorator forms too —
+    @jax.jit and @functools.partial(jax.jit, ...) are the house idiom
+    (ops/pallas_topk._call), and a bare decorator can never donate."""
+    import ast
+    import textwrap
+
+    from k8s1m_tpu.lint.base import SourceFile
+    from k8s1m_tpu.lint.rules_donate import UndonatedDeviceUpdate
+
+    src = textwrap.dedent('''
+        import functools
+        import jax
+        from k8s1m_tpu.snapshot.node_table import scatter_rows
+
+        @jax.jit
+        def bare(table, rows, delta):
+            return scatter_rows(table, rows, delta)
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def parted(table, rows, delta, k):
+            return scatter_rows(table, rows, delta)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def donated(table, rows, delta):
+            return scatter_rows(table, rows, delta)
+    ''')
+    f = SourceFile(
+        path="k8s1m_tpu/engine/synthetic.py", abspath="synthetic.py",
+        tree=ast.parse(src), lines=src.splitlines(), pragmas={},
+    )
+    lines = {x.line for x in UndonatedDeviceUpdate().check_file(f)}
+    # bare + parted flagged (on their decorator lines); donated clean.
+    assert len(lines) == 2
 
 
 def test_pragma_twins_pass(fixture_result):
@@ -188,7 +225,7 @@ def test_cli_entry_point_agrees():
 
 def test_cli_json_output_and_bounded_time():
     """``--json`` is the machine-readable CI shape (rule -> count ->
-    files), and the FULL run (all 10 passes, interprocedural lockgraph
+    files), and the FULL run (all 12 passes, interprocedural lockgraph
     included) stays under the 60s budget on this env — the bound that
     keeps the gate usable as a pre-commit check while the rule count
     grows."""

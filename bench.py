@@ -136,13 +136,24 @@ def _require_device(cpu_lane: bool, timeout_s: float = 240.0):
 
 def _cpu_baseline(metric: str) -> float | None:
     """Committed CPU-lane baseline value for ``metric`` (None when the
-    artifact is missing or describes a different shape)."""
+    artifact is missing or describes a different shape).  A mesh run at
+    the baseline shape compares against the single-device baseline (the
+    ``_meshDPxSP`` suffix is stripped for the lookup): the committed
+    number answers "did composing the mesh cost throughput at the same
+    shape", which is exactly the no-composition-regression gate."""
+    import re
+
     try:
         with open(_CPU_BASELINE_PATH) as f:
             data = json.load(f)
     except (OSError, ValueError):
         return None
-    if data.get("metric") != metric or not data.get("value"):
+    committed = data.get("metric")
+    if not data.get("value"):
+        return None
+    if committed != metric and committed != re.sub(
+        r"_mesh\d+x\d+", "", metric
+    ):
         return None
     return float(data["value"])
 
@@ -192,7 +203,9 @@ def main():
         "holds the cold node-table columns bit/byte-packed in HBM and "
         "decodes per chunk on device — byte-identical binds, >=2x less "
         "cold-column HBM (the report's cold_bytes_reduction).  Unset "
-        "defers to K8S1M_PACKING.  Does not compose with --mesh.",
+        "defers to K8S1M_PACKING.  Composes with --mesh: the packed "
+        "planes shard over sp and decode in the shard-local chunk "
+        "slice (the production path since meshpack).",
     )
     ap.add_argument(
         "--constraints", action="store_true",
@@ -214,8 +227,6 @@ def main():
     from k8s1m_tpu.snapshot.packing import resolve_packing
 
     args.packing = resolve_packing(args.packing)
-    if args.packing == "packed" and args.mesh:
-        ap.error("--packing packed does not compose with --mesh yet")
     if args.cpu_lane and not _in_cpu_env():
         # An explicit --cpu-lane invoked from the axon-hooked env: the
         # lane needs the cleaned CPU interpreter, same as the tests.
@@ -348,10 +359,11 @@ def main():
         pods = uniform_pods(args.batch)
 
     enc = PodBatchHost(pod_spec, spec, host.vocab)
+    table_sharding = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        table = host.to_device(NamedSharding(mesh, P("sp")))
+        table_sharding = NamedSharding(mesh, P("sp"))
         if constraints is not None:
             from k8s1m_tpu.parallel.mesh import constraint_specs
 
@@ -362,12 +374,14 @@ def main():
                     constraint_specs(constraints),
                 ),
             )
-    elif args.packing == "packed":
+    if args.packing == "packed":
+        # Composes with the mesh (meshpack): the packed planes land
+        # sharded over sp exactly like the plain columns.
         from k8s1m_tpu.snapshot.packing import pack_table_auto
 
-        table = pack_table_auto(host, spec)
+        table = pack_table_auto(host, spec, table_sharding)
     else:
-        table = host.to_device()
+        table = host.to_device(table_sharding)
     from k8s1m_tpu.snapshot.packing import bytes_report
 
     layout_report = bytes_report(table, spec)
@@ -387,10 +401,12 @@ def main():
             return 0
         return sample_offset_for(i, window_nodes, sample_rows)
 
-    # The production shape: single-device steps donate the table (and
-    # constraint) buffers so the per-wave commit is in-place in HBM.
-    # Safe here because the loop reassigns ``table`` from every return.
-    donate = mesh is None
+    # The production shape on BOTH paths: the step donates the table
+    # (and constraint) buffers so the per-wave commit is in-place in
+    # HBM — the mesh executables pin out_specs AND donate, aliasing
+    # shard-by-shard.  Safe here because the loop reassigns ``table``
+    # from every return.
+    donate = True
 
     def step(table, constraints, i):
         table, constraints, _asg, rows = schedule_batch_packed(

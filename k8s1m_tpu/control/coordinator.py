@@ -294,10 +294,11 @@ _DONATION = Counter(
 )
 _PACKING_FALLBACK = Counter(
     "device_packing_fallback_total",
-    "Fail-closed packed-layout rebuilds, by reason (field that overflowed "
-    "its static bit budget — vocab drift — or 'mesh' for the unsupported "
-    "mesh composition); the coordinator falls back to a wider layout, "
-    "never truncates",
+    "Fail-closed packed-layout rebuilds, by reason (the field that "
+    "overflowed its static bit budget — vocab drift — or 'taint_slots' "
+    "for a spec the meta word cannot hold); the coordinator widens the "
+    "layout ONCE, host-side and mesh-global, never truncates and never "
+    "decides per-shard",
     ("reason",),
 )
 
@@ -520,8 +521,11 @@ class Coordinator:
         # byte-identical binds, >=2x less cold-column HBM.  None defers
         # to the K8S1M_PACKING env var ("off" default).  Fail-closed:
         # vocab drift past the static bit budget rebuilds under a wider
-        # layout (device_packing_fallback_total); the mesh path does not
-        # compose with packing yet and falls back to "off" with a log.
+        # layout (device_packing_fallback_total) — the widening decision
+        # is made ONCE on the host, so a mesh coordinator never diverges
+        # per-shard.  Composes with ``mesh`` (meshpack): the packed
+        # planes shard over sp like the plain columns and decode inside
+        # the shard-local chunk slice.
         packing: str | None = None,
     ):
         self.store = store
@@ -644,19 +648,12 @@ class Coordinator:
         # first table upload so the label-fusion fail-closed decision
         # sees the bootstrap vocab, not an empty one.
         self._packing_mode = resolve_packing(packing)
-        if self._packing_mode == "packed" and mesh is not None:
-            log.warning(
-                "packed snapshot does not compose with the mesh path yet; "
-                "falling back to the unpacked layout (packing=off)"
-            )
-            _PACKING_FALLBACK.inc(reason="mesh")
-            self._packing_mode = "off"
         self._packing_spec = None
-        # Buffer donation: the single-device step and dirty-row scatter
-        # donate the table (and constraint) buffers so per-wave commits
-        # are in-place in HBM; the mesh step keeps copy-on-write (its
-        # out_shardings-pinned executables predate donation).
-        self._donate = mesh is None
+        # Buffer donation: every execution path donates the table (and
+        # constraint) buffers so per-wave commits are in-place in HBM —
+        # the mesh executables pin their out_shardings AND donate
+        # (pinning and donation compose; XLA aliases shard-by-shard).
+        self._donate = True
         self._donation_inplace: bool | None = None
         self._packing_rebuilding = False
 
@@ -724,8 +721,8 @@ class Coordinator:
             empty_constraints(table_spec) if with_constraints else None
         )
         self._table_sharding = None
-        # Single-device scatters donate (in-place dirty-row updates);
-        # the mesh override below pins sharding instead.
+        # Dirty-row scatters donate on both paths (in-place updates);
+        # the mesh override below additionally pins the row sharding.
         self._scatter = _scatter_rows_donated
         self._adjust = adjust_constraints
         if mesh is not None:
@@ -756,9 +753,13 @@ class Coordinator:
                 )
                 # Same drift guard as _scatter: out-of-step constraint
                 # corrections (deletes, CAS rollbacks) must hand the
-                # state back sharded, or every later wave reshards it.
-                self._adjust = jax.jit(  # graftlint: disable=undonated-device-update (mesh donation deferred; sharding pinned)
+                # state back sharded, or every later wave reshards it —
+                # and, like the scatter, they donate the constraint
+                # buffers (the coordinator always reassigns
+                # self.constraints from the return).
+                self._adjust = jax.jit(
                     adjust_constraints_impl, static_argnames=("sign",),
+                    donate_argnums=(0,),
                     out_shardings=cons_shardings,
                 )
         self.key = jax.random.key(seed)
@@ -1877,8 +1878,11 @@ class Coordinator:
     @property
     def donation_inplace(self) -> bool | None:
         """Whether the runtime honored per-wave buffer donation in place
-        (None until the first donating wave's probe runs; stays None on
-        the mesh path, which never donates).  The public read for bench/
+        (None until the first donating wave's probe runs).  On the mesh
+        the probe is per-shard: it collects every shard's buffer
+        pointers before the first wave and reports in-place when ANY
+        shard's post-step buffer set overlaps the probed set
+        (snapshot/packing.donation_probe).  The public read for bench/
         report surfaces — `commit_donation_total{inplace}` is the
         per-wave counter."""
         return self._donation_inplace
@@ -1921,13 +1925,25 @@ class Coordinator:
         """A dirty-row delta no longer fits the packed layout: widen the
         layout, retire the pipeline (the host mirror is authoritative
         for everything EXCEPT the in-flight assume chain, so the waves
-        must land before a wholesale re-upload), and rebuild."""
+        must land before a wholesale re-upload), and rebuild.
+
+        Cross-shard widening protocol (meshpack): the widening decision
+        — split label words vs drop to unpacked — happens ONCE, here on
+        the host (_packing_fallback mutates the one PackingSpec every
+        shard shares), never per-shard; the quiesce retires every
+        in-flight donating wave, and on the mesh the rebuild then
+        BLOCKS on the retired table so every shard's in-flight donated
+        buffers have settled before the wholesale re-upload replaces
+        them — a shard still executing against donated HBM while the
+        re-upload lands would be a per-shard layout skew."""
         self._packing_fallback(e)
         self._packing_rebuilding = True
         try:
             self._quiesce("packing")
         finally:
             self._packing_rebuilding = False
+        if self.mesh is not None and self.table is not None:
+            jax.block_until_ready(jax.tree.leaves(self.table))
         self._dirty_rows.clear()
         self._dirty_caps.clear()
         self.table = self._table_to_device()
@@ -3420,6 +3436,7 @@ class Coordinator:
 # DONATING: the coordinator always reassigns self.table from the
 # return, so the churn scatter updates HBM in place instead of
 # copy-on-write.  The mesh path swaps in
-# parallel.sharded_cycle.make_sharded_scatter; a replay caller that
-# keeps its input table alive must jit its own non-donating wrapper.
+# parallel.sharded_cycle.make_sharded_scatter — equally donating, with
+# the row sharding pinned on top; a replay caller that keeps its input
+# table alive must jit its own non-donating wrapper.
 _scatter_rows_donated = jax.jit(scatter_rows, donate_argnums=(0,))

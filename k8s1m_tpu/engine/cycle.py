@@ -170,14 +170,17 @@ def _slice_table(table: NodeTable, start, chunk: int) -> NodeTable:
     return unpack_chunk(sliced) if is_packed(sliced) else sliced
 
 
-def _prologue_stats(table, constraints):
+def _prologue_stats(table, constraints, axis_name: str | None = None):
     """topology.prologue over either layout: the prologue needs only the
     full valid/zone/region columns, which a packed table decodes ONCE per
-    wave (global domain statistics don't belong in a chunk decode)."""
+    wave (global domain statistics don't belong in a chunk decode).
+    ``axis_name`` is the shard_map node-shard axis ("sp") so the sharded
+    cycle shares this decode: domain reductions cross shards while the
+    DomainView decode stays shard-local."""
     from k8s1m_tpu.plugins import topology
 
     view = table.domain_view() if is_packed(table) else table
-    return topology.prologue(view, constraints)
+    return topology.prologue(view, constraints, axis_name=axis_name)
 
 
 def topk_by_argmax(prio, k: int):
@@ -757,10 +760,12 @@ def schedule_batch_packed(
 
     ``donate=True`` donates the table's (and constraint state's) buffers
     to the step so the per-wave commit is in-place in HBM instead of
-    copy-on-write — the production coordinator path.  The caller's input
-    references are DEAD afterwards (reassign from the return value);
-    replay/differential callers that re-run the same table must keep the
-    default.  Single-device only (the mesh step never donates).
+    copy-on-write — the production coordinator path, on BOTH execution
+    paths: the single-device step and the mesh step donate alike (the
+    sharded executables pin their out_specs, so each shard's buffers
+    alias in place).  The caller's input references are DEAD afterwards
+    (reassign from the return value); replay/differential callers that
+    re-run the same table must keep the default.
 
     ``table`` may be a snapshot.packing.PackedNodeTable (the packed
     production layout): chunks decode on-device inside the scan slice on
@@ -786,6 +791,7 @@ def schedule_batch_packed(
             mesh, profile, chunk=chunk, k=k,
             pod_spec=packed.spec, table_spec=packed.table_spec,
             groups=packed.groups, sample_rows=sample_rows, backend=backend,
+            donate=donate,
         )
         offset = np.int32(sample_offset)
         if constraints is not None:

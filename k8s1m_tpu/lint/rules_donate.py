@@ -13,10 +13,13 @@ wrapped callable (transitively, within the file) reaches one of the
 table-update primitives must pass ``donate_argnums``/``donate_argnames``
 — or carry the usual pragma with a reason.
 
-Legitimate non-donating variants exist and are pragma'd where they live:
-replay/differential surfaces (tests re-run one table; donation would
-delete it) and the mesh executables (out_shardings pinned, donation
-deferred).  The pragma forces each one to say WHY, which is the point.
+Legitimate non-donating variants exist and are pragma'd where they
+live: replay/differential surfaces (tests re-run one table; donation
+would delete it).  The mesh executables are NOT among them since
+meshpack — out_shardings pinning and donation compose (XLA aliases
+shard-by-shard), so the production sharded step/scatter/adjust all
+donate.  The pragma forces each remaining exception to say WHY, which
+is the point.
 
 Resolution is name-based and file-local (the graftlint house style —
 see rules_fence.py): the wrapped callable is resolved through direct
@@ -70,6 +73,16 @@ def _called_names(node: ast.AST) -> set[str]:
     return out
 
 
+def _callable_slots(call: ast.Call) -> list[ast.expr]:
+    """The argument positions that can hold a wrapped callable: first
+    positional, or jit's keyword spelling (``jax.jit(fun=impl)``).
+    Shared by alias resolution and jit-site detection so the slot rule
+    can never desynchronize between them."""
+    return list(call.args[:1]) + [
+        kw.value for kw in call.keywords if kw.arg == "fun"
+    ]
+
+
 class UndonatedDeviceUpdate(Rule):
     id = "undonated-device-update"
 
@@ -93,6 +106,24 @@ class UndonatedDeviceUpdate(Rule):
                     got = _called_names(node.value)
                 elif isinstance(node.value, ast.Name):
                     got = {node.value.id}      # alias: fn = impl
+                elif isinstance(node.value, ast.Call):
+                    # Wrapper aliasing: fn = shard_map_compat(impl, ...)
+                    # / step = jax.jit(impl, ...) — the bound name
+                    # reaches the wrapped callable, so a later jit of
+                    # the wrapper is still covered.  Only the callable
+                    # SLOT aliases (first positional, or jit's ``fun=``
+                    # spelling) — treating every argument as the
+                    # wrapped callable would make plain-data uses of an
+                    # updater name (`make_runner(cfg, scatter_rows)`)
+                    # false-positive.
+                    got = set()
+                    for a in _callable_slots(node.value):
+                        if isinstance(a, ast.Name):
+                            got.add(a.id)
+                        elif isinstance(a, ast.Lambda):
+                            got |= _called_names(a)
+                    if not got:
+                        continue
                 else:
                     continue
                 for n in names:
@@ -123,9 +154,9 @@ class UndonatedDeviceUpdate(Rule):
             "jitted function returns an updated device table but "
             "does not donate its input buffers (donate_argnums): "
             "every wave pays a full copy-on-write table in HBM.  "
-            "Donate, or pragma with the reason this call site must "
-            "keep its inputs alive (replay surface / mesh "
-            "out_shardings)"
+            "Donate (out_shardings pinning composes with donation), "
+            "or pragma with the reason this call site must keep its "
+            "inputs alive (replay surface)"
         )
 
         def jit_decorator(dec) -> tuple[bool, bool]:
@@ -155,7 +186,7 @@ class UndonatedDeviceUpdate(Rule):
                     continue
                 if any(kw.arg in DONATE_KWARGS for kw in node.keywords):
                     continue
-                if not node.args or not wraps_updater(node.args[0]):
+                if not any(wraps_updater(a) for a in _callable_slots(node)):
                     continue
                 out.append(self.finding(f, node, MSG))
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):

@@ -17,7 +17,11 @@ Two mesh axes replace the reference's two distribution mechanisms
 
 Node tables shard over ``sp`` and replicate over ``dp``; pod batches shard
 over ``dp`` and replicate over ``sp``; scalar/leaf metadata (qkey, PRNG
-key) is replicated everywhere.
+key) is replicated everywhere.  The specs are layout-agnostic: the packed
+production snapshot (snapshot/packing.PackedNodeTable) shards its planes
+— meta word, fused label words, int16/int8 scalars — over ``sp`` exactly
+like the plain i32 columns, which is what lets packed × sharded run as
+one production path (meshpack).
 """
 
 from __future__ import annotations
@@ -129,8 +133,11 @@ def resolve_mesh(
     return mesh
 
 
-def table_specs(table: NodeTable) -> NodeTable:
-    """PartitionSpec pytree: every node-table leaf shards its row axis over sp."""
+def table_specs(table):
+    """PartitionSpec pytree: every node-table leaf shards its row axis
+    over sp.  Accepts either layout — a plain ``NodeTable`` or a packed
+    ``PackedNodeTable`` (whose static ``spec`` rides the pytree aux data,
+    so the tree.map covers exactly the array planes)."""
     return jax.tree.map(lambda _: P("sp"), table)
 
 

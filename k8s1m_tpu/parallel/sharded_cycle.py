@@ -39,6 +39,16 @@ and wave N+1 with no host sync and no quiesce.  The scatter is pinned to
 the table's row sharding (out_shardings) for the same reason the
 coordinator pins its single-device scatter: a replicated output here
 would silently serialize every later wave behind a reshard.
+
+Donation (meshpack): the production step, scatter, and adjust
+executables all donate the table/constraint buffers — pinning and
+donation compose (inputs arrive sp-sharded, outputs are pinned
+sp-sharded, XLA aliases shard-by-shard), so per-wave bind commits and
+dirty-row churn scatters update sharded HBM in place instead of paying
+a copy-on-write table per wave.  The packed snapshot layout
+(snapshot/packing.py) rides the same specs: packed planes shard on sp
+and decode inside the shard-local chunk slice, identical to the
+single-device scan.
 """
 
 from __future__ import annotations
@@ -90,14 +100,24 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs):
 
 def make_sharded_scatter(table_sharding):
     """Dirty-row scatter pinned to the table's row sharding — the mesh
-    form of the coordinator's jitted snapshot.node_table.scatter_rows.
-    Safe to enqueue while waves are in flight: it consumes the latest
-    table future, so it executes after every dispatched wave (see the
-    module doc's pipelined-mutation note)."""
-    # Mesh donation is deferred: these executables pin out_shardings and
-    # predate buffer donation (single-device commits donate — see
-    # engine/cycle._jitted_schedule_packed and the coordinator scatter).
-    return jax.jit(scatter_rows, out_shardings=table_sharding)  # graftlint: disable=undonated-device-update (mesh donation deferred; sharding pinned)
+    form of the coordinator's donating jitted
+    snapshot.node_table.scatter_rows.  Safe to enqueue while waves are
+    in flight: it consumes the latest table future, so it executes
+    after every dispatched wave (see the module doc's pipelined-mutation
+    note).
+
+    Donation + pinning compose (meshpack): the input table arrives
+    already placed on ``table_sharding`` and the output is pinned to
+    the same sharding, so XLA aliases each shard's buffers in place —
+    the churn scatter updates sharded HBM without a copy-on-write
+    table, and without letting the partitioner drift the table onto a
+    replicated layout (which would serialize every later wave behind a
+    reshard).  The coordinator always reassigns ``self.table`` from the
+    return; a replay caller that keeps its input table alive must jit
+    its own non-donating wrapper."""
+    return jax.jit(
+        scatter_rows, donate_argnums=(0,), out_shardings=table_sharding
+    )
 
 
 def mesh_offsets(table, b_local: int):
@@ -195,7 +215,10 @@ def make_sharded_step(mesh, profile: Profile, *, chunk: int, k: int):
             out_specs=(table_specs(table), cons_specs, asg_specs),
         )(table, batch, key, constraints)
 
-    return jax.jit(step)  # graftlint: disable=undonated-device-update (mesh donation deferred)
+    # Replay/dev surface (tests, dryruns, multihost smokes re-run one
+    # table): the production mesh executable is make_sharded_packed_step
+    # with donate=True.
+    return jax.jit(step)  # graftlint: disable=undonated-device-update (replay/dev surface; production donates via make_sharded_packed_step)
 
 
 @functools.lru_cache(maxsize=64)
@@ -210,12 +233,28 @@ def make_sharded_packed_step(
     groups: frozenset,
     sample_rows: int | None = None,
     backend: str = "xla",
+    donate: bool = False,
 ):
     """The mesh analogue of engine.cycle._jitted_schedule_packed: the
     coordinator's production step — packed two-buffer pod upload,
     percentageOfNodesToScore windows, one i32[B] bind-row result — run
     as a shard_map over the (dp, sp) mesh so the e2e loop (store ->
     watch -> schedule -> CAS bind) drives every chip, not one.
+
+    ``table`` may be either snapshot layout.  A
+    snapshot.packing.PackedNodeTable (the production layout) shards its
+    packed planes — meta word, fused label words, int16/int8 scalars —
+    over ``sp`` exactly like the plain columns, and each shard decodes
+    inside its local chunk slice (engine/cycle._slice_table →
+    unpack_chunk), so the decode shares the single-device code path and
+    HBM holds only the packed layout on every device.
+
+    ``donate=True`` is the production coordinator form: the table's
+    (and constraint state's) buffers are donated to the step, so the
+    per-wave commit updates each shard's HBM in place instead of
+    copy-on-write — the caller MUST reassign from the return (the
+    donated input is dead).  Replay/differential callers keep the
+    non-donating default.
 
     This is the TPU re-expression of the reference's scheduler fan-out:
     "more replicas" (reference pkg/schedulerset/schedulerset.go:161-193,
@@ -245,7 +284,7 @@ def make_sharded_packed_step(
     -> (table, constraints|None, Assignment, rows i32[B]); table and
     constraint node tables sharded, everything else replicated.
     """
-    from k8s1m_tpu.plugins import topology
+    from k8s1m_tpu.engine.cycle import _prologue_stats
     from k8s1m_tpu.snapshot.constraints import slice_constraints
     from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
 
@@ -280,7 +319,10 @@ def make_sharded_packed_step(
         )
 
         stats = (
-            topology.prologue(table, constraints, axis_name="sp")
+            # Shared with the single-device path: a packed table decodes
+            # its DomainView once per wave, then the same cross-shard
+            # prologue reductions run (engine/cycle._prologue_stats).
+            _prologue_stats(table, constraints, axis_name="sp")
             if constraints is not None else None
         )
 
@@ -321,19 +363,19 @@ def make_sharded_packed_step(
         rows_out = jnp.where(asg.bound, asg.node_row, -1).astype(jnp.int32)
         return table, cons, asg, rows_out
 
-    def step(table, ints, bools, key, offset, constraints=None):
+    def _step_cons(table, ints, bools, key, offset, constraints):
         asg_specs = Assignment(P(), P(), P(), P(), P())
-        cons_specs = (
-            constraint_specs(constraints) if constraints is not None else None
+        cons_specs = constraint_specs(constraints)
+        fn = shard_map_compat(
+            _local_step,
+            mesh=mesh,
+            in_specs=(table_specs(table), P(), P(), P(), P(), cons_specs),
+            out_specs=(table_specs(table), cons_specs, asg_specs, P()),
         )
-        if constraints is not None:
-            fn = shard_map_compat(
-                _local_step,
-                mesh=mesh,
-                in_specs=(table_specs(table), P(), P(), P(), P(), cons_specs),
-                out_specs=(table_specs(table), cons_specs, asg_specs, P()),
-            )
-            return fn(table, ints, bools, key, offset, constraints)
+        return fn(table, ints, bools, key, offset, constraints)
+
+    def _step_plain(table, ints, bools, key, offset):
+        asg_specs = Assignment(P(), P(), P(), P(), P())
         fn = shard_map_compat(
             lambda t, i, bl, kk, off: _local_step(t, i, bl, kk, off, None),
             mesh=mesh,
@@ -342,4 +384,23 @@ def make_sharded_packed_step(
         )
         return fn(table, ints, bools, key, offset)
 
-    return jax.jit(step)  # graftlint: disable=undonated-device-update (mesh donation deferred)
+    if donate:
+        # The production coordinator executables: table (and constraint
+        # state) buffers are donated, so per-wave bind commits land in
+        # each shard's HBM in place.  Donation composes with the
+        # shard_map: the inputs arrive sp-sharded, the out_specs keep
+        # the outputs sp-sharded, and XLA aliases shard-by-shard.
+        step_cons = jax.jit(_step_cons, donate_argnums=(0, 5))
+        step_plain = jax.jit(_step_plain, donate_argnums=(0,))
+    else:
+        # Replay/differential variants (mesh gate tests, bench A/B
+        # lanes re-run one table); production passes donate=True.
+        step_cons = jax.jit(_step_cons)  # graftlint: disable=undonated-device-update (non-donating replay variant; production passes donate=True)
+        step_plain = jax.jit(_step_plain)  # graftlint: disable=undonated-device-update (non-donating replay variant; production passes donate=True)
+
+    def step(table, ints, bools, key, offset, constraints=None):
+        if constraints is not None:
+            return step_cons(table, ints, bools, key, offset, constraints)
+        return step_plain(table, ints, bools, key, offset)
+
+    return step

@@ -72,10 +72,12 @@ class PackingOverflow(ValueError):
 
 # Every reason device_packing_fallback_total can carry: the
 # PackingOverflow field names (pack_columns_np's range checks) plus the
-# coordinator's static fallbacks (meta word too narrow, mesh deferred).
+# coordinator's static fallback (meta word too narrow).  The packed
+# layout composes with the mesh path since meshpack, so "mesh" is no
+# longer a fallback reason — the sharded table holds the packed planes.
 FALLBACK_REASONS = (
     "label_key", "label_val", "taint_id", "taint_effect",
-    "zone", "region", "pods_alloc", "taint_slots", "mesh",
+    "zone", "region", "pods_alloc", "taint_slots",
 )
 
 
@@ -305,7 +307,14 @@ def pack_table_host(
     host: NodeTableHost, pspec: PackingSpec, sharding=None
 ) -> PackedNodeTable:
     """Pack the full host mirror into a device-resident PackedNodeTable
-    (the packed-mode counterpart of NodeTableHost.to_device)."""
+    (the packed-mode counterpart of NodeTableHost.to_device).
+
+    ``sharding`` is the sharded entry point (meshpack): pass the
+    coordinator's ``NamedSharding(mesh, P("sp"))`` and every packed
+    plane — meta word, fused label words, the int16/int8 scalars —
+    lands with its row axis sharded over ``sp``, exactly like the plain
+    layout; the sharded cycle decodes inside the shard-local chunk
+    slice (engine/cycle._slice_table)."""
     cols = {
         name: getattr(host, name)
         for name in (
@@ -350,7 +359,12 @@ def pack_row_delta(
     """Packed dirty-row delta for ``scatter_rows``: the packed-layout
     equivalent of ``{c: getattr(host, c)[rows] for c in columns}``.
     ``columns`` is CAP_COLUMNS or ALL_COLUMNS (NodeTable naming); the
-    returned dict uses PackedNodeTable field names."""
+    returned dict uses PackedNodeTable field names.  Layout-agnostic on
+    the device side by construction: the same delta dict feeds the
+    single-device donating scatter and the mesh's sharding-pinned
+    donating scatter (parallel/sharded_cycle.make_sharded_scatter) —
+    the delta rides replicated and the scatter lands it into the
+    sp-sharded packed planes in place."""
     cols = {c: getattr(host, c)[rows] for c in columns}
     return pack_columns_np(cols, pspec)
 
@@ -419,22 +433,36 @@ _HOT_PLANES = (
 )
 
 
+def _plane_ptrs(arr):
+    """Per-shard buffer pointers of one plane.  A table sharded over the
+    mesh's sp axis holds one buffer per (addressable) device, and XLA
+    aliases donated buffers shard-by-shard — so the probe must collect
+    EVERY shard's pointer, not call the single-device accessor (which
+    raises on multi-shard arrays)."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards:
+        return [s.data.unsafe_buffer_pointer() for s in shards]
+    return [arr.unsafe_buffer_pointer()]
+
+
 def donation_probe(table) -> frozenset:
-    """Buffer pointers of the table's donated hot planes, read BEFORE a
-    donating dispatch (evidence probe; reading a pointer syncs on the
-    buffer — keep it out of timed windows)."""
+    """Buffer pointers of the table's donated hot planes — every shard
+    of every plane, so the probe covers single-device AND mesh-sharded
+    tables — read BEFORE a donating dispatch (evidence probe; reading a
+    pointer syncs on the buffer — keep it out of timed windows)."""
     return frozenset(
-        getattr(table, c).unsafe_buffer_pointer() for c in _HOT_PLANES
+        p for c in _HOT_PLANES for p in _plane_ptrs(getattr(table, c))
     )
 
 
 def donation_inplace(table, probe: frozenset) -> bool:
-    """True when the post-step table reuses ANY probed input buffer —
-    the runtime honored the donation in place; False means every plane
-    was copied (e.g. another live reference pinned the inputs)."""
+    """True when the post-step table reuses ANY probed input buffer (on
+    any shard) — the runtime honored the donation in place; False means
+    every plane was copied (e.g. another live reference pinned the
+    inputs)."""
     return any(
-        getattr(table, c).unsafe_buffer_pointer() in probe
-        for c in _HOT_PLANES
+        p in probe
+        for c in _HOT_PLANES for p in _plane_ptrs(getattr(table, c))
     )
 
 

@@ -290,29 +290,68 @@ def _device_state_detail(coord) -> dict:
     }}
 
 
+def _shard_local_table(coord):
+    """Single-device copy of ONE sp shard's slice of the live table
+    (the first row block), keeping the live layout — packed tables stay
+    packed, so the profile includes the production per-chunk decode.
+    profile_stages runs the single-device step; this view makes it time
+    exactly the program each shard executes per stage (same rows/chunk
+    shape as one shard's scan), instead of an unintended
+    resharded/gathered run over the whole sharded table.  Built from
+    each leaf's first ADDRESSABLE shard, not a global np.asarray: on a
+    multi-host mesh the global array spans non-addressable devices (the
+    gather would raise and lose the whole report), and even single-host
+    it would fetch the full table only to keep 1/sp of it."""
+    import jax
+    import numpy as np
+
+    sp = int(coord.mesh.shape["sp"])
+    local_rows = coord.table_spec.max_nodes // sp
+    dev = jax.local_devices()[0]
+
+    def local(a):
+        shards = getattr(a, "addressable_shards", None)
+        if shards:
+            # The shard holding the FIRST row block (deterministic
+            # across dp replicas: all dp copies of block 0 are equal).
+            s = min(
+                shards,
+                key=lambda s: tuple(sl.start or 0 for sl in s.index),
+            )
+            return jax.device_put(np.asarray(s.data), dev)
+        return jax.device_put(np.asarray(a)[:local_rows], dev)
+
+    return jax.tree.map(local, coord.table)
+
+
 def _kernel_profile_detail(args, coord) -> dict:
     """Per-stage device-step decomposition for the report (opt-in:
     --kernel-profile; each plugin-knockout variant is its own compile).
     Runs over the coordinator's LIVE table — layout, request columns and
-    vocab exactly as the measured window left them."""
+    vocab exactly as the measured window left them.  Under --mesh the
+    probe times the SHARD-LOCAL step (one sp shard's row slice, live
+    layout) and records dp/sp + rows_per_shard so the ms/batch numbers
+    read as per-shard stage costs."""
     if not args.kernel_profile or coord.table is None:
-        return {}
-    if coord.mesh is not None:
-        # profile_stages runs the SINGLE-DEVICE step; over a sharded
-        # table it would time an unintended resharded/gathered run (or
-        # error at report-write time, losing the whole run).  Same
-        # deferred-composition stance as packing+mesh.
-        print("# --kernel-profile does not compose with --mesh yet; "
-              "skipping the profile lane", file=sys.stderr)
         return {}
     from k8s1m_tpu.snapshot.packing import bytes_report
     from k8s1m_tpu.tools.kernel_probe import profile_stages
 
+    if coord.mesh is not None:
+        table = _shard_local_table(coord)
+    else:
+        table = coord.table
     prof = profile_stages(
-        coord.table, coord.encoder, chunk=args.chunk, k=coord.k,
+        table, coord.encoder, chunk=args.chunk, k=coord.k,
         steps=3, backend=args.backend,
     )
-    prof["bytes_per_node"] = bytes_report(coord.table, coord.table_spec)
+    if coord.mesh is not None:
+        prof["mesh"] = {
+            "dp": int(coord.mesh.shape["dp"]),
+            "sp": int(coord.mesh.shape["sp"]),
+            "rows_per_shard": int(table.num_rows),
+        }
+    prof["bytes_per_node"] = bytes_report(table, coord.table_spec)
     prof["batch"] = coord.pod_spec.batch
     return {"kernel_profile": prof}
 

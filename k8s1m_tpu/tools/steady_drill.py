@@ -32,7 +32,11 @@ fault retried with zero give-ups, and the host feed actually staging
 **benchtrue part 3** (``--mesh DPxSP``): the same composed shape over
 the dp x sp sharded cycle — the table's rows shard over ``sp`` devices
 and the pod batch over ``dp`` (parallel/sharded_cycle), with the
-per-dp-shard host feed staging behind in-flight sharded waves.  Run on
+per-dp-shard host feed staging behind in-flight sharded waves.  Since
+meshpack the mesh drill defaults to ``--packing packed``, so the gates
+cover the full production composition (packed planes sharded over sp,
+donating sharded step/scatter) and additionally assert
+``device_packing_fallback_total`` stayed zero over the window.  Run on
 CPU with the virtual device mesh::
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -73,7 +77,16 @@ def parse_args(argv=None):
     ap.add_argument("--mesh", default=None,
                     help="run the composed drill over the dp x sp "
                     "sharded cycle (benchtrue part 3), e.g. '2x4' on "
-                    "the 8-device CPU mesh; default: single-device")
+                    "the 8-device CPU mesh; default: single-device.  "
+                    "A mesh drill defaults --packing to 'packed' so the "
+                    "composed packed x sharded x donated production "
+                    "path is what the gates exercise")
+    ap.add_argument("--packing", choices=("off", "packed"), default=None,
+                    help="device-snapshot layout (snapshot/packing.py); "
+                    "default: 'packed' when --mesh is set (the meshpack "
+                    "production path), else 'off'.  A packed drill "
+                    "additionally gates device_packing_fallback_total "
+                    "== 0 over the window")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 shape: tiny cluster, same gates")
     ap.add_argument("--out", default=None)
@@ -88,6 +101,19 @@ def parse_args(argv=None):
             # Mesh divisibility at smoke scale: rows-per-sp-shard must
             # be a chunk multiple (256/4 = 64, chunk 32).
             args.nodes, args.chunk = 256, 32
+    if args.packing is None:
+        # Same resolution chain as every other entry point: an explicit
+        # K8S1M_PACKING keeps the whole evidence pipeline on one layout
+        # (resolve_packing also rejects typo'd values loudly).  Only
+        # when the env var is ALSO unset does the mesh drill default to
+        # the composed production path — packed x sharded x donated
+        # gated together (meshpack).
+        if os.environ.get("K8S1M_PACKING") is not None:
+            from k8s1m_tpu.snapshot.packing import resolve_packing
+
+            args.packing = resolve_packing(None)
+        else:
+            args.packing = "packed" if args.mesh else "off"
     return args
 
 
@@ -146,13 +172,21 @@ def run(args) -> dict:
     ms0 = {c: mesh_scatter.value(cols=c) for c in ("full", "cap")}
     giveups = REGISTRY.get("retry_give_ups_total")
     giveup0 = giveups.value(component="coordinator.bind")
+    from k8s1m_tpu.snapshot.packing import FALLBACK_REASONS
+
+    pack_fb = REGISTRY.get("device_packing_fallback_total")
+    fb0 = {r: pack_fb.value(reason=r) for r in FALLBACK_REASONS}
 
     store = MemStore()
 
     def node_bytes(i: int, gen: int) -> bytes:
+        # pods stays inside the packed int16 plane (snapshot/packing.py)
+        # — the old 1<<20 "never the binding constraint" value would
+        # fail-closed every packed drill to unpacked at bootstrap, which
+        # is exactly the fallback the packed gate asserts never fires.
         return encode_node(NodeInfo(
             name=f"n{i:05d}", cpu_milli=1 << 22 if gen < 0 else
-            (1 << 22) + (gen % 16), mem_kib=1 << 30, pods=1 << 20,
+            (1 << 22) + (gen % 16), mem_kib=1 << 30, pods=(1 << 15) - 1,
         ))
 
     for i in range(args.nodes):
@@ -162,7 +196,7 @@ def run(args) -> dict:
         PodSpec(batch=b), Profile(topology_spread=0, interpod_affinity=0),
         chunk=args.chunk, k=4, with_constraints=False, seed=args.seed,
         score_pct=50, pipeline=True, depth=args.depth, tenancy=tn,
-        mesh=args.mesh or "none",
+        mesh=args.mesh or "none", packing=args.packing,
     )
 
     seq = 0
@@ -261,9 +295,14 @@ def run(args) -> dict:
     mesh_scatters = {
         c: int(mesh_scatter.value(cols=c) - ms0[c]) for c in ms0
     }
+    packing_fallbacks = sum(
+        int(pack_fb.value(reason=r) - fb0[r]) for r in fb0
+    )
     return {
         "weights": weights,
         "mesh": args.mesh,
+        "packing": args.packing,
+        "packing_fallbacks": packing_fallbacks,
         "mesh_sharded_scatters": mesh_scatters,
         "admitted": len(admitted),
         "rejected": rejected,
@@ -292,6 +331,9 @@ def run(args) -> dict:
             # actually have flowed through the sharded mid-flight
             # scatter, not a fallen-back single-device path.
             and (not args.mesh or mesh_scatters["cap"] > 0)
+            # Packed lane (meshpack): the composed window must hold the
+            # packed layout end to end — zero fail-closed rebuilds.
+            and (args.packing != "packed" or packing_fallbacks == 0)
         ),
     }
 
@@ -313,6 +355,7 @@ def main(argv=None) -> dict:
             "tenants": args.tenants, "tenant_skew": args.tenant_skew,
             "factor": args.factor, "churn_per_tick": args.churn_per_tick,
             "conflict_every": args.conflict_every, "mesh": args.mesh,
+            "packing": args.packing,
         },
         "evidence": evidence,
     }

@@ -56,3 +56,15 @@ def test_cpu_lane_mesh_smoke():
     report = _run_bench("--mesh", "2x4")
     assert report["metric"] == "pod_binds_per_sec_1024_nodes_mesh2x4_cpu"
     assert report["value"] > 0
+
+
+def test_cpu_lane_packed_mesh_smoke():
+    """meshpack: packed x sharded x donated through bench — the sharded
+    table holds the packed planes (>=2x cold reduction preserved) and
+    the per-shard donation probe reports in place."""
+    report = _run_bench("--mesh", "2x4", "--packing", "packed")
+    assert report["metric"] == "pod_binds_per_sec_1024_nodes_mesh2x4_cpu"
+    assert report["value"] > 0
+    assert report["layout"] == "packed"
+    assert report["cold_bytes_reduction"] >= 2.0
+    assert report["donation_inplace"] is True

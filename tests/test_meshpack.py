@@ -1,0 +1,303 @@
+"""meshpack: packed x sharded x donated as ONE production path.
+
+The composed differential gates (ISSUE 11): the PR 6 mesh gate and the
+PR 10 packing gate, extended to the composition both PRs deferred.
+
+1. **Engine step**: the donating packed-mesh step (dp x sp shard_map
+   over the sp-sharded packed planes, decoded in the shard-local chunk
+   slice) is byte-identical to the plain single-device step — and
+   actually consumes its donated input buffers, per shard.
+2. **Coordinator at 4096 nodes under churn** (the tier-1 acceptance
+   gate): a packed PIPELINED MESH coordinator run — capacity churn
+   scattering mid-flight through the donating sharded scatter, a
+   structural add landing mid-flight — produces byte-identical stored
+   pod objects, host mirror, and device request totals vs the plain
+   single-device pipeline.
+3. **Cross-shard widening**: a mid-run PackingOverflow on the mesh
+   (vocab drift past the fused-label budget) rebuilds under the split-
+   words layout decided ONCE, host-side — never per-shard — after
+   retiring in-flight waves; the rebuilt sharded table is exact and the
+   binds match the identically-driven single-device run.
+4. **Construction**: packed + mesh no longer falls back (the PR 10
+   deferred-composition seam is gone) and "mesh" is no longer a
+   fallback reason.
+"""
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+
+from k8s1m_tpu.cluster import populate_kwok_nodes, uniform_pods
+from k8s1m_tpu.config import PodSpec, TableSpec
+from k8s1m_tpu.control.coordinator import Coordinator
+from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
+from k8s1m_tpu.engine.cycle import schedule_batch_packed
+from k8s1m_tpu.obs.metrics import REGISTRY
+from k8s1m_tpu.parallel import make_mesh
+from k8s1m_tpu.plugins.registry import Profile
+from k8s1m_tpu.snapshot import NodeTableHost, PodBatchHost
+from k8s1m_tpu.snapshot.node_table import NodeInfo
+from k8s1m_tpu.snapshot.packing import (
+    FALLBACK_REASONS,
+    build_packing_spec,
+    donation_inplace,
+    donation_probe,
+    is_packed,
+    pack_table_host,
+    unpack_chunk,
+)
+from k8s1m_tpu.snapshot.pod_encoding import PodInfo
+from k8s1m_tpu.store.native import MemStore, prefix_end
+
+PROFILE = Profile(node_affinity=0, topology_spread=0, interpod_affinity=0)
+
+
+def mesh_2x4():
+    return make_mesh(dp=2, sp=4)
+
+
+def sp_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P("sp"))
+
+
+# ---- 1. the donating packed-mesh engine step ---------------------------
+
+
+def test_packed_mesh_step_byte_identical_and_donates():
+    spec = TableSpec(max_nodes=512)
+    host = NodeTableHost(spec)
+    populate_kwok_nodes(host, 512)
+    enc = PodBatchHost(PodSpec(batch=64), spec, host.vocab)
+    pb = enc.encode_packed(uniform_pods(64))
+    key = jax.random.key(3)
+
+    t1, _, _a1, r1 = schedule_batch_packed(
+        host.to_device(), pb, key, profile=PROFILE, chunk=128, k=4,
+    )
+    r1, q1 = np.asarray(r1), np.asarray(t1.pods_req)
+    assert (r1 >= 0).any()
+
+    mesh = mesh_2x4()
+    pspec = build_packing_spec(spec, host.vocab)
+    packed = pack_table_host(host, pspec, sp_sharding(mesh))
+    assert len(packed.meta.addressable_shards) >= 4   # genuinely sharded
+    probe = donation_probe(packed)                     # per-shard pointers
+    t2, _, _a2, r2 = schedule_batch_packed(
+        packed, pb, key, profile=PROFILE, chunk=128, k=4,
+        mesh=mesh, donate=True,
+    )
+    np.testing.assert_array_equal(r1, np.asarray(r2))
+    np.testing.assert_array_equal(q1, np.asarray(t2.pods_req))
+    # The donated sharded input is DEAD (its shard buffers were
+    # consumed) and the output reuses probed shard buffers in place.
+    assert packed.cpu_req.is_deleted()
+    assert donation_inplace(t2, probe)
+
+
+def test_packed_mesh_sampled_window_matches_unpacked_mesh():
+    """score_pct windows rotate SHARD-locally on the mesh; packed and
+    unpacked mesh runs of the same window must still be bit-equal."""
+    spec = TableSpec(max_nodes=512)
+    host = NodeTableHost(spec)
+    populate_kwok_nodes(host, 512)
+    enc = PodBatchHost(PodSpec(batch=64), spec, host.vocab)
+    pb = enc.encode_packed(uniform_pods(64))
+    key = jax.random.key(5)
+    mesh = mesh_2x4()
+    sh = sp_sharding(mesh)
+    _t1, _, _a1, r1 = schedule_batch_packed(
+        host.to_device(sh), pb, key, profile=PROFILE, chunk=64, k=4,
+        mesh=mesh, sample_rows=64, sample_offset=64,
+    )
+    pspec = build_packing_spec(spec, host.vocab)
+    _t2, _, _a2, r2 = schedule_batch_packed(
+        pack_table_host(host, pspec, sh), pb, key,
+        profile=PROFILE, chunk=64, k=4,
+        mesh=mesh, sample_rows=64, sample_offset=64,
+    )
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    assert (np.asarray(r1) >= 0).any()
+
+
+# ---- 2. the coordinator gate: 4096 nodes under churn -------------------
+
+SPEC_4K = TableSpec(max_nodes=4096, max_zones=16, max_regions=8)
+PODS_4K = PodSpec(batch=64)
+CHUNK_4K = 512
+
+
+def put_node(store, name, zone="z0", cpu=4000, **kw):
+    labels = {"topology.kubernetes.io/zone": zone, **kw.pop("labels", {})}
+    store.put(node_key(name), encode_node(NodeInfo(
+        name=name, cpu_milli=cpu, mem_kib=1 << 25, pods=110,
+        labels=labels, **kw,
+    )))
+
+
+def put_pod(store, name, **kw):
+    store.put(pod_key("default", name), encode_pod(PodInfo(
+        name=name, namespace="default", cpu_milli=20, mem_kib=200 << 10,
+        **kw,
+    )))
+
+
+def _snapshot(c, store):
+    res = store.range(b"/registry/pods/", prefix_end(b"/registry/pods/"))
+    pods = {bytes(kv.key): bytes(kv.value) for kv in res.kvs}
+    host = {
+        "row_of": dict(c.host._row_of),
+        "valid": c.host.valid.copy(),
+        "cpu_req": c.host.cpu_req.copy(),
+        "mem_req": c.host.mem_req.copy(),
+        "pods_req": c.host.pods_req.copy(),
+    }
+    table_req = np.asarray(c.table.pods_req).copy()
+    return pods, host, table_req
+
+
+def _drive_churned_4k(mesh, packing):
+    """One deterministic pipelined schedule at 4096 nodes: pod waves +
+    capacity churn on held rows + structural fresh-row adds, all
+    applied while waves are in flight; same seed in every mode.
+    (mesh=None, packing="off") IS the plain single-device pipeline."""
+    with MemStore() as store:
+        for i in range(4090):       # headroom for the structural adds
+            put_node(store, f"n{i}", zone=f"z{i % 4}")
+        c = Coordinator(
+            store, SPEC_4K, PODS_4K, PROFILE, chunk=CHUNK_4K, k=4,
+            with_constraints=False, pipeline=True, depth=3, seed=7,
+            max_attempts=8, mesh=mesh, packing=packing,
+        )
+        c.bootstrap()
+        assert is_packed(c.table) == (packing == "packed")
+        for wave in range(5):
+            for i in range(48):
+                put_pod(store, f"w{wave}-{i}")
+            # Capacity-only churn against held rows, landing mid-flight
+            # through the (donating, sharding-pinned) scatter.
+            for j in range(4):
+                put_node(store, f"n{(17 * wave + j) % 4090}",
+                         zone=f"z{(17 * wave + j) % 4}",
+                         cpu=4000 + 100 * wave)
+            if wave == 2:
+                put_node(store, "fresh-a")   # structural mid-flight adds
+                put_node(store, "fresh-b")
+            c.step()
+        c.run_until_idle()
+        snap = _snapshot(c, store)
+        di = c.donation_inplace
+        c.close()
+        return (*snap, di)
+
+
+def test_packed_mesh_coordinator_byte_identical_under_churn_4096():
+    """The tier-1 acceptance gate: packed-mesh == plain-single-device —
+    stored pod bytes (spliced nodeName included), host mirror, device
+    request totals — under capacity churn + mid-flight structural adds,
+    with per-shard donation honored in place."""
+    fb = REGISTRY.get("device_packing_fallback_total")
+    fb_base = {r: fb.value(reason=r) for r in FALLBACK_REASONS}
+    pods_pm, host_pm, treq_pm, di = _drive_churned_4k(mesh_2x4(), "packed")
+    assert di is True                       # per-shard probe saw aliasing
+    assert all(
+        fb.value(reason=r) == fb_base[r] for r in FALLBACK_REASONS
+    )                                       # the packed layout held
+    pods_s, host_s, treq_s, _ = _drive_churned_4k(None, "off")
+    assert pods_pm == pods_s
+    assert host_pm["row_of"] == host_s["row_of"]
+    for col in ("valid", "cpu_req", "mem_req", "pods_req"):
+        np.testing.assert_array_equal(host_pm[col], host_s[col])
+    np.testing.assert_array_equal(treq_pm, treq_s)
+    assert host_pm["pods_req"].sum() == 5 * 48
+
+
+# ---- 3. mid-run overflow: the cross-shard widening protocol ------------
+
+SPEC_SM = TableSpec(max_nodes=128, max_zones=16, max_regions=8)
+
+
+def _drive_drift(mesh):
+    """Bootstrap packed, tighten the live layout's value budget to the
+    already-interned width, intern ONE more value via capacity churn,
+    then schedule: the dirty-row delta overflows, the layout widens to
+    split words (ONE host-side decision), and the bind lands on the
+    rebuilt table.  Same seed both modes."""
+    fb = REGISTRY.get("device_packing_fallback_total")
+    base = fb.value(reason="label_val")
+    with MemStore() as store:
+        for i in range(8):
+            put_node(store, f"n{i}")
+        c = Coordinator(
+            store, SPEC_SM, PodSpec(batch=32), PROFILE, chunk=32, k=4,
+            with_constraints=False, packing="packed", pipeline=True,
+            depth=2, seed=1, mesh=mesh,
+        )
+        c.bootstrap()
+        assert is_packed(c.table) and c.table.spec.fuse_labels
+        tight = dataclasses.replace(
+            build_packing_spec(SPEC_SM, c.host.vocab),
+            val_bits=max(len(c.host.vocab.label_values).bit_length(), 2),
+        )
+        c._packing_spec = tight
+        c.table = pack_table_host(c.host, tight, c._table_sharding)
+        while len(c.host.vocab.label_values) < (1 << tight.val_bits):
+            c.host.vocab.label_values.intern(
+                f"pad-{len(c.host.vocab.label_values)}"
+            )
+        # Keep a wave in flight across the overflow so the rebuild's
+        # retire-then-reupload ordering is actually exercised.
+        put_pod(store, "inflight")
+        c.step()
+        put_node(store, "n0", labels={"drift": "novel-value"})
+        put_pod(store, "p0")
+        c.run_until_idle()
+        assert fb.value(reason="label_val") == base + 1
+        # Widened ONCE, globally: still packed, split words, exact.
+        assert is_packed(c.table) and not c.table.spec.fuse_labels
+        decoded = unpack_chunk(c.table)
+        plain = c.host.to_device()
+        for f in ("valid", "label_key", "label_val", "pods_alloc",
+                  "cpu_req", "pods_req", "zone", "region"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(decoded, f)),
+                np.asarray(getattr(plain, f)), err_msg=f,
+            )
+        kv = store.get(pod_key("default", "p0"))
+        assert json.loads(kv.value)["spec"].get("nodeName")
+        snap = _snapshot(c, store)
+        c.close()
+        return snap
+
+
+def test_mesh_overflow_global_label_split_rebuild_differential():
+    pods_m, host_m, treq_m = _drive_drift(mesh_2x4())
+    pods_s, host_s, treq_s = _drive_drift(None)
+    assert pods_m == pods_s
+    assert host_m["row_of"] == host_s["row_of"]
+    np.testing.assert_array_equal(host_m["pods_req"], host_s["pods_req"])
+    np.testing.assert_array_equal(treq_m, treq_s)
+
+
+# ---- 4. construction: the deferred-composition seam is gone ------------
+
+
+def test_packed_mesh_construction_stays_packed():
+    assert "mesh" not in FALLBACK_REASONS
+    with MemStore() as store:
+        for i in range(8):
+            put_node(store, f"n{i}")
+        c = Coordinator(
+            store, SPEC_SM, PodSpec(batch=32), PROFILE,
+            chunk=32, k=4, with_constraints=False, packing="packed",
+            mesh="2x4",
+        )
+        c.bootstrap()
+        assert is_packed(c.table)
+        assert c._donate                      # the mesh path donates too
+        # The packed planes are genuinely sp-sharded, not replicated.
+        assert not c.table.meta.sharding.is_fully_replicated
+        c.close()

@@ -16,8 +16,9 @@ Layers of evidence:
    totals vs the unpacked run.
 4. **Fail-closed drift**: a vocab outgrowing the fused-label bit budget
    triggers a counted layout rebuild (split words), never a truncated
-   id; the unsupported mesh composition falls back to "off" at
-   construction.
+   id.  (The packed x mesh composition — once a fallback — is the
+   production path since meshpack; its gates live in
+   tests/test_meshpack.py.)
 5. **Donation**: the donating executable returns identical binds and
    consumes its input buffers (the coordinator's in-place commit path).
 """
@@ -464,24 +465,6 @@ def test_double_overflow_retry_falls_back_unpacked():
         assert c._packing_mode == "off"
         assert fb.value(reason="label_val") == base_lv + 1
         assert fb.value(reason="pods_alloc") == base_pa + 1
-        c.close()
-
-
-def test_mesh_composition_falls_back_off():
-    base = REGISTRY.get("device_packing_fallback_total").value(reason="mesh")
-    with MemStore() as store:
-        for i in range(8):
-            put_node(store, f"n{i}")
-        c = Coordinator(
-            store, TableSpec(max_nodes=128), PodSpec(batch=32), PROFILE,
-            chunk=64, k=4, with_constraints=False, packing="packed",
-            mesh="1x2",
-        )
-        c.bootstrap()
-        assert not is_packed(c.table)
-        assert REGISTRY.get("device_packing_fallback_total").value(
-            reason="mesh"
-        ) == base + 1
         c.close()
 
 

@@ -107,10 +107,13 @@ from k8s1m_tpu.engine.cycle import (
     adjust_constraints,
     adjust_constraints_impl,
     commit_fields_np,
+    fill_shape_planes,
     sample_offset_for,
     sample_rows_for,
+    schedule_batch_delta,
     schedule_batch_packed,
 )
+from k8s1m_tpu.engine.deltacache import DeltaPlaneCache, resolve_deltasched
 from k8s1m_tpu.loadshed import CircuitBreaker, HealthController, Signals
 from k8s1m_tpu.loadshed import CLOSED as BREAKER_CLOSED
 from k8s1m_tpu.loadshed.breaker import FALLBACK_BINDS
@@ -121,11 +124,13 @@ from k8s1m_tpu.oracle import oracle_feasible, oracle_score
 from k8s1m_tpu.plugins.registry import Profile, degraded_profile
 from k8s1m_tpu.snapshot.constraints import ConstraintTracker, empty_constraints
 from k8s1m_tpu.snapshot.hotfeed import (
+    PLAIN,
     EncodeCache,
     HostFeed,
     HotPodBatchHost,
     ShardedHostFeed,
     encode_batch,
+    shape_key,
 )
 from k8s1m_tpu.snapshot.node_table import (
     ALL_COLUMNS,
@@ -527,6 +532,16 @@ class Coordinator:
         # planes shard over sp like the plain columns and decode inside
         # the shard-local chunk slice.
         packing: str | None = None,
+        # Incremental scheduling (engine/deltacache.py): cache each pod
+        # shape's feasibility/score plane in HBM and run the full
+        # filter+score kernel only over dirty rows ∪ in-flight bind
+        # rows when every shape in a wave hits — byte-identical binds,
+        # O(batch × dirty) steady-state device work.  None defers to
+        # the K8S1M_DELTASCHED env var ("off" default).  Engages only
+        # for full-scan XLA waves (score_pct 100, no row mask, not
+        # degraded); everything else takes the ordinary full pass.
+        deltacache: str | bool | None = None,
+        delta_slots: int = 64,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -762,6 +777,40 @@ class Coordinator:
                     donate_argnums=(0,),
                     out_shardings=cons_shardings,
                 )
+        # Delta-plane cache (deltasched): built after the mesh/sharding
+        # decisions so the plane buffers land row-sharded over sp like
+        # every other packed plane.  The fill encoder shares the one
+        # template cache — shape representatives were all seen at
+        # intake, so fills re-encode against warm templates.
+        self._delta: DeltaPlaneCache | None = None
+        self._delta_fill_enc: HotPodBatchHost | None = None
+        if resolve_deltasched(deltacache) == "on":
+            if self.backend != "xla":
+                # Same fail-loud rationale as resolve_deltasched: on the
+                # pallas backend every wave would fail the delta
+                # eligibility gate and silently measure full recompute
+                # plus cache overhead under a "deltacache on" label.
+                raise ValueError(
+                    "deltacache requires backend='xla' (the pallas fused "
+                    "kernel has no delta variant); set backend='xla' or "
+                    "deltacache='off'"
+                )
+            plane_sharding = None
+            if mesh is not None:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                plane_sharding = NamedSharding(mesh, P(None, "sp"))
+            self._delta = DeltaPlaneCache(
+                table_spec.max_nodes, slots=delta_slots,
+                sharding=plane_sharding,
+            )
+            self._delta_fill_enc = HotPodBatchHost(
+                dataclasses.replace(
+                    pod_spec, batch=self._delta.fill_batch
+                ),
+                table_spec, self.host.vocab, cache=self.encode_cache,
+            )
         self.key = jax.random.key(seed)
 
         self.queue: collections.deque[PendingPod] = collections.deque()
@@ -1357,6 +1406,14 @@ class Coordinator:
         key = (len(tr._spread), len(tr._affinity), namespace)
         incs = self._empty_incs_cache.get(key)
         if incs is None:
+            if len(self._empty_incs_cache) >= 1024:
+                # Bounded like _gang_oversize: namespaces churn on long
+                # soaks, and the registration counts in the key retire
+                # every older entry each time a constraint registers —
+                # unbounded, the dead generations pile up forever.
+                # Clearing just re-derives a live namespace's matches
+                # once more.
+                self._empty_incs_cache.clear()
             incs = (
                 tuple(tr.spread_matches(namespace, {})),
                 tuple(tr.affinity_matches(namespace, {})),
@@ -1381,6 +1438,10 @@ class Coordinator:
         # and the relist may need rows.
         self.host.release_rows(None)
         self._midflight_rows.clear()
+        if self._delta is not None:
+            # The relist rebuilds the row->node mapping wholesale; no
+            # row set bounds what a cached plane may now mis-describe.
+            self._delta.drop_all("resync")
         with _CYCLE_TIME.time(stage="resync"):
             self._nodes_watch.cancel()
             self._pods_watch.cancel()
@@ -1799,6 +1860,16 @@ class Coordinator:
         if not self._dirty_rows and not self._dirty_caps:
             return
         with self._stage("sync"):
+            if self._delta is not None:
+                # Journal the rows BEFORE the scatters dispatch: a delta
+                # wave enqueued after this point recomputes them from
+                # the post-scatter table (stream order), so version <=
+                # journal stamp <= device truth holds per row.  Both
+                # dirty classes ride one recompute — re-deriving a full
+                # row's plane columns is exact for a capacity-only
+                # change too, just conservative.
+                self._delta.note_rows(self._dirty_rows)
+                self._delta.note_rows(self._dirty_caps)
             if self._dirty_rows:
                 # A row needing the full upload supersedes its
                 # capacity-only entry (the full delta includes CAP cols).
@@ -1887,6 +1958,14 @@ class Coordinator:
         per-wave counter."""
         return self._donation_inplace
 
+    @property
+    def delta_enabled(self) -> bool:
+        """Whether the delta-plane cache (engine/deltacache.py) is
+        wired into this coordinator.  The public read for bench/report
+        surfaces — `deltasched_waves_total{path}` is the per-wave
+        counter."""
+        return self._delta is not None
+
     def _note_table_bytes(self, table) -> None:
         layout = "packed" if is_packed(table) else "unpacked"
         other = "unpacked" if layout == "packed" else "packed"
@@ -1946,6 +2025,12 @@ class Coordinator:
             jax.block_until_ready(jax.tree.leaves(self.table))
         self._dirty_rows.clear()
         self._dirty_caps.clear()
+        if self._delta is not None:
+            # The wholesale re-upload resets the device request columns
+            # to host truth — a state no journaled row set describes
+            # (deltasched invalidation contract: packing rebuilds drop
+            # the cache wholesale).
+            self._delta.drop_all("packing")
         self.table = self._table_to_device()
 
     # ---- the cycle -----------------------------------------------------
@@ -2546,6 +2631,88 @@ class Coordinator:
             return self._profile_degraded, self._sample_rows_degraded
         return self.profile, self._sample_rows
 
+    # ---- deltasched: plane-cached waves (engine/deltacache.py) ---------
+
+    @staticmethod
+    def _delta_key(p: PendingPod):
+        """The pod's plane-cache shape key (snapshot/hotfeed.shape_key),
+        or None for uncacheable shapes.  Native fast-lane pods
+        (pod=None) are canonical label-less plain pods by construction
+        — their key needs no PodInfo materialization at all."""
+        if p.pod is None:
+            return (PLAIN, p.cpu_milli, p.mem_kib)
+        return shape_key(p.pod)
+
+    def _plan_delta(self, batch_pods, batch):
+        """Plan this wave's delta path: shape-key lookups, plane fills
+        for recurring cold shapes (dispatched here, BEFORE the wave, so
+        a filled wave can still go delta), and the journaled dirty
+        slice.  Returns the WavePlan when the wave may run the delta
+        step, None for the ordinary full pass."""
+        cache = self._delta
+        gen = self.host.vocab.generation()
+        cache.check_generation(gen)
+        plan = cache.plan(
+            [self._delta_key(p) for p in batch_pods], batch.batch
+        )
+        if plan.fill_idx:
+            try:
+                reps = [batch_pods[i].ensure_pod() for i in plan.fill_idx]
+                fill_pb = self._delta_fill_enc.encode_packed(reps)
+            except ValueError:
+                # Representative shapes overflowed a fill-batch bound
+                # (e.g. distinct selector keys past PodSpec.query_keys
+                # across shapes): un-allocate and take the full pass —
+                # never guess at a partial fill.
+                cache.abort_fills(plan)
+                return None
+            fs = np.full(cache.fill_batch, cache.slots, np.int32)
+            fs[: len(plan.fill_slots)] = plan.fill_slots
+            try:
+                planes = fill_shape_planes(
+                    self.table, fill_pb, jnp.asarray(fs),
+                    cache.planes(gen),
+                    profile=self.profile, chunk=self.chunk, mesh=self.mesh,
+                )
+            except Exception:
+                # The fill executable donates the plane buffers; after a
+                # failed dispatch they are in an unknown consumed state.
+                # Reset fail-closed and re-raise for the breaker.
+                cache.reset("fill-error")
+                raise
+            cache.commit(*planes)
+            cache.note_fill(plan)
+        return plan if plan.slot_ids is not None else None
+
+    def _launch_delta(self, batch, subkey, plan):
+        """Dispatch the delta-wave executable: full kernel over the
+        dirty slice ∪ in-flight bind rows (each unretired wave's
+        device-resident rows_dev — consumed on-stream, no host sync),
+        scatter-merged into the cached planes, hashed top-k over the
+        merged planes, shared greedy/commit epilogue.  Constraint state
+        is untouched: delta waves carry only constraint-termless pods,
+        whose commit increments are identically zero."""
+        cache = self._delta
+        planes = cache.planes(self.host.vocab.generation())
+        try:
+            table, asg, rows_dev, planes = schedule_batch_delta(
+                self.table, batch, subkey,
+                profile=self.profile,
+                slot_ids=jnp.asarray(plan.slot_ids),
+                planes=planes,
+                dirty=jnp.asarray(plan.dirty),
+                inflight_rows=tuple(w.rows_dev for w in self._inflights),
+                chunk=self.chunk, k=self.k,
+                mesh=self.mesh, donate=self._donate,
+            )
+        except Exception:
+            # Donated buffers are in an unknown state after a failed
+            # dispatch; reset fail-closed and re-raise for the breaker.
+            cache.reset("dispatch-error")
+            raise
+        cache.commit(planes[0], planes[1], plan)
+        return table, asg, rows_dev
+
     def _launch(self, batch_pods, batch):
         """Enqueue the device step for an encoded batch (async — no
         device→host transfer is forced).  Faultline hook
@@ -2565,6 +2732,20 @@ class Coordinator:
                     raise faultline.InjectedFault(d)
         profile, sample_rows = self._active_knobs()
         self.key, subkey = jax.random.split(self.key)
+        delta_plan = None
+        if (
+            self._delta is not None
+            and self.backend == "xla"
+            and sample_rows is None
+            and self._row_mask_dev is None
+            and profile is self.profile
+            and self.table is not None
+        ):
+            # Delta eligibility is wave-local and conservative: only the
+            # full-scan XLA production shape reuses planes (sampled
+            # windows, degraded profiles and masked candidate views all
+            # compute DIFFERENT planes than the cache holds).
+            delta_plan = self._plan_delta(batch_pods, batch)
         probe_ptr = None
         if self._donate and self._donation_inplace is None:
             # One-time donation probe (first wave): did the runtime alias
@@ -2575,18 +2756,23 @@ class Coordinator:
             except Exception:  # graftlint: disable=broad-except (probe is evidence-only; any exotic array type just reports inplace=no)
                 self._donation_inplace = False
         with _CYCLE_TIME.time(stage="device"):
-            self.table, self.constraints, asg, rows_dev = schedule_batch_packed(
-                self.table, batch, subkey,
-                profile=profile, constraints=self.constraints,
-                chunk=self.chunk, k=self.k, backend=self.backend,
-                sample_rows=sample_rows,
-                sample_offset=(
-                    self._next_window(sample_rows) if sample_rows else 0
-                ),
-                row_mask=self._row_mask_dev,
-                mesh=self.mesh,
-                donate=self._donate,
-            )
+            if delta_plan is not None:
+                self.table, asg, rows_dev = self._launch_delta(
+                    batch, subkey, delta_plan
+                )
+            else:
+                self.table, self.constraints, asg, rows_dev = schedule_batch_packed(
+                    self.table, batch, subkey,
+                    profile=profile, constraints=self.constraints,
+                    chunk=self.chunk, k=self.k, backend=self.backend,
+                    sample_rows=sample_rows,
+                    sample_offset=(
+                        self._next_window(sample_rows) if sample_rows else 0
+                    ),
+                    row_mask=self._row_mask_dev,
+                    mesh=self.mesh,
+                    donate=self._donate,
+                )
         if probe_ptr is not None:
             try:
                 self._donation_inplace = donation_inplace(
@@ -2826,6 +3012,16 @@ class Coordinator:
             nb = len(batch_pods)
             rows = node_row[:nb]
             bound_idx = np.nonzero(rows >= 0)[0]
+            if self._delta is not None and bound_idx.size:
+                # This wave's device-side assumes are now host-visible:
+                # journal its bound rows so later delta waves recompute
+                # their plane columns.  While the wave was IN flight the
+                # same rows reached delta waves on-stream via rows_dev
+                # (engine/deltacache.combine_dirty) — this retire stamp
+                # closes the window for waves launched from here on.
+                # CAS conflicts and tombstoned rows additionally ride
+                # the ordinary dirty-row re-upload below.
+                self._delta.note_rows(rows[bound_idx])
             # No-feasible-row pods are settled AFTER the wave's binds
             # land in the host mirror (below): preemption's usage
             # snapshot must include this wave's own placements, or the

@@ -811,3 +811,157 @@ def schedule_batch_packed(
     if constraints is not None:
         args += (constraints,)
     return step(*args)
+
+
+# ---- deltasched: the plane-cached wave (engine/deltacache.py) -------------
+
+
+@functools.lru_cache(maxsize=256)
+def _jitted_schedule_delta(
+    profile: Profile, chunk: int, k: int,
+    pod_spec, table_spec, groups: frozenset, n_inflight: int,
+    donate: bool = False,
+):
+    """The delta-wave executable: merge the dirty slice into the cached
+    planes, hashed top-k over the merged planes, payload gather, shared
+    greedy/commit epilogue.  Byte-identical to _jitted_schedule_packed
+    for the same wave whenever the planes equal a full recompute of the
+    un-dirty rows (the deltacache invalidation contract; gated by
+    tests/test_deltasched.py).  Constraint state is deliberately not
+    threaded: delta waves carry only constraint-termless pods, whose
+    commit increments are identically zero."""
+    from k8s1m_tpu.engine.deltacache import (
+        attach_payload,
+        combine_dirty,
+        merge_dirty_planes,
+        plane_topk,
+    )
+    from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
+
+    def impl(table, ints, bools, key, slot_ids, pmask, pscore, dirty,
+             *inflight):
+        batch = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
+        n = pmask.shape[1]
+        rows = combine_dirty(dirty, inflight, n)
+        pmask, pscore = merge_dirty_planes(
+            table, batch, profile, slot_ids, pmask, pscore, rows
+        )
+        cand = plane_topk(
+            pmask, pscore, slot_ids, seed_of(key), chunk=chunk, k=k
+        )
+        cand = attach_payload(table, cand)
+        table, _cons, asg = finalize_batch(
+            table, None, cand, commit_fields_of(batch)
+        )
+        rows_out = jnp.where(asg.bound, asg.node_row, -1).astype(jnp.int32)
+        return table, asg, rows_out, pmask, pscore
+
+    if donate:
+        # Production form: the table AND both plane buffers donate —
+        # the scatter-merge updates the cached planes in HBM in place,
+        # exactly like the wave's bind commit updates the table.
+        return jax.jit(impl, donate_argnums=(0, 5, 6))
+    return jax.jit(impl)  # graftlint: disable=undonated-device-update (replay/differential variant; production passes donate=True)
+
+
+def schedule_batch_delta(
+    table,
+    packed,
+    key: jax.Array,
+    *,
+    profile: Profile,
+    slot_ids,
+    planes,
+    dirty,
+    inflight_rows=(),
+    chunk: int = 16384,
+    k: int = 4,
+    mesh=None,
+    donate: bool = False,
+):
+    """schedule_batch_packed's delta-wave twin (deltasched): every pod's
+    feasibility/score plane is already cached, so the device step runs
+    the full kernel only over ``dirty`` ∪ the in-flight waves' bind rows
+    and re-derives candidates from the merged planes.
+
+    ``planes`` is the (mask, score) pair from the epoch-checked
+    ``DeltaPlaneCache.planes`` accessor; ``slot_ids`` maps each batch
+    position to its shape's plane slot (sentinel = slot count for
+    padding); ``dirty`` is the sentinel-padded journaled dirty-row
+    vector and ``inflight_rows`` the unretired waves' device-resident
+    ``rows_dev`` arrays — consumed on-stream, never synced to host.
+
+    Returns (new_table, Assignment, rows, new_planes).  Under ``mesh``
+    the planes must be sharded ``P(None, "sp")`` — row-sharded like
+    every packed plane — and the dirty gather stays shard-local.
+    """
+    pmask, pscore = planes
+    if mesh is not None:
+        from k8s1m_tpu.parallel.sharded_cycle import make_sharded_delta_step
+
+        step = make_sharded_delta_step(
+            mesh, profile, chunk=chunk, k=k,
+            pod_spec=packed.spec, table_spec=packed.table_spec,
+            groups=packed.groups, n_inflight=len(inflight_rows),
+            donate=donate,
+        )
+    else:
+        step = _jitted_schedule_delta(
+            profile, chunk, k, packed.spec, packed.table_spec,
+            packed.groups, len(inflight_rows), donate,
+        )
+    table, asg, rows, pmask, pscore = step(
+        table, packed.ints, packed.bools, key, slot_ids, pmask, pscore,
+        dirty, *inflight_rows,
+    )
+    return table, asg, rows, (pmask, pscore)
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_plane_fill(
+    profile: Profile, chunk: int, pod_spec, table_spec, groups: frozenset
+):
+    """Plane-fill executable: one full filter+score pass for a batch of
+    shape representatives, scattered into their plane slots.  The table
+    is read-only here (fills never commit); only the plane buffers
+    donate."""
+    from k8s1m_tpu.engine.deltacache import fill_planes_scan
+    from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
+
+    def impl(table, ints, bools, fill_slots, pmask, pscore):
+        batch = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
+        return fill_planes_scan(
+            table, batch, profile, fill_slots, pmask, pscore, chunk=chunk
+        )
+
+    return jax.jit(impl, donate_argnums=(4, 5))
+
+
+def fill_shape_planes(
+    table,
+    packed,
+    fill_slots,
+    planes,
+    *,
+    profile: Profile,
+    chunk: int = 16384,
+    mesh=None,
+):
+    """Populate the plane slots in ``fill_slots`` from a full pass for
+    the representative pods in ``packed`` (deltasched cold-shape /
+    refresh path).  Returns the new (mask, score) planes; the table is
+    untouched and NOT donated."""
+    pmask, pscore = planes
+    if mesh is not None:
+        from k8s1m_tpu.parallel.sharded_cycle import make_sharded_plane_fill
+
+        fill = make_sharded_plane_fill(
+            mesh, profile, chunk=chunk,
+            pod_spec=packed.spec, table_spec=packed.table_spec,
+            groups=packed.groups,
+        )
+    else:
+        fill = _jitted_plane_fill(
+            profile, chunk, packed.spec, packed.table_spec, packed.groups
+        )
+    return fill(table, packed.ints, packed.bools, fill_slots, pmask, pscore)

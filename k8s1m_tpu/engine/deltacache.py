@@ -1,0 +1,620 @@
+"""deltasched: incremental filter+score via shape-keyed plane reuse.
+
+The steady-state regime is heavy traffic at low churn: millions of
+template-shaped pods per hour against a table whose rows barely move
+(hotfeed's template hit rate is 1.0 at 90%-hot pools).  Yet every wave
+recomputes filter+score over ALL N rows even when the pod's structural
+shape was seen last wave and <0.1% of rows changed.  This module keeps,
+per pod *shape* (snapshot/hotfeed.shape_key: structural fingerprint +
+request scalars), the HBM-resident *plane* that pass produces — the
+feasibility mask ``bool[N]`` and the pre-greedy integer score ``i32[N]``
+— and lets a wave whose every pod hits the cache run the full kernel
+only over the rows that actually moved:
+
+    dirty rows (the coordinator's _dirty_rows/_dirty_caps scatters,
+    retired bind commits, eviction repairs — journaled through
+    snapshot/node_table.RowVersions)
+  ∪ rows touched by in-flight binds (each unretired wave's device-
+    resident ``rows_dev`` array, consumed on-stream — the host never
+    syncs to learn them)
+
+then scatter-merge the recomputed columns into the cached planes and
+proceed straight to the per-pod hashed top-k over the merged plane.
+Per-wave device work drops from O(batch × N × plugin-chain) toward
+O(batch × dirty) plus a cheap O(batch × N) hash/top-k tail.
+
+**The cache is an invisible replay, never a semantic.**  Binds must be
+BYTE-IDENTICAL to full recompute under churn, pipelining, preemption,
+gangs, mesh sharding and donation (tests/test_deltasched.py).  The
+contract that makes that hold:
+
+- a plane is keyed on ``(shape_key, vocab generation)``; pods whose
+  mask/score reads the live constraint count tables (spread/affinity
+  refs or incs) are NOT cacheable — their key is None and the wave
+  takes the full pass (the constraint stage is an exact identity for
+  termless pods, so delta waves may skip it entirely);
+- row-level invalidation is version-journaled (RowVersions): every
+  device-table row mutation is noted when its scatter/commit is
+  *dispatched*, so a delta wave enqueued later recomputes those rows
+  from the post-mutation table — stream order does the rest;
+- capacity-delta rows and structural rows ride the same recompute
+  (recomputing both planes for a dirty row is conservative and exact);
+- vocab generation movement, packing rebuilds, resync and mesh/table
+  rebuilds drop the cache WHOLESALE (``drop_all``) — those events
+  change what encoded ids *mean*, which no row set can bound;
+- HBM is bounded: a fixed slot count with LRU shape eviction
+  (``deltasched_evictions_total``).
+
+Sharding (parallel/sharded_cycle.make_sharded_delta_step): the planes
+shard on ``sp`` along the row axis exactly like every packed table
+plane; the dirty-slice gather and the plane top-k stay shard-local and
+tie-breaks hash over global coordinates, so the mesh delta wave is
+byte-identical to the single-device delta wave — which is byte-identical
+to full recompute.
+
+Host-side reads of the plane buffers outside this module MUST flow
+through the epoch-checked accessor ``DeltaPlaneCache.planes(gen)``
+(enforced statically by the ``deltacache-epoch-keyed`` graftlint pass):
+raw attribute access would let a stale-generation plane reach a wave.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import os
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from k8s1m_tpu.obs.metrics import Counter, Gauge
+from k8s1m_tpu.plugins.registry import Profile, score_and_filter
+from k8s1m_tpu.snapshot.node_table import RowVersions
+from k8s1m_tpu.snapshot.packing import is_packed, unpack_chunk
+
+log = logging.getLogger("k8s1m.deltasched")
+
+_WAVES = Counter(
+    "deltasched_waves_total",
+    "Coordinator waves by execution path (delta = plane-cached step over "
+    "the dirty slice; full = the ordinary full filter+score pass)",
+    ("path",),
+)
+_SHAPE_HITS = Counter(
+    "deltasched_shape_hits_total",
+    "Per-pod shape lookups answered by a live cached plane", (),
+)
+_SHAPE_MISSES = Counter(
+    "deltasched_shape_misses_total",
+    "Per-pod shape lookups that missed (cold shape, evicted, "
+    "generation-dropped, or an uncacheable constraint-coupled shape)",
+    (),
+)
+_EVICTIONS = Counter(
+    "deltasched_evictions_total",
+    "Cached shape planes evicted by the LRU slot bound "
+    "(the HBM-budget pressure signal)", (),
+)
+_FILLS = Counter(
+    "deltasched_fills_total",
+    "Plane fills dispatched (cold recurring shapes populated, or stale "
+    "slots refilled after journal compaction / oversized dirty sets)", (),
+)
+_DIRTY_ROWS = Counter(
+    "deltasched_dirty_rows_total",
+    "Host-journaled dirty rows recomputed across delta waves (mean "
+    "dirty fraction = this / (delta waves x table rows))", (),
+)
+_PLANES_RESIDENT = Gauge(
+    "deltasched_planes_resident",
+    "Shape planes currently resident across live delta caches", (),
+)
+_LIVE_CACHES: weakref.WeakSet = weakref.WeakSet()
+_PLANES_RESIDENT.set_function(
+    lambda: sum(len(c._slot_of) for c in _LIVE_CACHES)
+)
+
+
+def resolve_deltasched(arg: str | bool | None = None) -> str:
+    """Delta-cache mode from an explicit arg or the K8S1M_DELTASCHED env
+    var.  Returns "off" or "on"; unknown values fail loudly (a typo'd
+    env var silently running full recompute would invalidate every
+    steady-state number downstream)."""
+    if isinstance(arg, bool):
+        return "on" if arg else "off"
+    mode = arg if arg is not None else os.environ.get("K8S1M_DELTASCHED", "off")
+    if mode not in ("off", "on"):
+        raise ValueError(
+            f"K8S1M_DELTASCHED/deltacache must be off|on, got {mode!r}"
+        )
+    return mode
+
+
+# ---- device-side plane ops (traced inside the delta/fill executables) ----
+
+
+def combine_dirty(host_dirty, inflight_rows, sentinel: int):
+    """One global dirty-row vector: the host-journaled rows (already
+    sentinel-padded) plus every in-flight wave's bind rows, with their
+    -1 unbound markers remapped to the out-of-bounds sentinel so the
+    scatter-merge drops them."""
+    parts = [host_dirty]
+    for r in inflight_rows:
+        parts.append(jnp.where(r >= 0, r, sentinel).astype(jnp.int32))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def gather_rows(table, idx):
+    """A decoded mini-table of the rows at ``idx`` (clipped; callers
+    drop out-of-range entries at scatter time).  A packed table decodes
+    the gathered rows here — unpack_chunk is row-elementwise, so it
+    applies to an arbitrary gathered row set just like a chunk slice."""
+    n = table.num_rows
+    safe = jnp.clip(idx, 0, n - 1)
+    sub = jax.tree.map(lambda a: a[safe], table)
+    return unpack_chunk(sub) if is_packed(sub) else sub
+
+
+def merge_dirty_planes(
+    table, batch, profile: Profile, slot_ids, pmask, pscore, rows
+):
+    """Recompute filter+score for ``rows`` against the CURRENT table and
+    scatter-merge the columns into the cached planes at each pod's slot.
+
+    ``rows`` are plane-local (shard-local on the mesh) with the
+    out-of-bounds sentinel for padding/unowned entries; ``slot_ids``
+    carry the slot-count sentinel for padded pods.  Duplicate (slot,
+    row) targets always carry identical values — two pods share a slot
+    only when they share the full shape key, and a row listed twice
+    recomputes the same column — so the scatter is deterministic.
+
+    Constraints are deliberately absent: a delta wave only ever carries
+    constraint-termless pods, for which the constraint stage is an
+    exact identity (plugins/topology.filter_and_score masks nothing and
+    scores zero when no term is valid).
+    """
+    mask_d, score_d = score_and_filter(
+        gather_rows(table, rows), batch, profile, None, None
+    )
+    at = (slot_ids[:, None], rows[None, :])
+    pmask = pmask.at[at].set(mask_d, mode="drop")
+    pscore = pscore.at[at].set(score_d, mode="drop")
+    return pmask, pscore
+
+
+def plane_topk(
+    pmask, pscore, slot_ids, seed, *, chunk: int, k: int,
+    row_offset=0, pod_offset=0,
+):
+    """Per-pod hashed top-k over the merged planes — the delta wave's
+    replacement for the full filter+score chunk scan.
+
+    Mirrors engine/cycle.filter_score_topk's scan EXACTLY (same chunk
+    walk, same pack_hashed jitter over global (pod row, node column)
+    coordinates, same merge_topk carry) so the surviving candidates are
+    bit-identical to the full pass over an equal mask/score field —
+    the byte-identity contract's tail half.  Payload columns come back
+    zeroed; ``attach_payload`` gathers them from the live table (the
+    values are gated by feasibility downstream, so end-gather equals
+    the full pass's per-chunk gather byte-for-byte).
+    """
+    from k8s1m_tpu.engine.cycle import (
+        Candidates,
+        chunk_topk,
+        empty_candidates,
+        merge_topk,
+    )
+    from k8s1m_tpu.ops.priority import pack_hashed
+
+    n = pmask.shape[1]
+    if n % chunk:
+        raise ValueError(f"plane rows {n} not divisible by chunk {chunk}")
+    num_chunks = n // chunk
+    b = slot_ids.shape[0]
+    pod_rows = lax.broadcasted_iota(jnp.int32, (b, 1), 0) + pod_offset
+    zeros = jnp.zeros((b, k), jnp.int32)
+
+    def body(carry, _):
+        carry, ci = carry
+        start = ci * chunk
+        m = jnp.take(
+            lax.dynamic_slice_in_dim(pmask, start, chunk, 1), slot_ids, 0
+        )
+        sc = jnp.take(
+            lax.dynamic_slice_in_dim(pscore, start, chunk, 1), slot_ids, 0
+        )
+        node_cols = (
+            lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+            + start + row_offset
+        )
+        prio = pack_hashed(sc, seed, m, pod_rows, node_cols)
+        top_prio, idx = chunk_topk(prio, k)
+        local = Candidates(
+            idx=(idx + start + row_offset).astype(jnp.int32),
+            prio=top_prio,
+            cpu=zeros, mem=zeros, pods=zeros, zone=zeros, region=zeros,
+        )
+        return (merge_topk(carry, local, k), ci + 1), None
+
+    init = (empty_candidates(b, k), jnp.int32(0))
+    if num_chunks == 1:
+        (cand, _), _ = body(init, None)
+    else:
+        (cand, _), _ = lax.scan(body, init, None, length=num_chunks)
+    return cand.replace(idx=jnp.where(cand.prio >= 0, cand.idx, -1))
+
+
+def attach_payload(table, cand, row_offset=0):
+    """Gather the candidate payload (free capacity at batch start,
+    topology domains) from the live table at the surviving top-k rows.
+
+    The full pass gathers these per chunk during the scan; the table
+    does not change within a step, so gathering at the end reads the
+    identical values — and infeasible candidates' payload (clipped
+    garbage) is unread downstream (greedy_assign gates on prio >= 0,
+    the assignment gates on bound)."""
+    local = cand.idx - row_offset
+    sub = gather_rows(table, local.reshape(-1))
+    free_cpu, free_mem, free_pods = sub.free()
+    shape = cand.idx.shape
+    return cand.replace(
+        cpu=free_cpu.reshape(shape),
+        mem=free_mem.reshape(shape),
+        pods=free_pods.reshape(shape),
+        zone=sub.zone.reshape(shape),
+        region=sub.region.reshape(shape),
+    )
+
+
+def fill_planes_scan(
+    table, batch, profile: Profile, fill_slots, pmask, pscore, *, chunk: int
+):
+    """Populate plane rows for a batch of shape representatives: one
+    full chunked filter+score pass over the (shard-local) table, each
+    chunk's columns scattered into the representatives' slots.  The
+    sentinel slot (out of bounds) drops padded representatives."""
+    from k8s1m_tpu.engine.cycle import _slice_table
+
+    n = pmask.shape[1]
+    if n % chunk:
+        raise ValueError(f"plane rows {n} not divisible by chunk {chunk}")
+    num_chunks = n // chunk
+
+    def body(carry, _):
+        pmask, pscore, ci = carry
+        start = ci * chunk
+        tchunk = _slice_table(table, start, chunk)
+        mask, score = score_and_filter(tchunk, batch, profile, None, None)
+        cols = start + lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        at = (fill_slots[:, None], cols)
+        pmask = pmask.at[at].set(mask, mode="drop")
+        pscore = pscore.at[at].set(score, mode="drop")
+        return (pmask, pscore, ci + 1), None
+
+    init = (pmask, pscore, jnp.int32(0))
+    if num_chunks == 1:
+        (pmask, pscore, _), _ = body(init, None)
+    else:
+        (pmask, pscore, _), _ = lax.scan(body, init, None, length=num_chunks)
+    return pmask, pscore
+
+
+# ---- host-side cache controller -------------------------------------------
+
+
+@dataclasses.dataclass
+class WavePlan:
+    """One wave's delta decision (DeltaPlaneCache.plan).
+
+    ``fill_idx``/``fill_slots`` name the batch positions whose shapes
+    must be plane-filled BEFORE the wave dispatches (recurring shapes
+    being promoted, or stale slots being refreshed) — the coordinator
+    encodes those representatives and runs the fill executable whether
+    or not the wave itself goes delta.  ``slot_ids`` is None for a full
+    wave (some shape stayed unresolvable); otherwise the wave runs the
+    delta step with ``dirty`` (sentinel-padded global rows) and the
+    stamps in ``stamp_slots`` applied at commit time."""
+
+    fill_idx: list[int]
+    fill_slots: list[int]
+    slot_ids: np.ndarray | None = None
+    dirty: np.ndarray | None = None
+    stamp_slots: tuple[int, ...] = ()
+    stamp_ver: int = 0
+
+
+class DeltaPlaneCache:
+    """Host controller of the HBM-resident per-shape plane cache.
+
+    Owns the device plane buffers (``bool[S, N]`` mask + ``i32[S, N]``
+    score, sharded over ``sp`` on the row axis under a mesh), the shape
+    key → slot map with LRU eviction, the per-slot freshness stamps,
+    and the row-version journal consumers invalidate through.  All
+    state is cycle-thread-confined, like the dirty-row sets it mirrors.
+    """
+
+    def __init__(
+        self,
+        num_rows: int,
+        *,
+        slots: int = 64,
+        fill_batch: int = 16,
+        journal_cap: int = 1 << 16,
+        seen_cap: int = 1 << 16,
+        dirty_cap: int | None = None,
+        sharding=None,
+    ) -> None:
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.num_rows = num_rows
+        self.slots = slots
+        self.fill_batch = fill_batch
+        # Past this many dirty rows the delta recompute stops being a
+        # bargain; the plan refreshes the used slots wholesale instead
+        # (a fill is one F-pod pass, far cheaper than a B-pod full wave)
+        # and the wave still runs delta over an empty dirty set.
+        self.dirty_cap = (
+            dirty_cap if dirty_cap is not None else max(num_rows // 4, 1)
+        )
+        self.versions = RowVersions(cap=journal_cap)
+        self._sharding = sharding
+        self._mask = None           # bool[S, N] device plane
+        self._score = None          # i32[S, N] device plane
+        self._slot_of: collections.OrderedDict = collections.OrderedDict()
+        self._free: list[int] = list(range(slots - 1, -1, -1))
+        self._fresh: dict[int, int] = {}     # slot -> version stamp
+        self._gen = -1                       # vocab generation of planes
+        # Shapes seen once before (promotion gate: a shape plane-fills
+        # only on its SECOND sighting, so one-shot shapes — the cold/
+        # high-churn lane — never pay a fill).  Bounded like the
+        # coordinator's _gang_oversize set: clearing just re-requires
+        # one extra sighting from a repeat shape.
+        self._seen: set = set()
+        self._seen_cap = seen_cap
+        _LIVE_CACHES.add(self)
+
+    # -- device buffers ---------------------------------------------------
+
+    def ensure_device(self) -> None:
+        if self._mask is not None:
+            return
+        s, n = self.slots, self.num_rows
+        mask = jnp.zeros((s, n), jnp.bool_)
+        score = jnp.zeros((s, n), jnp.int32)
+        if self._sharding is not None:
+            mask = jax.device_put(mask, self._sharding)
+            score = jax.device_put(score, self._sharding)
+        self._mask, self._score = mask, score
+
+    def planes(self, gen: int):
+        """THE epoch-checked plane accessor (deltacache-epoch-keyed
+        lint contract): hands out the device buffers only against the
+        generation they were computed at.  A mismatch is a caller bug —
+        the cache must be generation-checked (check_generation) before
+        any wave planning touches it."""
+        if gen != self._gen:
+            raise RuntimeError(
+                f"delta plane access at generation {gen} but planes are "
+                f"stamped {self._gen}; call check_generation first"
+            )
+        self.ensure_device()
+        return self._mask, self._score
+
+    def commit(self, mask, score, plan: WavePlan | None = None) -> None:
+        """Store the (donated-through) plane buffers back and apply the
+        plan's freshness stamps — called only after the dispatch that
+        consumed the old buffers succeeded."""
+        self._mask, self._score = mask, score
+        if plan is not None:
+            for s in plan.stamp_slots:
+                self._fresh[s] = plan.stamp_ver
+
+    # -- invalidation -----------------------------------------------------
+
+    def note_rows(self, rows) -> None:
+        """Journal one batch of device-table row mutations (called when
+        the mutating scatter/commit is DISPATCHED, so stream order
+        guarantees later delta waves recompute from the new values)."""
+        if self._slot_of or self._seen:
+            self.versions.note(rows)
+
+    def check_generation(self, gen: int) -> None:
+        """Drop everything when the vocab generation moved: cached
+        planes bake interned ids (tolerated taint sets, selector value
+        ids), and a new id can change what an identical shape encodes."""
+        if gen != self._gen:
+            if self._slot_of:
+                self.drop_all("generation")
+            self._gen = gen
+
+    def drop_all(self, reason: str) -> None:
+        """Wholesale invalidation: table rebuilds (packing widening,
+        mesh/device re-upload), resync, vocab generation movement.  The
+        device buffers stay allocated — only the host keying drops, so
+        the next fills simply overwrite."""
+        if self._slot_of:
+            log.info(
+                "deltasched: dropping %d cached shape planes (%s)",
+                len(self._slot_of), reason,
+            )
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._slot_of.clear()
+        self._fresh.clear()
+        self._seen.clear()
+        # Everything before this point is unenumerable by construction.
+        self.versions.release(self.versions.ver + 1)
+
+    def reset(self, reason: str) -> None:
+        """drop_all PLUS discard the device buffers (a failed donating
+        dispatch leaves them in an unknown consumed state); the next
+        ensure_device reallocates zeros."""
+        self.drop_all(reason)
+        self._mask = self._score = None
+
+    # -- wave planning ----------------------------------------------------
+
+    def _note_seen(self, key) -> None:
+        if len(self._seen) >= self._seen_cap:
+            self._seen.clear()
+        self._seen.add(key)
+
+    def _alloc_slot(self, key, busy) -> int | None:
+        """A slot for ``key``: a free one, else LRU-evict — but NEVER a
+        slot in ``busy`` (already assigned to a pod of the CURRENT
+        wave): evicting one would refill it with this key's plane and
+        the earlier pod would silently read the wrong shape's mask/
+        score — a byte-identity break with no error.  Returns None when
+        every resident slot is busy (the wave takes the full pass)."""
+        if self._free:
+            slot = self._free.pop()
+        else:
+            victim = next(
+                (
+                    (k, s) for k, s in self._slot_of.items()  # LRU first
+                    if s not in busy
+                ),
+                None,
+            )
+            if victim is None:
+                return None
+            del self._slot_of[victim[0]]
+            slot = victim[1]
+            self._fresh.pop(slot, None)
+            _EVICTIONS.inc()
+        self._slot_of[key] = slot
+        return slot
+
+    def plan(self, keys, batch_b: int) -> WavePlan:
+        """Decide this wave's path from the pods' shape keys.
+
+        ``keys`` is one entry per real pod (None = uncacheable shape);
+        ``batch_b`` is the encoded batch size (padding gets the slot
+        sentinel).  Returns a WavePlan: fills to dispatch first, and —
+        when every shape resolved to a live slot — the delta step's
+        slot ids, sentinel-padded dirty rows, and commit stamps.
+        """
+        fills_idx: list[int] = []
+        fills_slot: list[int] = []
+        if any(k is None for k in keys):
+            # Constraint-coupled shapes poison the whole wave (their
+            # pods need the real constraint stage); no fills either —
+            # mixed waves are the cold lane, keep it zero-overhead.
+            _SHAPE_MISSES.inc(len(keys))
+            _WAVES.inc(path="full")
+            return WavePlan([], [])
+        slot_ids = np.full(batch_b, self.slots, np.int32)
+        hits = misses = 0
+        missing = False
+        filled_keys: dict = {}
+        busy: set[int] = set()   # slots assigned to THIS wave so far
+        for i, key in enumerate(keys):
+            slot = self._slot_of.get(key)
+            if slot is not None:
+                self._slot_of.move_to_end(key)
+                slot_ids[i] = slot
+                busy.add(slot)
+                hits += 1
+                continue
+            misses += 1
+            prior = filled_keys.get(key)
+            if prior is not None:
+                slot_ids[i] = prior
+                continue
+            if key in self._seen and len(fills_idx) < self.fill_batch:
+                slot = self._alloc_slot(key, busy)
+                if slot is None:
+                    # Every resident slot belongs to a pod of this very
+                    # wave: no evictable victim.  Full pass.
+                    missing = True
+                    continue
+                fills_idx.append(i)
+                fills_slot.append(slot)
+                filled_keys[key] = slot
+                slot_ids[i] = slot
+                busy.add(slot)
+            else:
+                self._note_seen(key)
+                missing = True
+        _SHAPE_HITS.inc(hits)
+        if misses:
+            _SHAPE_MISSES.inc(misses)
+        if missing:
+            _WAVES.inc(path="full")
+            return WavePlan(fills_idx, fills_slot)
+
+        # Dirty slice: rows mutated since the stalest used slot's fill.
+        used = sorted({int(s) for s in slot_ids if s < self.slots})
+        fresh_fills = set(fills_slot)
+        stale = [
+            s for s in used
+            if s not in fresh_fills
+            and self._fresh.get(s, -1) < self.versions.floor
+        ]
+        dirty: set[int] | None = set()
+        live = [s for s in used if s not in fresh_fills and s not in stale]
+        if live:
+            vmin = min(self._fresh[s] for s in live)
+            dirty = self.versions.rows_since(vmin)
+        if dirty is None or len(dirty) > self.dirty_cap or stale:
+            # Unenumerable or oversized delta (journal compaction, a
+            # churn burst): refresh every used slot wholesale — one
+            # F-shape fill pass — and run delta over the in-flight rows
+            # alone.  Slots past the fill budget force the full pass.
+            refresh = [s for s in used if s not in fresh_fills]
+            if len(fills_idx) + len(refresh) > self.fill_batch:
+                _WAVES.inc(path="full")
+                return WavePlan(fills_idx, fills_slot)
+            slot_at = {int(s): i for i, s in enumerate(slot_ids) if s < self.slots}
+            for s in refresh:
+                fills_idx.append(slot_at[s])
+                fills_slot.append(s)
+            dirty = set()
+        _WAVES.inc(path="delta")
+        _DIRTY_ROWS.inc(len(dirty))
+        return WavePlan(
+            fills_idx, fills_slot,
+            slot_ids=slot_ids,
+            dirty=self._pad_dirty(dirty),
+            stamp_slots=tuple(used),
+            stamp_ver=self.versions.ver,
+        )
+
+    def _pad_dirty(self, rows: set) -> np.ndarray:
+        """Sorted, power-of-two-padded dirty rows with the out-of-bounds
+        sentinel (= num_rows) as padding, so the jitted step sees a
+        handful of shapes instead of one trace per dirty count."""
+        arr = np.fromiter(rows, np.int32, len(rows))
+        arr.sort()
+        cap = 1 << max(0, int(max(arr.size, 1) - 1).bit_length())
+        out = np.full(cap, self.num_rows, np.int32)
+        out[: arr.size] = arr
+        return out
+
+    def note_fill(self, plan: WavePlan) -> None:
+        """Stamp freshly-filled slots at the journal version their fill
+        dispatch observed (called right after the fill executable is
+        enqueued)."""
+        _FILLS.inc(len(plan.fill_slots))
+        for s in plan.fill_slots:
+            self._fresh[s] = self.versions.ver
+
+    def abort_fills(self, plan: WavePlan) -> None:
+        """Un-allocate the plan's fill slots (the representative encode
+        failed, e.g. a query-key overflow across fill shapes): the keys
+        drop back to seen-once and the wave takes the full pass."""
+        for s in plan.fill_slots:
+            self._fresh.pop(s, None)
+            self._free.append(s)
+        for key, slot in list(self._slot_of.items()):
+            if slot in set(plan.fill_slots):
+                del self._slot_of[key]
+        plan.fill_idx.clear()
+        plan.fill_slots.clear()
+
+    @property
+    def resident(self) -> int:
+        return len(self._slot_of)

@@ -44,6 +44,7 @@ from k8s1m_tpu.lint.lockgraph import (
     write_artifact,
 )
 from k8s1m_tpu.lint.rules_clock import NoWallClock
+from k8s1m_tpu.lint.rules_deltacache import DeltaCacheEpochKeyed
 from k8s1m_tpu.lint.rules_donate import UndonatedDeviceUpdate
 from k8s1m_tpu.lint.rules_except import BroadExcept
 from k8s1m_tpu.lint.rules_fence import FencedStoreWrite
@@ -67,6 +68,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     MeshPurity,
     FencedStoreWrite,
     UndonatedDeviceUpdate,
+    DeltaCacheEpochKeyed,
 )
 
 # The linted slice of the repo (everything else is docs/artifacts).

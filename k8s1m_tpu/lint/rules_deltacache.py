@@ -1,0 +1,88 @@
+"""deltacache-epoch-keyed: cached plane reads flow through the accessor.
+
+The delta-plane cache (engine/deltacache.py) hands a wave HBM buffers
+that are only meaningful against the vocab generation they were filled
+at — a stale-generation plane silently encodes RETIRED interned ids
+(taint sets, selector values), and a wave that consumes one produces
+plausible-looking, wrong binds with no crash to point at the cause.
+The module therefore exposes exactly one read path,
+``DeltaPlaneCache.planes(gen)``, which raises on a generation mismatch.
+
+This pass pins that contract statically: in device-step code —
+``k8s1m_tpu/engine/`` and ``k8s1m_tpu/parallel/`` — any raw read of the
+cache's plane attributes (``._mask`` / ``._score``, including their
+``__dict__[...]`` / ``getattr`` spellings) is a finding.  Only
+``engine/deltacache.py`` itself, where the buffers live and the
+accessor is defined, may touch them directly.
+
+Escape hatches (base.py): a ``# graftlint: disable=`` pragma carrying
+the reason the raw read is generation-safe, or a baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile
+
+_PLANE_ATTRS = {"_mask", "_score"}
+_SCOPED_DIRS = ("k8s1m_tpu/engine/", "k8s1m_tpu/parallel/")
+_OWNER_PATH = "k8s1m_tpu/engine/deltacache.py"
+
+_MSG = (
+    "raw read of cached plane attribute {attr!r} — delta planes must be "
+    "obtained through the epoch-checked DeltaPlaneCache.planes(gen) "
+    "accessor (engine/deltacache.py), never raw attribute access"
+)
+
+
+def _const_plane_name(node: ast.AST) -> str | None:
+    """The plane-attribute name when ``node`` is a literal naming one."""
+    if isinstance(node, ast.Constant) and node.value in _PLANE_ATTRS:
+        return node.value
+    return None
+
+
+class DeltaCacheEpochKeyed(Rule):
+    id = "deltacache-epoch-keyed"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if f.path == _OWNER_PATH or not f.path.startswith(_SCOPED_DIRS):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(f.tree):
+            # cache._mask / cache._score — reads only: an Attribute in
+            # Store context is the cache module's own state management,
+            # which cannot exist outside deltacache.py anyway, but a
+            # write through a leaked alias is equally a contract break,
+            # so flag every context.
+            if isinstance(node, ast.Attribute) and node.attr in _PLANE_ATTRS:
+                out.append(
+                    self.finding(f, node, _MSG.format(attr=node.attr))
+                )
+            # getattr(cache, "_mask") / cache.__dict__["_score"]: the
+            # dynamic spellings of the same raw read.
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Name)
+                    and fn.id == "getattr"
+                    and len(node.args) >= 2
+                ):
+                    attr = _const_plane_name(node.args[1])
+                    if attr is not None:
+                        out.append(
+                            self.finding(f, node, _MSG.format(attr=attr))
+                        )
+            elif isinstance(node, ast.Subscript):
+                v = node.value
+                if (
+                    isinstance(v, ast.Attribute)
+                    and v.attr == "__dict__"
+                ):
+                    attr = _const_plane_name(node.slice)
+                    if attr is not None:
+                        out.append(
+                            self.finding(f, node, _MSG.format(attr=attr))
+                        )
+        return out

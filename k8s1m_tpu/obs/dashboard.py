@@ -45,6 +45,11 @@ ROWS = [
     # The dp x sp sharded execution path (parallel/): mesh axis sizes,
     # sharded dirty-row scatters by column class, per-dp-shard feed depth.
     ("Mesh (dp x sp sharded cycle)", ("mesh_",)),
+    # Incremental scheduling (engine/deltacache.py): delta vs full wave
+    # split, per-pod shape hit/miss, plane fills and LRU evictions
+    # (HBM-budget pressure), journaled dirty rows (mean dirty fraction),
+    # and planes resident across live caches.
+    ("Incremental scheduling (deltasched)", ("deltasched_",)),
     # Packed device snapshot + buffer donation (snapshot/packing.py,
     # ISSUE 10 devicestate): table HBM bytes by layout, per-wave commit
     # donations split by whether the runtime honored them in place, and

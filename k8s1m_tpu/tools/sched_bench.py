@@ -94,6 +94,40 @@ def parse_args(argv=None):
         "counts (snapshot/hotfeed.py)",
     )
     ap.add_argument(
+        "--deltacache", choices=("off", "on"), default=None,
+        help="incremental scheduling (engine/deltacache.py): cache each "
+        "pod shape's feasibility/score plane in HBM and recompute only "
+        "dirty rows on a shape hit — byte-identical binds, O(batch x "
+        "dirty) steady-state device work.  Unset defers to "
+        "K8S1M_DELTASCHED ('off' default)",
+    )
+    ap.add_argument(
+        "--delta-profile", action="store_true",
+        help="add delta-plane-cache evidence to the report detail: "
+        "delta vs full wave split, shape hit rate, mean dirty "
+        "fraction, planes resident, fills and LRU evictions "
+        "(engine/deltacache.py)",
+    )
+    ap.add_argument(
+        "--shape-pool", type=int, default=0, metavar="N",
+        help="pods draw structural shapes (nodeAffinity required + "
+        "preferred terms, Deployment-template style) from a pool of N "
+        "specs instead of the plain uniform pod — the paper's "
+        "template-shaped firehose.  Enables the node-affinity plugin. "
+        "0 = plain pods (default)",
+    )
+    ap.add_argument(
+        "--shape-share", type=float, default=1.0, metavar="F",
+        help="with --shape-pool: fraction of pods drawing from the hot "
+        "pool; the rest draw from a bounded 4N-spec tail (the 90%%-hot "
+        "regime of artifacts/hostpath_bench.json)",
+    )
+    ap.add_argument(
+        "--shape-cold", action="store_true",
+        help="every pod is its OWN shape (unique request scalars): the "
+        "shape cache can never hit — the deltasched overhead lane",
+    )
+    ap.add_argument(
         "--depth", type=int, default=2,
         help="scheduling pipeline depth (in-flight waves; >2 helps when "
         "the device round trip dominates the wave, e.g. a remote relay)",
@@ -253,6 +287,42 @@ def _encode_profile_detail(enabled: bool) -> dict:
         },
         "staged_depth": int(
             REGISTRY.get("hotfeed_staged_depth").value()
+        ),
+    }}
+
+
+def _delta_profile_detail(args, coord) -> dict:
+    """Delta-plane-cache evidence for the report (ISSUE 12 deltasched;
+    empty unless --delta-profile)."""
+    if not args.delta_profile:
+        return {}
+    from k8s1m_tpu.obs.metrics import REGISTRY
+
+    waves = REGISTRY.get("deltasched_waves_total")
+    delta_waves = waves.value(path="delta")
+    full_waves = waves.value(path="full")
+    hits = REGISTRY.get("deltasched_shape_hits_total").value()
+    misses = REGISTRY.get("deltasched_shape_misses_total").value()
+    dirty = REGISTRY.get("deltasched_dirty_rows_total").value()
+    rows = coord.table_spec.max_nodes
+    return {"delta_profile": {
+        "enabled": coord.delta_enabled,
+        "delta_waves": int(delta_waves),
+        "full_waves": int(full_waves),
+        "shape_hit_rate": (
+            round(hits / (hits + misses), 4) if hits + misses else None
+        ),
+        # Journaled dirty rows actually recomputed, as a fraction of the
+        # full-recompute work the delta waves displaced.
+        "mean_dirty_fraction": (
+            round(dirty / (delta_waves * rows), 6) if delta_waves else None
+        ),
+        "planes_resident": int(
+            REGISTRY.get("deltasched_planes_resident").value()
+        ),
+        "fills": int(REGISTRY.get("deltasched_fills_total").value()),
+        "evictions": int(
+            REGISTRY.get("deltasched_evictions_total").value()
         ),
     }}
 
@@ -683,7 +753,15 @@ def main(argv=None):
     if mesh is not None:
         # The chunked scan runs per shard; clamp to the shard's rows.
         args.chunk = min(args.chunk, cap // mesh.shape["sp"])
-    profile = Profile(node_affinity=0, topology_spread=0, interpod_affinity=0)
+    # Template-shaped pods (--shape-pool) do real per-(pod, node)
+    # selector work, so the affinity plugin is live for them — the
+    # regime the delta-plane cache collapses.  Plain pods keep the
+    # committed-baseline profile (affinity would contribute zeros).
+    profile = (
+        Profile(topology_spread=0, interpod_affinity=0)
+        if args.shape_pool
+        else Profile(node_affinity=0, topology_spread=0, interpod_affinity=0)
+    )
     coord = Coordinator(
         store, TableSpec(max_nodes=cap), PodSpec(batch=args.batch),
         profile, chunk=args.chunk, with_constraints=False,
@@ -693,6 +771,7 @@ def main(argv=None):
         # "none" so the Coordinator does NOT re-read K8S1M_MESH.
         mesh=mesh if mesh is not None else "none",
         packing=args.packing,
+        deltacache=args.deltacache,
     )
     t0 = time.perf_counter()
     coord.bootstrap()
@@ -712,13 +791,52 @@ def main(argv=None):
         namespaces = [f"tenant-{t}" for t in tenant_ids]
     else:
         namespaces = ["default"] * args.pods
-    values = [
-        encode_pod(PodInfo(
+    shape_templates = []
+    if args.shape_pool:
+        # Deployment-template shapes doing real per-(pod, node) selector
+        # work against the KWOK zone/region labels: a required In over
+        # two zones + a region NotIn, plus a preferred zone — the
+        # node_affinity_pods structure (sized to build_node's 8 zones /
+        # 4 regions), made key-distinct beyond the 8 structural combos
+        # by the request scalar the shape key also covers.  Pods draw
+        # from a HOT pool of N specs or, for the (1 - share) slice, a
+        # bounded 4N-spec tail — real pools' tails repeat too
+        # (hotfeed's hit rate is 1.0 at 90%-hot pools,
+        # artifacts/hostpath_bench.json).
+        from k8s1m_tpu.cluster.workload import node_affinity_pods
+
+        pool = node_affinity_pods(5 * args.shape_pool, zones=8, regions=4)
+        for j, t in enumerate(pool):
+            t.cpu_milli = 10 + j
+            shape_templates.append(t)
+
+    def bench_pod(i: int) -> PodInfo:
+        p = PodInfo(
             f"bench-{i}", namespace=namespaces[i],
             cpu_milli=10, mem_kib=1024,
-        ))
-        for i in range(args.pods)
-    ]
+        )
+        if args.shape_cold:
+            # Every pod its own shape (the key includes the request
+            # scalars): identical device work, zero possible cache hits
+            # — isolates the deltasched host overhead.
+            p.cpu_milli = 10 + i
+            return p
+        if shape_templates:
+            import random as _random
+
+            rng = _random.Random((args.seed << 20) | i)
+            hot = rng.random() < args.shape_share
+            j = (
+                rng.randrange(args.shape_pool) if hot
+                else args.shape_pool + rng.randrange(4 * args.shape_pool)
+            )
+            t = shape_templates[j]
+            p.cpu_milli = t.cpu_milli
+            p.required_terms = t.required_terms
+            p.preferred_terms = t.preferred_terms
+        return p
+
+    values = [encode_pod(bench_pod(i)) for i in range(args.pods)]
     keys = [
         pod_key(namespaces[i], f"bench-{i}") for i in range(args.pods)
     ]
@@ -881,6 +999,7 @@ def main(argv=None):
                 **_mesh_detail(coord, feed_depth_samples),
                 **_tenant_detail(args),
                 **_encode_profile_detail(args.encode_profile),
+                **_delta_profile_detail(args, coord),
                 **_device_state_detail(coord),
                 **_kernel_profile_detail(args, coord),
                 **_resilience_detail(),
@@ -978,6 +1097,7 @@ def main(argv=None):
             **_mesh_detail(coord, feed_depth_samples),
             **_tenant_detail(args),
             **_encode_profile_detail(args.encode_profile),
+            **_delta_profile_detail(args, coord),
             **_device_state_detail(coord),
             **_kernel_profile_detail(args, coord),
             **_resilience_detail(),

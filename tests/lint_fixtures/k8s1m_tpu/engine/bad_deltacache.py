@@ -1,0 +1,8 @@
+"""deltacache-epoch-keyed true positive: a device step reading a cached
+plane buffer straight off the cache object — a stale-generation plane
+(retired interned ids) would flow into a wave unchecked."""
+
+
+def delta_wave(cache, step, table, batch, key):
+    pmask = cache._mask
+    return step(table, batch, key, pmask)

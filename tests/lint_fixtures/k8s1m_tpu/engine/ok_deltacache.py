@@ -1,0 +1,7 @@
+"""deltacache-epoch-keyed pragma twin: the same raw plane read,
+suppressed with a stated reason (a teardown path that only drops the
+buffer, never hands it to a wave)."""
+
+
+def drop_planes(cache):
+    cache._mask = None  # graftlint: disable=deltacache-epoch-keyed (teardown: buffer dropped, never consumed)

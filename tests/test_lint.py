@@ -46,6 +46,7 @@ EXPECTED = {
     "mesh-purity": "k8s1m_tpu/parallel/bad_mesh.py",
     "fenced-store-write": "k8s1m_tpu/control/bad_fenced_write.py",
     "undonated-device-update": "k8s1m_tpu/engine/bad_donate.py",
+    "deltacache-epoch-keyed": "k8s1m_tpu/engine/bad_deltacache.py",
 }
 
 

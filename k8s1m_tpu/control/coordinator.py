@@ -101,6 +101,7 @@ from k8s1m_tpu.control.objects import (
     decode_pod_obj,
     node_key,
     pod_key,
+    pod_key_str_of_obj,
 )
 from k8s1m_tpu.engine.cycle import (
     Wave,
@@ -118,6 +119,7 @@ from k8s1m_tpu.loadshed import CircuitBreaker, HealthController, Signals
 from k8s1m_tpu.loadshed import CLOSED as BREAKER_CLOSED
 from k8s1m_tpu.loadshed.breaker import FALLBACK_BINDS
 from k8s1m_tpu.obs.metrics import Counter, Gauge, Histogram, LevelTimer
+from k8s1m_tpu.obs.podtrace import NULL_TRACER
 from k8s1m_tpu.obs.trace import FlightRecorder
 from k8s1m_tpu.ops.priority import pod_priority_of
 from k8s1m_tpu.oracle import oracle_feasible, oracle_score
@@ -129,6 +131,7 @@ from k8s1m_tpu.snapshot.hotfeed import (
     HostFeed,
     HotPodBatchHost,
     ShardedHostFeed,
+    cache_counts,
     encode_batch,
     shape_key,
 )
@@ -152,7 +155,12 @@ from k8s1m_tpu.snapshot.packing import (
 )
 from k8s1m_tpu.snapshot.pod_encoding import PodBatchHost, PodInfo
 from k8s1m_tpu.tenancy.gang import note_gang
-from k8s1m_tpu.tenancy.policy import gang_of_labels, tenant_of_key, tenant_of_pod
+from k8s1m_tpu.tenancy.policy import (
+    gang_of_labels,
+    tenant_of_key,
+    tenant_of_obj,
+    tenant_of_pod,
+)
 from k8s1m_tpu.tenancy.preempt import (
     Victim,
     note_eviction,
@@ -457,6 +465,7 @@ def unsplice_node_name(raw: bytes) -> bytes | None:
     _gang_staging=THREAD_OWNER,
     _gang_parked=THREAD_OWNER,
     _bind_meta=THREAD_OWNER,
+    _trace_gaveup=THREAD_OWNER,
 )
 class Coordinator:
     """Single-process scheduling coordinator over an in-process store."""
@@ -480,6 +489,18 @@ class Coordinator:
         # cycle flight dump — the reference's always-answerable "where
         # did the time go" (parca-agent.tf, scheduler_metrics.go:68-74).
         profiler=None,
+        # Per-pod lifecycle tracing (obs/podtrace.py): a PodTracer
+        # head-samples 1-in-N pods (deterministic by pod-key hash) and
+        # records their whole journey as a contiguous span chain —
+        # admit, gang staging, queue wait, encode (cache attrs),
+        # dispatch wait, device (wave epoch / depth / delta-vs-full),
+        # bind CAS incl. retries, preemption/eviction, failover
+        # requeue.  None (the default) installs the null tracer: every
+        # emit site is behind a single ``enabled`` read, so tracing off
+        # is free (enforced by the trace-lazy-emit lint pass).  A pod
+        # whose schedule-to-bind exceeds the flight recorder's
+        # threshold dumps the ring WITH its span chain attached.
+        tracer=None,
         backend: str = "xla",
         pipeline: bool = False,
         depth: int = 2,
@@ -560,6 +581,14 @@ class Coordinator:
         self.scheduler_name = scheduler_name
         self.flight = flight_recorder
         self.profiler = profiler
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        # Pods that spent their retry budget THIS wave (populated only
+        # while tracing): the wave-retire pass closes their chains
+        # AFTER the device/bind spans land, so an unschedulable pod's
+        # final wave is attributed to device/bind, not lumped into its
+        # terminal requeue span (the give-up sites run mid-bind-loop,
+        # before the retire pass, and cannot stamp those spans).
+        self._trace_gaveup: set[str] = set()
         self._profile_dumps = 0
         self.backend = backend
         self.pipeline = pipeline
@@ -1067,6 +1096,11 @@ class Coordinator:
 
     def _on_pod_delete(self, key: bytes) -> None:
         pod_key_str = key[len(PODS_PREFIX):].decode()
+        tracer = self._tracer
+        if tracer.enabled:
+            # A pod deleted while pending closes its chain here (a
+            # bound pod's trace already closed at bind; this no-ops).
+            tracer.finish(pod_key_str, "requeue", outcome="deleted")
         self._queued_keys.discard(pod_key_str)
         self._orphan_bound.pop(pod_key_str, None)
         self._bind_meta.pop(pod_key_str, None)
@@ -1290,6 +1324,8 @@ class Coordinator:
             (flags & (fast | POD_HAS_NODE)) == fast
         )
         now = time.perf_counter()
+        tracer = self._tracer
+        tr_on = tracer.enabled
         tr = self.tracker
         has_constraints = bool(tr._spread or tr._affinity)
         if fastmask.all() and not has_constraints:
@@ -1315,6 +1351,8 @@ class Coordinator:
                     cpu_milli=cpu_l[i], mem_kib=mem_l[i],
                     key_str=ks, key_bytes=key,
                 ))
+                if tr_on:
+                    tracer.begin(ks, now, source="intake")
             return
         aoff = evb.aoff.tolist()
         ab = evb.aux_blob
@@ -1388,6 +1426,8 @@ class Coordinator:
                 cpu_milli=cpu_l[i], mem_kib=mem_l[i],
                 key_str=ks, key_bytes=key,
             ))
+            if tr_on:
+                tracer.begin(ks, now, source="intake")
 
     def _node_name_bytes(self) -> list:
         """Encoded node names, index-parallel with vocab.node_names
@@ -1811,6 +1851,16 @@ class Coordinator:
                     g = gang_of_labels(pod.labels, pod.namespace)
                     if g is not None:
                         rec.gang_id, rec.gang_size = g
+                    tracer = self._tracer
+                    if tracer.enabled:
+                        # Takeover requeue: the released member's chain
+                        # re-anchors under the new reign before
+                        # _stage_or_queue's generic begin can label it
+                        # as ordinary intake.
+                        tracer.begin(
+                            rec.key_str, rec.enqueued_at,
+                            source="failover",
+                        )
                     self._stage_or_queue(rec, pod)
             note_gang("recovered")
             log.info(
@@ -2104,6 +2154,8 @@ class Coordinator:
         global priority floor is replaced by token buckets, so overload
         degrades the over-share tenant instead of the cluster.
         """
+        tracer = self._tracer
+        t_in = time.perf_counter() if tracer.enabled else 0.0
         if not admitted:
             if self.tenancy is not None:
                 self.tenancy.admission.check_admit_obj(
@@ -2113,6 +2165,23 @@ class Coordinator:
                 self.loadshed.check_admit(
                     pod_priority_of(obj), point="coordinator"
                 )
+        if tracer.enabled:
+            # The admit span anchors the trace at intake entry and
+            # covers the admission decision; the tenant's bucket level
+            # is the "how close to shed" evidence.  begin() no-ops when
+            # the webhook already opened this trace at receipt — the
+            # admit span is emitted EITHER way (it closes against
+            # whichever anchor is live).
+            key = pod_key_str_of_obj(obj)
+            tracer.begin(key, t_in, source="external")
+            attrs = {"point": "webhook" if admitted else "coordinator"}
+            if self.tenancy is not None:
+                tenant = tenant_of_obj(obj)
+                attrs["tenant"] = tenant
+                attrs["bucket"] = self.tenancy.admission.bucket_level(
+                    tenant
+                )
+            tracer.emit(key, "admit", **attrs)
         with self._external_lock:
             self._external.append(obj)
 
@@ -2163,6 +2232,10 @@ class Coordinator:
         contiguously only once ALL are present; until then they hold no
         queue slot and no capacity.  Oversize gangs (bigger than one
         wave) degrade to plain scheduling, counted once per gang."""
+        tracer = self._tracer
+        if tracer.enabled:
+            # No-op for a webhook pod (its trace opened at admission).
+            tracer.begin(rec.key_str, rec.enqueued_at, source="intake")
         tn = self.tenancy
         if tn is not None and tn.policy.gang_enabled and pod is not None:
             g = gang_of_labels(pod.labels, pod.namespace)
@@ -2190,6 +2263,14 @@ class Coordinator:
                     st[1][rec.key_str] = rec
                     if len(st[1]) >= st[0]:
                         del self._gang_staging[gid]
+                        if tracer.enabled:
+                            # Staging wait ends for every member the
+                            # moment the last one completes the gang.
+                            for m in st[1].values():
+                                tracer.emit(
+                                    m.key_str, "gang_stage",
+                                    gang=gid, size=st[0],
+                                )
                         self.queue.extend(st[1].values())
                     return
         self.queue.append(rec)
@@ -2377,6 +2458,7 @@ class Coordinator:
                     for r, vs in victims_by_row.items()
                 },
             })
+        tracer = self._tracer
         for v in choice.victims:
             evicted, rec = self._evict_bound(v.key, path="preempt")
             if not evicted:
@@ -2386,6 +2468,12 @@ class Coordinator:
                 # preemptor retries through the normal path.
                 return False
             if rec is not None:
+                if tracer.enabled:
+                    # The evicted victim re-enters the lifecycle: a
+                    # fresh chain anchored at its requeue time.
+                    tracer.begin(
+                        rec.key_str, rec.enqueued_at, source="evict",
+                    )
                 self.queue.append(rec)
             # Keep the caller's per-wave index current for the next
             # preemptor: this pod is no longer bound.
@@ -2395,6 +2483,13 @@ class Coordinator:
         if not self._bind(p, choice.node):
             return False
         _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
+        if tracer.enabled:
+            # Host-side preemption bind: the chain closes here (the
+            # wave's retire pass will find no live trace and skip it).
+            tracer.finish(
+                p.key_str, "bind", outcome="preempt",
+                victims=len(choice.victims),
+            )
         # The device never committed this bind: same repair contract as
         # the breaker fallback — dirty the row, queue the constraint
         # correction a device commit would have applied.
@@ -2505,6 +2600,8 @@ class Coordinator:
         worst = max(p.attempts for p in alive)
         if worst >= pol.max_attempts:
             for p in alive:
+                if self._tracer.enabled:
+                    self._trace_gaveup.add(p.key_str)
                 _PODS_SCHEDULED.inc(outcome="unschedulable")
                 note_give_up("coordinator.bind")
                 self.unschedulable[p.key_str] = p.ensure_pod()
@@ -2605,15 +2702,36 @@ class Coordinator:
         # graftlint: disable=hotfeed-no-per-pod-python (O(pods) set bookkeeping for popped keys)
         for p in batch_pods:
             self._queued_keys.discard(p.key_str)
+        tracer = self._tracer
+        tr_on = tracer.enabled
+        if tr_on:
+            t_pop = time.perf_counter()
+            hits0, miss0 = cache_counts()
+        claimed = False
         with self._stage("encode"):
             batch = None
             if self._feed is not None:
                 batch = self._feed.claim(
                     batch_pods, self.host.vocab.feed_generation()
                 )
+                claimed = batch is not None
             if batch is None:
                 batch = encode_batch(
                     self._encoder_for(len(batch_pods)), batch_pods
+                )
+        if tr_on:
+            t_enc = time.perf_counter()
+            hits1, miss1 = cache_counts()
+            path = "feed" if claimed else "inline"
+            dh, dm = hits1 - hits0, miss1 - miss0
+            # graftlint: disable=hotfeed-no-per-pod-python (behind the tracer.enabled guard; O(pods) span bookkeeping on sampled runs only)
+            for p in batch_pods:
+                tracer.emit(
+                    p.key_str, "queue_wait", t=t_pop, attempts=p.attempts
+                )
+                tracer.emit(
+                    p.key_str, "encode", t=t_enc, path=path,
+                    cache_hits=dh, cache_misses=dm,
                 )
         return batch_pods, batch
 
@@ -2796,10 +2914,19 @@ class Coordinator:
             pass
         # begin_wave stamps the snapshot epoch AFTER the dispatch above:
         # rows removed from here on quarantine until this wave retires.
-        return Wave(
+        wave = Wave(
             batch_pods, batch, asg, rows_dev, t_start,
             epoch=self.host.begin_wave(),
+            depth=len(self._inflights) + 1,
+            path="delta" if delta_plan is not None else "full",
         )
+        tracer = self._tracer
+        if tracer.enabled:
+            # Encode end -> dispatch: the pipeline-slot wait (in the
+            # pipelined cycle this includes retiring the oldest wave).
+            for p in batch_pods:
+                tracer.emit(p.key_str, "dispatch_wait", t=t_start)
+        return wave
 
     def _loadshed_tick(self) -> None:
         """Feed the health controller one cycle's signals (no-op without
@@ -2961,6 +3088,11 @@ class Coordinator:
                 bound_ok[pi] = True
                 FALLBACK_BINDS.inc()
                 _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
+                tracer = self._tracer
+                if tracer.enabled:
+                    # Breaker-open oracle bind: no wave ever launched,
+                    # so the whole journey settles in one bind span.
+                    tracer.finish(p.key_str, "bind", outcome="fallback")
                 # The device table never committed this bind: dirty the
                 # row so the next sync re-uploads the host truth, and
                 # queue the constraint-count correction a device commit
@@ -2979,6 +3111,17 @@ class Coordinator:
                 np.full(len(take), -1, np.int64),
                 np.zeros(len(take), bool),
             )
+            tracer = self._tracer
+            if tracer.enabled:
+                # No wave-retire pass runs on the breaker path: close
+                # the chains of pods that spent their retry budget here.
+                for p in take:
+                    if p.key_str in self._trace_gaveup:
+                        self._trace_gaveup.discard(p.key_str)
+                        tracer.finish(
+                            p.key_str, "requeue",
+                            outcome="unschedulable", attempts=p.attempts,
+                        )
         return nbound
 
     def _complete(self, inflight: Wave) -> int:
@@ -2993,6 +3136,7 @@ class Coordinator:
             # is a full round trip (~tens of ms), so the bind decision
             # comes back as a single packed i32[B] (-1 = unbound).
             node_row = jax.device_get(rows_dev)
+        t_sync = time.perf_counter()
 
         nbound = 0
         failed = np.zeros(batch.batch, bool)
@@ -3197,6 +3341,8 @@ class Coordinator:
                 self.constraints, commit_fields_np(batch.fields),
                 asg.node_row, asg.zone, asg.region, m, m, sign=-1,
             )
+        if self._tracer.enabled:
+            self._trace_retire(inflight, rows, bound_ok, t_sync)
 
         cycle_s = time.perf_counter() - t_start
         self._last_cycle_s = cycle_s
@@ -3245,6 +3391,64 @@ class Coordinator:
                     )
                 )
         return nbound
+
+    def _trace_retire(self, inflight: Wave, rows, bound_ok, t_sync: float) -> None:
+        """Wave-retire observability pass (runs only while tracing is
+        enabled — tracing off keeps the flight recorder's historical
+        slow-CYCLE behavior exactly): close every sampled pod's span
+        chain — the device span stamped with the wave's epoch, pipeline
+        depth and delta-vs-full pass, the bind span with the settled
+        outcome — and give any TRACED pod whose schedule-to-bind
+        exceeded the flight threshold the reference's per-slow-pod
+        flight dump with its span chain attached (scheduler.go:556-565).
+        Traced pods only, by design: the dump budget (max_dumps) is
+        shared with the slow-cycle dumps, so an untraced backlog —
+        where every pod's queue wait clears the per-op threshold — must
+        not be able to drain it 1-in-1."""
+        tracer = self._tracer
+        if not tracer.enabled:
+            return
+        flight = self.flight
+        now = time.perf_counter()
+        for i, p in enumerate(inflight.batch_pods):
+            ok = bool(bound_ok[i])
+            done = None
+            tracer.emit(
+                p.key_str, "device", t=t_sync,
+                wave_epoch=inflight.epoch, depth=inflight.depth,
+                path=inflight.path,
+            )
+            if ok:
+                done = tracer.finish(
+                    p.key_str, "bind", t=now, outcome="bound"
+                )
+            else:
+                tracer.emit(
+                    p.key_str, "bind", t=now,
+                    outcome="nofit" if rows[i] < 0 else "conflict",
+                    attempts=p.attempts,
+                )
+                if p.key_str in self._trace_gaveup:
+                    # Retry budget spent during this wave's settlement:
+                    # close the chain HERE, after its device/bind spans.
+                    self._trace_gaveup.discard(p.key_str)
+                    tracer.finish(
+                        p.key_str, "requeue",
+                        outcome="unschedulable", attempts=p.attempts,
+                    )
+            if done is not None and flight is not None:
+                lat = now - p.enqueued_at
+                if lat > flight.threshold_s:
+                    flight.dump(
+                        reason=(
+                            f"pod {p.key_str} schedule-to-bind "
+                            f"{lat * 1e3:.1f}ms"
+                        ),
+                        extra={
+                            "pod": p.key_str,
+                            "pod_spans": done.doc()["spans"],
+                        },
+                    )
 
     def step(self) -> int:
         """One scheduling cycle; returns number of pods bound.
@@ -3550,6 +3754,10 @@ class Coordinator:
             # Give-up degrades gracefully: the pod is parked (the
             # reference reports unschedulable the same way), never
             # tight-looped.
+            if self._tracer.enabled:
+                # Chain closes in the wave-retire pass (after the
+                # device/bind spans), not here mid-bind-loop.
+                self._trace_gaveup.add(p.key_str)
             _PODS_SCHEDULED.inc(outcome="unschedulable")
             note_give_up("coordinator.bind")
             self.unschedulable[p.key_str] = p.ensure_pod()

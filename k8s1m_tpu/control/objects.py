@@ -94,6 +94,16 @@ def lease_key(namespace: str, name: str) -> bytes:
     return f"/registry/leases/{namespace}/{name}".encode()
 
 
+def pod_key_str_of_obj(obj: dict) -> str:
+    """``"<ns>/<name>"`` for a pod manifest dict — the ``PodInfo.key``
+    shape (unset namespace = "default", upstream semantics).  The ONE
+    derivation the webhook and ``submit_external`` both use for
+    podtrace keys: the two sites must produce byte-identical keys or a
+    webhook-opened trace never matches the coordinator's chain."""
+    md = obj.get("metadata") or {}
+    return f"{md.get('namespace') or 'default'}/{md.get('name', '')}"
+
+
 # ---- quantities ------------------------------------------------------------
 
 

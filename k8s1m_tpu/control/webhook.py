@@ -43,10 +43,13 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from k8s1m_tpu.config import DEFAULT_SCHEDULER
+from k8s1m_tpu.control.objects import pod_key_str_of_obj
 from k8s1m_tpu.obs.metrics import Counter
+from k8s1m_tpu.obs.podtrace import NULL_TRACER
 from k8s1m_tpu.ops.priority import pod_priority_of
 
 log = logging.getLogger("k8s1m.webhook")
@@ -92,10 +95,16 @@ class WebhookServer:
         # Per-connection socket timeout: a stalled client gets dropped
         # instead of pinning a handler thread indefinitely.
         request_timeout_s: float = 30.0,
+        # Per-pod lifecycle tracing (obs/podtrace.py): a sampled pod's
+        # trace opens HERE, at webhook receipt — the earliest intake
+        # timestamp the system observes — so the admit span covers the
+        # admission decision itself.  None = the null tracer (free).
+        tracer=None,
     ):
         self.sink = sink
         self.scheduler_name = scheduler_name
         self.controller = controller
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -173,6 +182,18 @@ class WebhookServer:
                 self.wfile.write(body)
                 if claimed:
                     _REQUESTS.inc(outcome="enqueued")
+                    tracer = outer.tracer
+                    key = ""
+                    if tracer.enabled:
+                        # Open the trace at webhook receipt — the
+                        # earliest intake timestamp the system sees
+                        # (only for pods we actually claim: a foreign
+                        # scheduler's pod must not hold a live trace
+                        # that can never close).
+                        key = pod_key_str_of_obj(obj)
+                        tracer.begin(
+                            key, time.perf_counter(), source="webhook",
+                        )
                     try:
                         if outer.controller is not None:
                             # This pod already passed admission here —
@@ -185,6 +206,13 @@ class WebhookServer:
                         else:
                             outer.sink(obj)
                     except Exception:
+                        if tracer.enabled:
+                            # The pod never reached the queue: close
+                            # the receipt-anchored chain or it pins a
+                            # live-trace slot forever (max_live leak).
+                            tracer.finish(
+                                key, "requeue", outcome="sink_error",
+                            )
                         log.exception("webhook sink failed")
                 else:
                     _REQUESTS.inc(outcome="ignored")

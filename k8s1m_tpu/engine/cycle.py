@@ -74,6 +74,11 @@ class Wave:
     rows_dev: jax.Array  # i32[B] bound row per pod (-1 = unbound)
     t_start: float
     epoch: int
+    # Podtrace span attributes stamped at launch (obs/podtrace.py):
+    # in-flight depth including this wave, and which kernel pass ran
+    # ("full" vs the deltacache "delta" path).
+    depth: int = 1
+    path: str = "full"
 
 
 @struct.dataclass

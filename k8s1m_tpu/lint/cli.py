@@ -54,6 +54,7 @@ from k8s1m_tpu.lint.rules_jax import HotPathHostSync, TraceTimeBranch
 from k8s1m_tpu.lint.rules_mesh import MeshPurity
 from k8s1m_tpu.lint.rules_metrics import MetricsRegistry
 from k8s1m_tpu.lint.rules_retry import RetryThroughPolicy
+from k8s1m_tpu.lint.rules_trace import TraceLazyEmit
 
 ALL_RULES: tuple[type[Rule], ...] = (
     HotPathHostSync,
@@ -69,6 +70,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     FencedStoreWrite,
     UndonatedDeviceUpdate,
     DeltaCacheEpochKeyed,
+    TraceLazyEmit,
 )
 
 # The linted slice of the repo (everything else is docs/artifacts).

@@ -1,0 +1,180 @@
+"""trace-lazy-emit: tracing off must be free in the hot paths.
+
+The podtrace contract (obs/podtrace.py) is the null-tracer pattern: a
+coordinator holds ``NULL_TRACER`` by default, and every span/attr
+construction in the scheduling hot paths sits behind one cheap
+``tracer.enabled`` read — so a tracing-off run pays an attribute check
+per site, never a span append, a key hash, or an attrs dict.  An
+unguarded ``tracer.emit(...)`` quietly reintroduced into the cycle
+would still be *correct* (the null tracer no-ops), but the argument
+construction and call overhead would land on every pod of every wave —
+exactly the regression the ±5% CPU-lane gate exists to catch, found
+here at lint time instead.
+
+Scope: ``k8s1m_tpu/engine/``, ``k8s1m_tpu/snapshot/`` and
+``k8s1m_tpu/control/`` — the wave hot paths.  Flagged shape: a call
+``<recv>.begin/.emit/.finish(...)`` whose receiver's dotted name
+contains ``trace`` (``tracer``, ``self._tracer``, ``podtrace``) with no
+enclosing guard on the ``enabled`` flag.  Guard forms recognized,
+polarity-aware (a call in the body of ``if not tracer.enabled:`` is
+NOT guarded — it runs exactly when tracing is off):
+
+- ``if tracer.enabled:`` / the hoisted ``tr_on = tracer.enabled`` name
+  (body guarded; ``else`` of a negated test guarded);
+- the short-circuit ``tracer.enabled and tracer.emit(...)`` and the
+  ternary's guarded arm;
+- the early-return dominator: a top-level
+  ``if not tracer.enabled: return`` earlier in the same function body
+  guards everything after it (the whole-method-is-cold form).
+
+Escape hatch: a ``# graftlint: disable=trace-lazy-emit`` pragma
+carrying the reason the site is deliberately unguarded (a cold path
+where emission cost is irrelevant).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile, dotted_name
+
+SCOPE_PREFIXES = (
+    "k8s1m_tpu/engine/",
+    "k8s1m_tpu/snapshot/",
+    "k8s1m_tpu/control/",
+)
+
+# The span-chain mutators of the PodTracer surface.  Reads (spans_of,
+# completed, attribution) are not flagged: they run on cold paths by
+# construction and build nothing per pod.
+_EMITTERS = {"begin", "emit", "finish"}
+
+
+class TraceLazyEmit(Rule):
+    id = "trace-lazy-emit"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if not f.path.startswith(SCOPE_PREFIXES):
+            return []
+        # Names assigned from an ``.enabled`` read (``tr_on =
+        # tracer.enabled``) guard like the attribute itself.
+        enabled_names: set[str] = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Attribute
+            ) and node.value.attr == "enabled":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        enabled_names.add(tgt.id)
+
+        def mentions_enabled(test: ast.AST) -> bool:
+            for n in ast.walk(test):
+                if isinstance(n, ast.Attribute) and n.attr == "enabled":
+                    return True
+                if isinstance(n, ast.Name) and n.id in enabled_names:
+                    return True
+            return False
+
+        def negated(test: ast.AST) -> bool:
+            """STRICTLY `not <enabled>` — the only form whose else arm
+            (or early return) soundly implies tracing is on."""
+            return (
+                isinstance(test, ast.UnaryOp)
+                and isinstance(test.op, ast.Not)
+                and mentions_enabled(test.operand)
+            )
+
+        def has_negated_mention(test: ast.AST) -> bool:
+            """Any `not ...enabled...` ANYWHERE in the test (e.g.
+            `cond and not tracer.enabled`) — such a test can be true
+            with tracing OFF, so it guards nothing."""
+            for n in ast.walk(test):
+                if isinstance(n, ast.UnaryOp) and isinstance(
+                    n.op, ast.Not
+                ) and mentions_enabled(n.operand):
+                    return True
+            return False
+
+        def positive(test: ast.AST) -> bool:
+            return mentions_enabled(test) and not has_negated_mention(test)
+
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(f.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def guarded(node: ast.AST) -> bool:
+            cur = node
+            while cur in parents:
+                parent = parents[cur]
+                if isinstance(parent, ast.If):
+                    # Polarity-aware: a positive test guards its body,
+                    # a negated test guards its else branch.
+                    if cur in parent.body and positive(parent.test):
+                        return True
+                    if cur in parent.orelse and negated(parent.test):
+                        return True
+                elif isinstance(parent, ast.IfExp):
+                    if cur is parent.body and positive(parent.test):
+                        return True
+                    if cur is parent.orelse and negated(parent.test):
+                        return True
+                elif isinstance(parent, ast.BoolOp) and isinstance(
+                    parent.op, ast.And
+                ):
+                    # Short-circuit only guards operands AFTER the
+                    # enabled test: `enabled and emit()` guards,
+                    # `emit() and enabled` does not.
+                    idx = next(
+                        (j for j, v in enumerate(parent.values)
+                         if v is cur),
+                        None,
+                    )
+                    if idx is not None and any(
+                        positive(v) for v in parent.values[:idx]
+                    ):
+                        return True
+                elif isinstance(
+                    parent, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    # Early-return dominator: a top-level
+                    # `if not <enabled>: return` before this call makes
+                    # the rest of the function tracing-on-only.
+                    for st in parent.body:
+                        if st.lineno >= node.lineno:
+                            break
+                        if (
+                            isinstance(st, ast.If)
+                            and negated(st.test)
+                            and not st.orelse
+                            and st.body
+                            and all(
+                                isinstance(b, (ast.Return, ast.Raise))
+                                for b in st.body
+                            )
+                        ):
+                            return True
+                cur = parent
+            return False
+
+        out: list[Finding] = []
+        for node in ast.walk(f.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EMITTERS
+            ):
+                continue
+            recv = dotted_name(node.func.value)
+            if recv is None or "trace" not in recv.lower():
+                continue
+            if guarded(node):
+                continue
+            out.append(self.finding(
+                f, node,
+                f"unguarded tracer.{node.func.attr}() in a hot path; "
+                "wrap the span construction in `if tracer.enabled:` "
+                "(the null-tracer contract — tracing off must be "
+                "free), or pragma with the reason this site is cold",
+            ))
+        return out

@@ -38,6 +38,10 @@ ROWS = [
     # Quiesce-free pipelining evidence: quiesce reasons, in-flight depth,
     # and the host-stage overlap split (pipeline_* in control/coordinator).
     ("Scheduling cycle", ("pipeline_",)),
+    # Per-pod lifecycle tracing (obs/podtrace.py): the schedule-to-bind
+    # latency decomposed by stage, trace-bus accounting, and the
+    # flight recorder's dump-budget outcomes (obs/trace.py).
+    ("Latency attribution", ("pod_stage_", "podtrace_", "flight_")),
     # Cached + overlapped pod encoding (snapshot/hotfeed.py): encode
     # seconds by path, template-cache hit/miss, staged-batch use and the
     # stale-discard reasons.

@@ -328,6 +328,22 @@ class LevelTimer:
         self._since = self._clock()
 
 
+def quantile_report_ms(
+    hist: Histogram,
+    quantiles: tuple[float, ...] = (0.5, 0.95, 0.99),
+    **labels,
+) -> dict:
+    """``{"p50_ms": ..., "p95_ms": ...}`` for one histogram label set —
+    the schedule-to-bind report shape every bench shares (sched_bench's
+    paced and fill reports, shard_bench's status doc).  One helper so
+    the rounding/naming never drifts between the call sites."""
+    out = {}
+    for q in quantiles:
+        pct = f"{q * 100:g}".replace(".", "_")
+        out[f"p{pct}_ms"] = round(hist.quantile(q, **labels) * 1e3, 2)
+    return out
+
+
 class Registry:
     def __init__(self):
         self._metrics: dict[str, Metric] = {}

@@ -101,15 +101,21 @@ class SamplingProfiler:
 
     def _run(self) -> None:
         me = threading.get_ident()
-        skip_names = {
-            t.ident for t in threading.enumerate()
-            if t.name.startswith(_EXCLUDE_THREADS)
-        }
         while not self._stop.wait(self.interval):
+            # Re-resolve the excluded set EVERY tick (names are in
+            # threading.enumerate(), a cheap list walk): a profiler
+            # thread started after this one would otherwise be sampled
+            # as workload — its wait/fold frames accruing a full-count
+            # entry per tick — because a start-time snapshot can never
+            # see it.
+            skip_idents = {
+                t.ident for t in threading.enumerate()
+                if t.name.startswith(_EXCLUDE_THREADS)
+            }
             frames = sys._current_frames()
             self.samples += 1
             for ident, frame in frames.items():
-                if ident == me or ident in skip_names:
+                if ident == me or ident in skip_idents:
                     continue
                 if self.targets is not None and ident not in self.targets:
                     continue

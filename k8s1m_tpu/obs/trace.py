@@ -17,7 +17,17 @@ import os
 import threading
 import time
 
+from k8s1m_tpu.obs.metrics import Counter
+
 log = logging.getLogger("k8s1m.trace")
+
+_DUMPS = Counter(
+    "flight_dumps_total",
+    "Flight-recorder dump attempts by outcome (suppressed = the "
+    "max_dumps budget is spent — later slow ops leave no artifact; "
+    "error = the dump write itself failed)",
+    ("outcome",),
+)
 
 
 class FlightRecorder:
@@ -34,6 +44,7 @@ class FlightRecorder:
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._dumps = 0
+        self._suppression_logged = False
 
     def record(self, name: str, duration_s: float, **fields) -> None:
         # graftlint: disable=no-wall-clock (span wall stamp for cross-process correlation; dur_s is caller-measured monotonic)
@@ -46,21 +57,44 @@ class FlightRecorder:
     def span(self, name: str, **fields):
         return _Span(self, name, fields)
 
-    def dump(self, reason: str = "") -> str | None:
+    def dump(self, reason: str = "", extra: dict | None = None) -> str | None:
+        """Write the ring (+ optional ``extra`` payload — the slow pod's
+        podtrace span chain) to a dump file.  Exhaustion of the
+        ``max_dumps`` budget is not silent: it is counted in
+        ``flight_dumps_total{outcome="suppressed"}`` and logged once."""
+        suppressed = first = False
         with self._lock:
             if self._dumps >= self.max_dumps:
-                return None
-            self._dumps += 1
-            ring = list(self._ring)
+                suppressed = True
+                first = not self._suppression_logged
+                self._suppression_logged = True
+            else:
+                self._dumps += 1
+                ring = list(self._ring)
+                n = self._dumps
+        if suppressed:
+            if first:
+                log.warning(
+                    "flight recorder: max_dumps=%d budget spent; further "
+                    "dumps suppressed (flight_dumps_total{outcome="
+                    '"suppressed"} keeps counting)', self.max_dumps,
+                )
+            _DUMPS.inc(outcome="suppressed")
+            return None
         path = os.path.join(
             # graftlint: disable=no-wall-clock (epoch-ms dump name, correlates across restarts)
-            self.dump_dir, f"flight-{int(time.time() * 1e3)}-{self._dumps}.json"
+            self.dump_dir, f"flight-{int(time.time() * 1e3)}-{n}.json"
         )
+        doc = {"reason": reason, "spans": ring}
+        if extra:
+            doc.update(extra)
         try:
             with open(path, "w") as f:
-                json.dump({"reason": reason, "spans": ring}, f)
+                json.dump(doc, f)
         except OSError:
+            _DUMPS.inc(outcome="error")
             return None
+        _DUMPS.inc(outcome="written")
         log.warning("flight recorder dump: %s (%s)", path, reason)
         return path
 

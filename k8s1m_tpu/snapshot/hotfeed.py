@@ -134,6 +134,15 @@ def _feeds_depth() -> int:
 _STAGED_DEPTH.set_function(_feeds_depth)
 
 
+def cache_counts() -> tuple[int, int]:
+    """(hits, misses) of the shape-template cache so far — podtrace
+    reads a delta around one batch's encode so the encode span carries
+    the cache-hit/template-path evidence as attributes (process-wide
+    counters: with several live coordinators the delta mixes their
+    traffic, which only blurs the attrs, never the span timings)."""
+    return int(_CACHE_HITS.value()), int(_CACHE_MISSES.value())
+
+
 # Shared sentinel for the all-zero structural template: a plain pod
 # (the 1M-KWOK steady state) writes scalars only, no template at all.
 PLAIN = object()

@@ -189,6 +189,14 @@ class FairAdmission:
     def retry_after_s(self) -> float:
         return self.controller.retry_after_s()
 
+    def bucket_level(self, tenant: str) -> float:
+        """Current token balance of ``tenant``'s bucket (the starter
+        cushion for a tenant not yet seen) — a cheap locked read for
+        observability (the podtrace admit-span attribute), never an
+        admission decision."""
+        with self._admit_lock:
+            return round(self._buckets.get(tenant, self._starter), 3)
+
     # ---- the per-cycle refill ------------------------------------------
 
     def tick(self, capacity: int | None = None) -> None:

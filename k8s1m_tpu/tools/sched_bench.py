@@ -176,6 +176,19 @@ def parse_args(argv=None):
         "sharded-scatter counts) lands in the report detail.",
     )
     ap.add_argument(
+        "--trace", type=int, default=0, metavar="N",
+        help="podtrace (obs/podtrace.py): trace 1-in-N pods through "
+        "the whole lifecycle (head-sampled, deterministic by pod-key "
+        "hash); the stage-attribution waterfall lands in the report's "
+        "latency_attribution detail.  0 = off (the null tracer — free)",
+    )
+    ap.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="with --trace: write the Chrome/Perfetto trace-event JSON "
+        "export to PATH (stages as tracks, pods as flow events; load "
+        "in ui.perfetto.dev or chrome://tracing)",
+    )
+    ap.add_argument(
         "--profile", metavar="PATH", default=None,
         help="sample the measured window with obs/profiler.py, write "
         "the collapsed-stack artifact to PATH, and print the self-time "
@@ -236,6 +249,8 @@ def parse_args(argv=None):
     args = ap.parse_args(argv)
     if args.overload_at and not args.rate:
         ap.error("--overload-at requires --rate (the paced producer)")
+    if args.trace_out and not args.trace:
+        ap.error("--trace-out requires --trace (the pod tracer)")
     return args
 
 
@@ -325,6 +340,15 @@ def _delta_profile_detail(args, coord) -> dict:
             REGISTRY.get("deltasched_evictions_total").value()
         ),
     }}
+
+
+def _trace_detail(args, tracer) -> dict:
+    """Stage-attribution waterfall for the report (empty without
+    --trace): per-stage p50/p99 + share of the end-to-end total,
+    coverage, and the optional Perfetto export."""
+    from k8s1m_tpu.obs.podtrace import trace_report_detail
+
+    return trace_report_detail(tracer, args.trace_out)
 
 
 def _tenant_detail(args) -> dict:
@@ -762,6 +786,11 @@ def main(argv=None):
         if args.shape_pool
         else Profile(node_affinity=0, topology_spread=0, interpod_affinity=0)
     )
+    tracer = None
+    if args.trace:
+        from k8s1m_tpu.obs.podtrace import PodTracer
+
+        tracer = PodTracer(sample_n=args.trace)
     coord = Coordinator(
         store, TableSpec(max_nodes=cap), PodSpec(batch=args.batch),
         profile, chunk=args.chunk, with_constraints=False,
@@ -772,6 +801,7 @@ def main(argv=None):
         mesh=mesh if mesh is not None else "none",
         packing=args.packing,
         deltacache=args.deltacache,
+        tracer=tracer,
     )
     t0 = time.perf_counter()
     coord.bootstrap()
@@ -864,7 +894,7 @@ def main(argv=None):
     # burst-arrival reason, README.adoc:684-695).  Interleaved, not
     # threaded: on a single-core host a producer thread only adds GIL
     # contention and queue backlog.
-    from k8s1m_tpu.obs.metrics import REGISTRY
+    from k8s1m_tpu.obs.metrics import REGISTRY, quantile_report_ms
 
     if args.rate:
         # Warm the adaptive buckets the paced run will actually use
@@ -968,9 +998,10 @@ def main(argv=None):
         e2e = bound / sched_s if sched_s else 0.0
         if args.stats:
             _print_stage_stats(sched_s)
+        q = quantile_report_ms(lat)
         return _emit_report({
             "metric": f"e2e_p50_bind_ms_{args.nodes}_nodes_at_{args.rate}",
-            "value": round(lat.quantile(0.5) * 1e3, 2),
+            "value": q["p50_ms"],
             "unit": "ms",
             "vs_baseline": None,
             "detail": {
@@ -989,15 +1020,14 @@ def main(argv=None):
                 "unbound": args.pods - 1 - bound,
                 "deleted": deleted,
                 "stress_watchers": args.stress_watchers,
-                "p50_ms": round(lat.quantile(0.5) * 1e3, 2),
-                "p95_ms": round(lat.quantile(0.95) * 1e3, 2),
-                "p99_ms": round(lat.quantile(0.99) * 1e3, 2),
+                **q,
                 **_pipeline_detail(
                     coord, quiesce_base, overlap_base, depth_samples,
                     node_churn,
                 ),
                 **_mesh_detail(coord, feed_depth_samples),
                 **_tenant_detail(args),
+                **_trace_detail(args, tracer),
                 **_encode_profile_detail(args.encode_profile),
                 **_delta_profile_detail(args, coord),
                 **_device_state_detail(coord),
@@ -1067,7 +1097,7 @@ def main(argv=None):
     e2e = bound / sched_s if sched_s else 0.0
 
     lat = REGISTRY.get("coordinator_schedule_to_bind_seconds")
-    p50_ms = round(lat.quantile(0.5) * 1e3, 2) if lat else None
+    p50_ms = quantile_report_ms(lat, (0.5,))["p50_ms"] if lat else None
 
     if args.stats:
         _print_stage_stats(sched_s)
@@ -1096,6 +1126,7 @@ def main(argv=None):
             ),
             **_mesh_detail(coord, feed_depth_samples),
             **_tenant_detail(args),
+            **_trace_detail(args, tracer),
             **_encode_profile_detail(args.encode_profile),
             **_delta_profile_detail(args, coord),
             **_device_state_detail(coord),

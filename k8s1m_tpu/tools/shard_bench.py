@@ -75,7 +75,7 @@ def run_worker(args) -> None:
     from k8s1m_tpu.control.coordinator import Coordinator
     from k8s1m_tpu.control.shardset import ShardMember, pod_shard
     from k8s1m_tpu.envboot import tune_gc
-    from k8s1m_tpu.obs.metrics import REGISTRY
+    from k8s1m_tpu.obs.metrics import REGISTRY, quantile_report_ms
     from k8s1m_tpu.plugins.registry import Profile
     from k8s1m_tpu.store.remote import RemoteStore
 
@@ -121,8 +121,7 @@ def run_worker(args) -> None:
             "worker": args.worker,
             "bound": int(sched.value(outcome="bound")) - warm_bound,
             "conflicts": int(sched.value(outcome="conflict")),
-            "p50_ms": round((hist.quantile(0.5) or 0) * 1e3, 2),
-            "p99_ms": round((hist.quantile(0.99) or 0) * 1e3, 2),
+            **quantile_report_ms(hist, (0.5, 0.99)),
             "done": done,
         }
         store.put(STATUS_PREFIX + str(args.worker).encode(),

@@ -87,6 +87,14 @@ def parse_args(argv=None):
                     "production path), else 'off'.  A packed drill "
                     "additionally gates device_packing_fallback_total "
                     "== 0 over the window")
+    ap.add_argument("--trace", type=int, default=0, metavar="N",
+                    help="podtrace (obs/podtrace.py): trace 1-in-N "
+                    "pods through the composed drill; the stage-"
+                    "attribution waterfall lands in the evidence as "
+                    "latency_attribution.  0 = off (the null tracer)")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="with --trace: write the Chrome/Perfetto "
+                    "trace-event export of the drill to PATH")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 shape: tiny cluster, same gates")
     ap.add_argument("--out", default=None)
@@ -101,6 +109,8 @@ def parse_args(argv=None):
             # Mesh divisibility at smoke scale: rows-per-sp-shard must
             # be a chunk multiple (256/4 = 64, chunk 32).
             args.nodes, args.chunk = 256, 32
+    if args.trace_out and not args.trace:
+        ap.error("--trace-out requires --trace (the pod tracer)")
     if args.packing is None:
         # Same resolution chain as every other entry point: an explicit
         # K8S1M_PACKING keeps the whole evidence pipeline on one layout
@@ -191,12 +201,17 @@ def run(args) -> dict:
 
     for i in range(args.nodes):
         store.put(node_key(f"n{i:05d}"), node_bytes(i, -1))
+    tracer = None
+    if args.trace:
+        from k8s1m_tpu.obs.podtrace import PodTracer
+
+        tracer = PodTracer(sample_n=args.trace)
     coord = Coordinator(
         store, TableSpec(max_nodes=args.nodes, max_zones=16, max_regions=8),
         PodSpec(batch=b), Profile(topology_spread=0, interpod_affinity=0),
         chunk=args.chunk, k=4, with_constraints=False, seed=args.seed,
         score_pct=50, pipeline=True, depth=args.depth, tenancy=tn,
-        mesh=args.mesh or "none", packing=args.packing,
+        mesh=args.mesh or "none", packing=args.packing, tracer=tracer,
     )
 
     seq = 0
@@ -298,10 +313,14 @@ def run(args) -> dict:
     packing_fallbacks = sum(
         int(pack_fb.value(reason=r) - fb0[r]) for r in fb0
     )
+    from k8s1m_tpu.obs.podtrace import trace_report_detail
+
+    trace_detail = trace_report_detail(tracer, args.trace_out)
     return {
         "weights": weights,
         "mesh": args.mesh,
         "packing": args.packing,
+        **trace_detail,
         "packing_fallbacks": packing_fallbacks,
         "mesh_sharded_scatters": mesh_scatters,
         "admitted": len(admitted),

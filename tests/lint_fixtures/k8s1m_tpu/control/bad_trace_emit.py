@@ -1,0 +1,6 @@
+"""Fixture: span emission outside the enabled guard in a control hot
+path (violates trace-lazy-emit and nothing else)."""
+
+
+def retire(tracer, pod):
+    tracer.emit(pod.key, "bind", outcome="bound")

@@ -1,0 +1,7 @@
+"""Pragma twin of bad_trace_emit.py: the same unguarded emission,
+carrying the reason it is acceptable."""
+
+
+def retire(tracer, pod):
+    # graftlint: disable=trace-lazy-emit (fixture: cold settlement path, emission cost irrelevant)
+    tracer.emit(pod.key, "bind", outcome="bound")

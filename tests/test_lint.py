@@ -47,6 +47,7 @@ EXPECTED = {
     "fenced-store-write": "k8s1m_tpu/control/bad_fenced_write.py",
     "undonated-device-update": "k8s1m_tpu/engine/bad_donate.py",
     "deltacache-epoch-keyed": "k8s1m_tpu/engine/bad_deltacache.py",
+    "trace-lazy-emit": "k8s1m_tpu/control/bad_trace_emit.py",
 }
 
 
@@ -100,6 +101,60 @@ def test_donate_rule_covers_decorator_spellings():
     lines = {x.line for x in UndonatedDeviceUpdate().check_file(f)}
     # bare + parted flagged (on their decorator lines); donated clean.
     assert len(lines) == 2
+
+
+def test_trace_rule_polarity_and_early_return_dominator():
+    """trace-lazy-emit must accept the early-return dominator form
+    (`if not tracer.enabled: return` heading a function) and the
+    hoisted-name guard, and must NOT accept a wrong-polarity guard
+    (`if not tracer.enabled:` body runs exactly when tracing is off)."""
+    import ast
+    import textwrap
+
+    from k8s1m_tpu.lint.base import SourceFile
+    from k8s1m_tpu.lint.rules_trace import TraceLazyEmit
+
+    src = textwrap.dedent('''
+        def dominated(tracer, pod):
+            if not tracer.enabled:
+                return
+            tracer.emit(pod.key, "bind")          # guarded (dominator)
+
+        def wrong_polarity(tracer, pod):
+            if not tracer.enabled:
+                tracer.emit(pod.key, "bind")      # NOT guarded
+            else:
+                tracer.finish(pod.key, "bind")    # guarded (else arm)
+
+        def hoisted(tracer, pod):
+            tr_on = tracer.enabled
+            if tr_on:
+                tracer.emit(pod.key, "bind")      # guarded (hoisted name)
+
+        def short_circuit(tracer, pod):
+            tracer.enabled and tracer.emit(pod.key, "bind")  # guarded
+
+        def compound_negation(tracer, pod, pods):
+            if pods and not tracer.enabled:
+                tracer.emit(pod.key, "bind")      # NOT guarded (off-branch)
+
+        def wrong_order(tracer, pod):
+            tracer.emit(pod.key, "bind") and tracer.enabled  # NOT guarded
+    ''')
+    f = SourceFile(
+        path="k8s1m_tpu/control/synthetic.py", abspath="synthetic.py",
+        tree=ast.parse(src), lines=src.splitlines(), pragmas={},
+    )
+    findings = TraceLazyEmit().check_file(f)
+    assert len(findings) == 3, [x.render() for x in findings]
+    flagged = {x.source for x in findings}
+    assert 'tracer.emit(pod.key, "bind")      # NOT guarded' in flagged
+    assert 'tracer.emit(pod.key, "bind")      # NOT guarded (off-branch)' in (
+        flagged
+    )
+    assert 'tracer.emit(pod.key, "bind") and tracer.enabled  # NOT guarded' in (
+        flagged
+    )
 
 
 def test_pragma_twins_pass(fixture_result):
@@ -226,7 +281,7 @@ def test_cli_entry_point_agrees():
 
 def test_cli_json_output_and_bounded_time():
     """``--json`` is the machine-readable CI shape (rule -> count ->
-    files), and the FULL run (all 12 passes, interprocedural lockgraph
+    files), and the FULL run (all 14 passes, interprocedural lockgraph
     included) stays under the 60s budget on this env — the bound that
     keeps the gate usable as a pre-commit check while the rule count
     grows."""

@@ -92,6 +92,39 @@ def test_profiler_samples_other_threads(tmp_path):
     assert seen_in_collapsed(prof.report())
 
 
+def test_profiler_excludes_late_started_profiler_thread():
+    """Exclusions re-resolve per sample tick: a profiler(-named) thread
+    started AFTER this one must not be sampled as workload (the
+    start-time snapshot could never see it — its wait/fold frames then
+    accrued a full-count entry per tick)."""
+    stop = threading.Event()
+
+    def _late_decoy_spin():
+        while not stop.is_set():
+            time.sleep(0.002)
+
+    prof = SamplingProfiler(hz=250).start()
+    late = threading.Thread(
+        # Matches the _EXCLUDE_THREADS prefix, like a second profiler.
+        target=_late_decoy_spin, name="sampling-profiler-late", daemon=True,
+    )
+    try:
+        # Thread.start() returns only after the thread registered in
+        # threading.enumerate(), so every later tick can resolve it.
+        late.start()
+        deadline = time.monotonic() + 10.0
+        while prof.samples < 10 and time.monotonic() < deadline:
+            _spin(time.perf_counter() + 0.05)
+    finally:
+        stop.set()
+        prof.stop()
+        late.join()
+    assert prof.samples > 0
+    assert not any("_late_decoy_spin" in s for s in prof.stacks), (
+        [s for s in prof.stacks if "_late_decoy_spin" in s][:3]
+    )
+
+
 def test_slow_cycle_dumps_profile_artifact(tmp_path):
     """Coordinator wiring: a cycle over the flight threshold writes a
     profile-slowcycle-*.json next to the flight dump."""

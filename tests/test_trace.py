@@ -1,0 +1,99 @@
+"""FlightRecorder edges (obs/trace.py, ISSUE 13 satellites): the
+threshold-triggered dump, the ring capacity bound, max_dumps
+exhaustion (counted + logged once, never silent), and the OSError
+dump path."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+from k8s1m_tpu.obs.metrics import REGISTRY
+from k8s1m_tpu.obs.trace import FlightRecorder
+
+_DUMPS = REGISTRY.get("flight_dumps_total")
+
+
+def _outcomes() -> dict:
+    return {
+        o: _DUMPS.value(outcome=o)
+        for o in ("written", "suppressed", "error")
+    }
+
+
+def test_threshold_triggered_dump(tmp_path):
+    rec = FlightRecorder(threshold_s=0.010, dump_dir=str(tmp_path))
+    base = _outcomes()
+    rec.record("fast", 0.001, queue=3)
+    assert os.listdir(tmp_path) == []      # under threshold: ring only
+    rec.record("slow", 0.050, queue=9)
+    files = os.listdir(tmp_path)
+    assert len(files) == 1
+    with open(tmp_path / files[0]) as f:
+        doc = json.load(f)
+    assert "slow took" in doc["reason"]
+    # The ring preserved the events LEADING UP TO the slow op.
+    names = [s["name"] for s in doc["spans"]]
+    assert names == ["fast", "slow"]
+    assert _outcomes()["written"] == base["written"] + 1
+
+
+def test_ring_capacity_bound(tmp_path):
+    rec = FlightRecorder(
+        threshold_s=1.0, capacity=4, dump_dir=str(tmp_path)
+    )
+    for i in range(10):
+        rec.record(f"ev-{i}", 0.0)
+    path = rec.dump(reason="manual")
+    with open(path) as f:
+        doc = json.load(f)
+    # Bounded ring: only the newest `capacity` spans survive.
+    assert [s["name"] for s in doc["spans"]] == [
+        "ev-6", "ev-7", "ev-8", "ev-9",
+    ]
+
+
+def test_max_dumps_suppression_counted_and_logged_once(tmp_path, caplog):
+    rec = FlightRecorder(
+        threshold_s=0.010, dump_dir=str(tmp_path), max_dumps=2
+    )
+    base = _outcomes()
+    with caplog.at_level(logging.WARNING, logger="k8s1m.trace"):
+        for _ in range(5):
+            rec.record("slow", 0.050)
+    assert len(os.listdir(tmp_path)) == 2
+    out = _outcomes()
+    assert out["written"] == base["written"] + 2
+    # Exhaustion is not silent: every suppressed dump is counted...
+    assert out["suppressed"] == base["suppressed"] + 3
+    # ...and the budget exhaustion is logged exactly ONCE (a sustained
+    # slow window must not turn the log into the new flood).
+    suppression_logs = [
+        r for r in caplog.records if "further dumps suppressed" in r.message
+    ]
+    assert len(suppression_logs) == 1
+
+
+def test_oserror_dump_path_counted(tmp_path):
+    rec = FlightRecorder(
+        threshold_s=1.0, dump_dir=str(tmp_path / "does" / "not" / "exist")
+    )
+    rec.record("ev", 0.0)
+    base = _outcomes()
+    assert rec.dump(reason="manual") is None
+    assert _outcomes()["error"] == base["error"] + 1
+
+
+def test_dump_extra_payload_lands_in_doc(tmp_path):
+    rec = FlightRecorder(threshold_s=1.0, dump_dir=str(tmp_path))
+    rec.record("ev", 0.0)
+    path = rec.dump(
+        reason="manual",
+        extra={"pod": "ns/p", "pod_spans": [{"stage": "bind"}]},
+    )
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["pod"] == "ns/p"
+    assert doc["pod_spans"] == [{"stage": "bind"}]
+    assert doc["spans"]                    # the ring is still there

@@ -166,6 +166,7 @@ from k8s1m_tpu.tenancy.preempt import (
     note_eviction,
     select_preemption,
 )
+from k8s1m_tpu.snapshot.bulkload import BulkNodeLoader
 from k8s1m_tpu.store.native import (
     BIND_INVALID,
     POD_CANONICAL,
@@ -177,6 +178,8 @@ from k8s1m_tpu.store.native import (
     Watcher,
     drain_events_light,
     list_prefix,
+    list_prefix_sharded,
+    list_prefix_values,
     prefix_end,
 )
 
@@ -208,6 +211,13 @@ _RESYNCS = Counter(
     "coordinator_resyncs_total", "Full relist+rewatch recoveries", ()
 )
 _NODE_COUNT = Gauge("coordinator_node_count", "Nodes in the snapshot", ())
+_COLD_BUILD = Gauge(
+    "megarow_cold_build_seconds",
+    "Wall seconds of the last store->watch->table cold build "
+    "(bootstrap's node relist + bulk ingest + device table build) — "
+    "a first-class metric so a 1M-row build is a number, not a silent "
+    "multi-minute stall", (),
+)
 # All live coordinators in this process; gauges aggregate over them so a
 # discarded instance neither pins memory nor clobbers the live one's stats.
 # Scrape-thread reads of cycle-thread-owned state go through racy_read:
@@ -445,6 +455,67 @@ def unsplice_node_name(raw: bytes) -> bytes | None:
     return raw[:start] + raw[j + 2:]
 
 
+class _VictimRows:
+    """Row-keyed view over the coordinator's incremental by-node victim
+    index — the ``victims_by_row`` mapping ``select_preemption``
+    consumes, built per wave in O(nodes-with-victims) instead of the
+    old O(bound pods) ledger scan.
+
+    Only the row -> node-name resolution is materialized up front
+    (ints; victims whose node left the snapshot drop out exactly like
+    the old scan's ``row_of.get``).  ``get`` reads the live per-node
+    dict fresh on every call, so evictions during the same wave
+    (``_evict_bound`` pops the index) are visible to later preemptors
+    with no manual bookkeeping; rows are patched into the returned
+    Victims for the replay log's benefit.
+    """
+
+    __slots__ = ("_by_node", "_name_at", "_max_seq")
+
+    def __init__(self, by_node: dict, row_of: dict, max_seq: int) -> None:
+        self._by_node = by_node
+        self._name_at = {
+            row_of[name]: name for name in by_node if name in row_of
+        }
+        # Bind-sequence fence: only pods bound BEFORE this view was
+        # built are victims.  Without it, a preemptor's own host-side
+        # bind (inserted live into the by-node index) would be visible
+        # to later preemptors of the SAME wave — same-wave eviction
+        # thrash the old snapshot index structurally excluded.
+        self._max_seq = max_seq
+
+    def get(self, row: int, default=()):
+        name = self._name_at.get(row)
+        if name is None:
+            return default
+        d = self._by_node.get(name)
+        if not d:
+            return default
+        out = [
+            dataclasses.replace(v, row=row)
+            for v in d.values() if v.seq <= self._max_seq
+        ]
+        return out or default
+
+    def items(self):
+        """Materialized (row, victims) pairs — the replay-log dump."""
+        return [(row, self.get(row)) for row in sorted(self._name_at)]
+
+    def values(self):
+        return [vs for _row, vs in self.items()]
+
+    def __eq__(self, other):
+        # Dict-shaped for consumers (and tests) that compare against
+        # the materialized per-row index.
+        if isinstance(other, (dict, _VictimRows)):
+            return dict(self.items()) == (
+                other if isinstance(other, dict) else dict(other.items())
+            )
+        return NotImplemented
+
+    __hash__ = None
+
+
 @guarded_by(
     # Webhook-thread <-> cycle-thread boundary: the staging list is the
     # ONLY coordinator state server threads may touch, and only under
@@ -465,6 +536,9 @@ def unsplice_node_name(raw: bytes) -> bytes | None:
     _gang_staging=THREAD_OWNER,
     _gang_parked=THREAD_OWNER,
     _bind_meta=THREAD_OWNER,
+    # The incremental preemption-victims index mirrors _bound/_bind_meta
+    # (same insert/delete sites, same cycle-thread confinement).
+    _victims_by_node=THREAD_OWNER,
     _trace_gaveup=THREAD_OWNER,
 )
 class Coordinator:
@@ -702,6 +776,9 @@ class Coordinator:
         self._packing_rebuilding = False
 
         self.host = NodeTableHost(table_spec)
+        # Bulk cold-relist lane (snapshot/bulkload.py): templates and
+        # the bytes->str memo persist across bootstrap and resyncs.
+        self._bulk = BulkNodeLoader(self.host)
         self.tracker = ConstraintTracker(table_spec)
         # One shape-keyed template cache shared by every encoder this
         # coordinator owns (inline buckets, the feed's worker, the
@@ -867,6 +944,17 @@ class Coordinator:
         # of its gang bound, the exact state gangs exist to prevent.
         self._bind_meta: dict[str, tuple[int, int, str, str]] = {}
         self._bind_seq = 0
+        # Incremental preemption-victims index: node name -> {pod key ->
+        # Victim}, maintained at the same insert/delete sites as _bound
+        # (_victims_note/_victims_drop) so victim selection never scans
+        # the full bound-pod ledger per wave — the O(bound pods) scan
+        # the 1M-pod shape cannot afford (ISSUE 14).  Only maintained
+        # when preemption can actually run; rows resolve lazily at wave
+        # time (_VictimRows) so node remove/re-add never stales it.
+        self._track_victims = bool(
+            tenancy is not None and tenancy.policy.preempt_enabled
+        )
+        self._victims_by_node: dict[str, dict[str, Victim]] = {}
         # Replayable preemption evidence (populated only when
         # tenancy.policy.log_preemptions; bounded).
         self.preempt_log: list[dict] = []
@@ -982,16 +1070,35 @@ class Coordinator:
 
     # ---- bootstrap -----------------------------------------------------
 
+    def _relist_nodes(self) -> tuple[list, int]:
+        """Full node relist for bootstrap/resync, returning ``(values,
+        revision)`` — the bulk ingest lane reads node names out of the
+        objects, so the keys (and their per-KV wrappers) are never
+        materialized.  The in-process store takes the values-only light
+        parse serially (its page parse is GIL-bound — sharding buys
+        nothing); wire stores fan the value fetch over key-range shards
+        so round trips and proto decode overlap
+        (store/native.list_prefix_sharded)."""
+        if isinstance(self.store, MemStore):
+            return list_prefix_values(self.store, NODES_PREFIX)
+        kvs, rev = list_prefix_sharded(self.store, NODES_PREFIX, shards=8)
+        return [kv.value for kv in kvs], rev
+
     def bootstrap(self) -> None:
         """List+watch: load current state, then stream deltas from there.
 
         The watch starts at the list revision + 1, the same
-        resourceVersion handoff kube informers perform.
+        resourceVersion handoff kube informers perform.  The node
+        relist feeds the bulk ingest lane (snapshot/bulkload.py) —
+        byte-identical to the per-node upsert loop it replaced, minus
+        the per-node wall — and the whole store->table build is timed
+        into ``megarow_cold_build_seconds``.
         """
+        t_cold = time.perf_counter()
         with _CYCLE_TIME.time(stage="bootstrap"):
-            kvs, rev = list_prefix(self.store, NODES_PREFIX)
-            for kv in kvs:
-                self.host.upsert(decode_node(kv.value))
+            values, rev = self._relist_nodes()
+            self._bulk.ingest(values)
+            del values
             self._nodes_watch = self.store.watch(
                 NODES_PREFIX, prefix_end(NODES_PREFIX),
                 start_revision=rev + 1, queue_cap=self.watch_queue_cap,
@@ -1005,6 +1112,7 @@ class Coordinator:
             )
             self._bind_excludes = isinstance(self._pods_watch, Watcher)
             self.table = self._table_to_device()
+        _COLD_BUILD.set(time.perf_counter() - t_cold)
 
     # ---- watch delta application --------------------------------------
 
@@ -1016,6 +1124,29 @@ class Coordinator:
             or any(r.required and r.anti for r in pod.affinity_refs)
         )
 
+    def _victims_note(
+        self, key: str, node_name: str, cpu: int, mem: int,
+        priority: int, seq: int, tenant: str, gang: str,
+    ) -> None:
+        """Insert one bound pod into the incremental victims index —
+        called at BOTH _bound insert sites (_note_bound and the native
+        bind-batch retire).  Gang members are excluded exactly like the
+        old per-wave scan: evicting one would strand its gang bound.
+        ``row`` is carried as -1; _VictimRows resolves it lazily against
+        the live row mapping at wave time."""
+        if not self._track_victims or gang:
+            return
+        self._victims_by_node.setdefault(node_name, {})[key] = Victim(
+            key, node_name, -1, cpu, mem, priority, seq, tenant,
+        )
+
+    def _victims_drop(self, key: str, node_name: str) -> None:
+        if not self._track_victims:
+            return
+        d = self._victims_by_node.get(node_name)
+        if d is not None and d.pop(key, None) is not None and not d:
+            del self._victims_by_node[node_name]
+
     def _note_bound(self, pod: PodInfo, node_name: str, *, external: bool) -> None:
         row = self.host.row_of(node_name)
         zone, region = int(self.host.zone[row]), int(self.host.region[row])
@@ -1023,9 +1154,14 @@ class Coordinator:
         self._bound[pod.key] = (node_name, pod.cpu_milli, pod.mem_kib, zone, region, keep)
         self._bind_seq += 1
         gang = gang_of_labels(pod.labels, pod.namespace)
+        gang_id = gang[0] if gang is not None else ""
+        tenant = tenant_of_pod(pod)
         self._bind_meta[pod.key] = (
-            pod.priority, self._bind_seq, tenant_of_pod(pod),
-            gang[0] if gang is not None else "",
+            pod.priority, self._bind_seq, tenant, gang_id,
+        )
+        self._victims_note(
+            pod.key, node_name, pod.cpu_milli, pod.mem_kib,
+            pod.priority, self._bind_seq, tenant, gang_id,
         )
         if external and keep is not None and self.constraints is not None:
             # An externally bound pod contributes to domain counts exactly
@@ -1116,6 +1252,7 @@ class Coordinator:
         bound = self._bound.pop(pod_key_str, None)
         if bound is not None:
             node_name, cpu, mem, zone, region, keep = bound
+            self._victims_drop(pod_key_str, node_name)
             if node_name in self.host._row_of:
                 self.host.remove_pod(node_name, cpu, mem)
                 self._dirty_rows.add(self.host.row_of(node_name))
@@ -1486,15 +1623,21 @@ class Coordinator:
             self._nodes_watch.cancel()
             self._pods_watch.cancel()
 
-            kvs, rev = list_prefix(self.store, NODES_PREFIX)
-            listed = set()
-            for kv in kvs:
-                node = decode_node(kv.value)
-                listed.add(node.name)
-                self._dirty_rows.add(self.host.upsert(node))
-            for name in list(self.host._row_of):
-                if name not in listed:
-                    self._dirty_rows.add(self.host.remove(name))
+            values, rev = self._relist_nodes()
+            rows = self._bulk.ingest(values)
+            del values
+            self._dirty_rows.update(rows.tolist())
+            # Listed names read back from the ingested rows (the
+            # object's metadata.name, exactly what the old decode loop
+            # collected), so a writer whose key disagrees with its
+            # object cannot desync the removal sweep.
+            nv = self.host.vocab.node_names._to_val
+            listed = {nv[i] for i in self.host.name_id[rows].tolist()}
+            stale = [
+                name for name in self.host._row_of if name not in listed
+            ]
+            for name in stale:
+                self._dirty_rows.add(self.host.remove(name))
             self._nodes_watch = self.store.watch(
                 NODES_PREFIX, prefix_end(NODES_PREFIX),
                 start_revision=rev + 1, queue_cap=self.watch_queue_cap,
@@ -2028,7 +2171,15 @@ class Coordinator:
         (vocab drift) — the caller rebuilds fail-closed."""
         if is_packed(self.table):
             return pack_row_delta(self.host, rows, self.table.spec, columns)
-        return {c: getattr(self.host, c)[rows] for c in columns}
+        out = {}
+        for c in columns:
+            arr = getattr(self.host, c)[rows]
+            if arr.dtype != np.bool_ and arr.dtype != np.int32:
+                # Narrow mirror columns (node_table.mirror_dtype) widen
+                # back to the unpacked device layout's int32.
+                arr = arr.astype(np.int32)
+            out[c] = arr
+        return out
 
     def _packing_fallback(self, e: PackingOverflow) -> None:
         """Fail-closed layout widening (the vocab-drift gate, hotfeed's
@@ -2347,6 +2498,7 @@ class Coordinator:
             return False, None
         self._bound.pop(key_str, None)
         self._bind_meta.pop(key_str, None)
+        self._victims_drop(key_str, node_name)
         if node_name in self.host._row_of:
             self.host.remove_pod(node_name, cpu, mem)
             self._dirty_rows.add(self.host.row_of(node_name))
@@ -2384,13 +2536,24 @@ class Coordinator:
             and p.attempts + 1 >= tn.policy.preempt_after_attempts
         )
 
-    def _victims_index(self) -> dict[int, list[Victim]]:
-        """All preemptable bound pods grouped by row — built at most
-        ONCE per wave (the O(bound pods) scan must not repeat per
-        failing preemptor; select_preemption applies the per-preemptor
-        priority filter itself).  Gang-bound pods are excluded: evicting
-        one member would strand its gang bound — the exact partial
-        state gangs exist to prevent."""
+    def _victims_index(self) -> _VictimRows:
+        """Per-wave view of all preemptable bound pods grouped by row —
+        built at most ONCE per wave from the incrementally-maintained
+        by-node index (select_preemption applies the per-preemptor
+        priority filter itself).  Gang-bound pods were excluded at
+        insert time: evicting one member would strand its gang bound —
+        the exact partial state gangs exist to prevent.  The current
+        bind sequence fences the view: this wave's own preemption
+        binds (noted later) never become victims within the wave."""
+        return _VictimRows(
+            self._victims_by_node, self.host._row_of, self._bind_seq,
+        )
+
+    def _victims_index_full(self) -> dict[int, list[Victim]]:
+        """The pre-megarow full ``_bound.items()`` scan, kept as the
+        differential reference: the incremental index must materialize
+        to exactly this (tests/test_megarow.py gates it under a
+        preemption drill).  Never called on the wave path."""
         victims_by_row: dict[int, list[Victim]] = {}
         row_of = self.host._row_of
         for key, rec in self._bound.items():
@@ -2411,7 +2574,7 @@ class Coordinator:
         return victims_by_row
 
     def _try_preempt(
-        self, p: PendingPod, victims_by_row: dict[int, list[Victim]]
+        self, p: PendingPod, victims_by_row: _VictimRows
     ) -> bool:
         """Preemption for a pod the wave found no feasible row for:
         select victims (tenancy/preempt.py — lowest priority first,
@@ -2475,11 +2638,10 @@ class Coordinator:
                         rec.key_str, rec.enqueued_at, source="evict",
                     )
                 self.queue.append(rec)
-            # Keep the caller's per-wave index current for the next
-            # preemptor: this pod is no longer bound.
-            vs = victims_by_row.get(v.row)
-            if vs is not None:
-                victims_by_row[v.row] = [x for x in vs if x.key != v.key]
+            # The eviction already dropped this pod from the by-node
+            # index (_evict_bound -> _victims_drop), and the per-wave
+            # _VictimRows view reads that index live — later preemptors
+            # in the same wave see current state with no manual repair.
         if not self._bind(p, choice.node):
             return False
         _BIND_LATENCY.observe(time.perf_counter() - p.enqueued_at)
@@ -3265,8 +3427,9 @@ class Coordinator:
                             if p.pod is not None and self._constraintful(p.pod)
                             else None
                         )
+                        node_name = nv[ids_l[j]]
                         bound_dict[p.key_str] = (
-                            nv[ids_l[j]], p.cpu_milli, p.mem_kib,
+                            node_name, p.cpu_milli, p.mem_kib,
                             zones[j], regions[j], keep,
                         )
                         self._bind_seq += 1
@@ -3275,11 +3438,16 @@ class Coordinator:
                         # supplies the label-aware tenant; the true
                         # fast-lane (pod=None) is label-less canonical,
                         # so its key namespace IS the tenant.
-                        self._bind_meta[p.key_str] = (
-                            p.priority, self._bind_seq,
+                        tenant = (
                             tenant_of_pod(p.pod) if p.pod is not None
-                            else tenant_of_key(p.key_str),
-                            p.gang_id,
+                            else tenant_of_key(p.key_str)
+                        )
+                        self._bind_meta[p.key_str] = (
+                            p.priority, self._bind_seq, tenant, p.gang_id,
+                        )
+                        self._victims_note(
+                            p.key_str, node_name, p.cpu_milli, p.mem_kib,
+                            p.priority, self._bind_seq, tenant, p.gang_id,
                         )
                         continue
                     name = nbytes[ids_l[j]].decode()

@@ -189,6 +189,30 @@ def decode_node(data: bytes) -> NodeInfo:
     )
 
 
+# Exact grammar of encode_node's output for a plain schedulable node
+# (no taints, no unschedulable, fixed Ready conditions): the bulk
+# cold-build lane (snapshot/bulkload.py) FULLMATCHES a value against
+# this and reads the captures directly — name, raw label blob, cpu
+# milli, mem KiB, pods.  Everything variable is captured by character
+# classes that exclude quotes, backslashes and control bytes, so a
+# fullmatch parses byte-identically to json.loads by construction
+# (json.dumps ensure_ascii escapes non-ASCII into backslash sequences,
+# which simply fail the match); any other shape — heartbeat-churned
+# status, taints, escapes — falls back to decode_node per value.
+_S = rb'[^"\\\x00-\x1f]*'
+CANONICAL_NODE_RE = re.compile(
+    rb'\{"apiVersion":"v1","kind":"Node","metadata":\{"name":"(' + _S +
+    rb')","labels":\{((?:"' + _S + rb'":"' + _S +
+    rb'"(?:,"' + _S + rb'":"' + _S + rb'")*)?)\}\},"spec":\{\},'
+    rb'"status":\{"allocatable":\{"cpu":"(\d+)m","memory":"(\d+)Ki",'
+    rb'"pods":"(\d+)"\},"conditions":\[\{"type":"Ready","status":'
+    rb'"True"\}\]\}\}'
+)
+# One label pair inside the captured blob (the blob grammar above
+# guarantees findall reconstructs it exactly; duplicate keys resolve
+# last-wins below, matching json.loads).
+CANONICAL_LABEL_RE = re.compile(rb'"(' + _S + rb')":"(' + _S + rb')"')
+
 # Byte landmarks of the canonical encode_node shape (same restricted-
 # parser contract as decode_pod_fast): accepted iff the metadata prefix
 # matches exactly, spec is EMPTY (taints/unschedulable fall back to the

@@ -343,7 +343,7 @@ class DeltaPlaneCache:
         *,
         slots: int = 64,
         fill_batch: int = 16,
-        journal_cap: int = 1 << 16,
+        journal_cap: int | None = None,
         seen_cap: int = 1 << 16,
         dirty_cap: int | None = None,
         sharding=None,
@@ -360,6 +360,18 @@ class DeltaPlaneCache:
         self.dirty_cap = (
             dirty_cap if dirty_cap is not None else max(num_rows // 4, 1)
         )
+        if journal_cap is None:
+            # Scale-aware journal bound (ISSUE 14): the cap tracks the
+            # TABLE SIZE, not a fixed row budget — at the old 1<<16 a
+            # 1M-row churn burst compacted the journal every wave and
+            # fail-closed the whole cache to wholesale refills.  Half
+            # the table (compacting down to dirty_cap, a quarter) keeps
+            # the enumerable window a constant FRACTION of rows: the
+            # delta lane stays plannable right up to the dirty_cap
+            # break-even it would abandon anyway.  At 131072 rows this
+            # derives exactly the old 1<<16 — the fixed-cap
+            # differential gate (tests/test_megarow.py).
+            journal_cap = max(1 << 16, num_rows // 2)
         self.versions = RowVersions(cap=journal_cap)
         self._sharding = sharding
         self._mask = None           # bool[S, N] device plane

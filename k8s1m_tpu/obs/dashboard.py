@@ -54,6 +54,11 @@ ROWS = [
     # (HBM-budget pressure), journaled dirty rows (mean dirty fraction),
     # and planes resident across live caches.
     ("Incremental scheduling (deltasched)", ("deltasched_",)),
+    # The 1,048,576-row operating shape (ISSUE 14 megarow): cold-build
+    # wall seconds (bootstrap relist -> bulk ingest -> device table),
+    # bulk-ingest row rate (snapshot/bulkload + bulk_upsert), and the
+    # host mirror's column-byte budget under the narrow-dtype rule.
+    ("Million-row (megarow)", ("megarow_",)),
     # Packed device snapshot + buffer donation (snapshot/packing.py,
     # ISSUE 10 devicestate): table HBM bytes by layout, per-wave commit
     # donations split by whether the runtime honored them in place, and

@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +51,51 @@ from k8s1m_tpu.config import (
     TableSpec,
 )
 from k8s1m_tpu.lint import THREAD_OWNER, guarded_by
+from k8s1m_tpu.obs.metrics import Counter, Gauge
 from k8s1m_tpu.snapshot.interning import Vocab, numeric_of
+
+_BULK_ROWS = Counter(
+    "megarow_bulk_ingest_rows_total",
+    "Node rows ingested through the vectorized bulk lane "
+    "(NodeTableHost.bulk_upsert / snapshot.bulkload) — rate vs wall "
+    "clock is the bulk-ingest rows/s evidence", (),
+)
+_MIRROR_BYTES = Gauge(
+    "megarow_host_mirror_bytes",
+    "Host-mirror column bytes across live NodeTableHost instances "
+    "(the int16/int8 mirror-width rule's budget gauge)", (),
+)
+_LIVE_HOSTS: weakref.WeakSet = weakref.WeakSet()
+# The gauge callback runs on the metrics scrape thread while any other
+# thread may be constructing a NodeTableHost; a bare WeakSet iteration
+# concurrent with add() raises "set changed size during iteration", so
+# both sides serialize on this lock (mirror_nbytes reads immutable
+# array headers — cheap enough to hold it across the sum).
+_HOSTS_LOCK = threading.Lock()
+
+
+def _mirror_bytes_total() -> int:
+    with _HOSTS_LOCK:
+        return sum(h.mirror_nbytes() for h in _LIVE_HOSTS)
+
+
+_MIRROR_BYTES.set_function(_mirror_bytes_total)
+
+
+def mirror_dtype(bound: int) -> np.dtype:
+    """Host-mirror column width for ids in ``[0, bound)``: the
+    narrowest signed dtype that holds the TableSpec bound, mirroring
+    snapshot/packing.py's packed-layout dtype decisions.  A million-row
+    mirror must not spend 4 bytes on a 512-value zone column; the
+    device-facing transfer paths (``to_device``, the coordinator's
+    dirty-row deltas) re-widen to the canonical int32 so the unpacked
+    device layout is byte-identical either way.  New columns MUST pick
+    their width through this rule (MIGRATION: "Host-mirror dtypes")."""
+    if bound <= 1 << 7:
+        return np.dtype(np.int8)
+    if bound <= 1 << 15:
+        return np.dtype(np.int16)
+    return np.dtype(np.int32)
 
 class RowsExhausted(ValueError):
     """No allocatable row: the table is at ``max_nodes`` and the free
@@ -184,13 +230,18 @@ class NodeTableHost:
         self.cpu_req = np.zeros((n,), np.int32)
         self.mem_req = np.zeros((n,), np.int32)
         self.pods_req = np.zeros((n,), np.int32)
+        # Label/name ids are unbounded by TableSpec (a 1M-node cluster
+        # interns ~1M hostname label values), so they stay int32; the
+        # spec-bounded columns take the narrow mirror width.
         self.label_key = np.zeros((n, l), np.int32)
         self.label_val = np.zeros((n, l), np.int32)
         self.label_num = np.zeros((n, l), np.int32)
-        self.taint_id = np.zeros((n, t), np.int32)
-        self.taint_effect = np.zeros((n, t), np.int32)
-        self.zone = np.zeros((n,), np.int32)
-        self.region = np.zeros((n,), np.int32)
+        self.taint_id = np.zeros((n, t), mirror_dtype(spec.max_taint_ids))
+        # Effects are the 2-bit EFFECT_* range, checked at upsert the
+        # same way pack_meta_np fail-closes past the packed budget.
+        self.taint_effect = np.zeros((n, t), np.int8)
+        self.zone = np.zeros((n,), mirror_dtype(spec.max_zones))
+        self.region = np.zeros((n,), mirror_dtype(spec.max_regions))
         self.name_id = np.zeros((n,), np.int32)
         self._row_of: dict[str, int] = {}
         self._free_rows: list[int] = []
@@ -217,6 +268,22 @@ class NodeTableHost:
         # consumer owns draining it (enable_row_journal returns the list;
         # clear after consuming).
         self._row_journal: list[tuple[str, int, bool]] | None = None
+        with _HOSTS_LOCK:
+            _LIVE_HOSTS.add(self)
+
+    def mirror_nbytes(self) -> int:
+        """Total bytes held by the mirror's column arrays (the
+        megarow_host_mirror_bytes evidence; excludes the row mapping
+        and vocab, which are Python dicts)."""
+        return sum(
+            getattr(self, c).nbytes
+            for c in (
+                "valid", "cpu_alloc", "mem_alloc", "pods_alloc",
+                "cpu_req", "mem_req", "pods_req",
+                "label_key", "label_val", "label_num",
+                "taint_id", "taint_effect", "zone", "region", "name_id",
+            )
+        )
 
     def enable_row_journal(self) -> list[tuple[str, int, bool]]:
         if self._row_journal is None:
@@ -251,6 +318,33 @@ class NodeTableHost:
         if self._row_journal is not None:
             self._row_journal.append((name, row, True))
         return row
+
+    def bulk_alloc(self, names) -> np.ndarray:
+        """Allocate (or resolve) a row per name, with the capacity
+        check front-loaded: either every name gets a row, or
+        RowsExhausted raises BEFORE any allocation — a mid-batch raise
+        would leave names mapped to rows whose columns were never
+        written (the bulk lanes write columns only after every row is
+        allocated)."""
+        row_of = self._row_of
+        fresh = {n for n in names if n not in row_of}
+        free = len(self._free_rows) + (self.spec.max_nodes - self._next_row)
+        if len(fresh) > free:
+            raise RowsExhausted(
+                f"bulk ingest needs {len(fresh)} fresh rows but only "
+                f"{free} are allocatable (max_nodes="
+                f"{self.spec.max_nodes})" + (
+                    f" ({len(self._quarantine)} rows quarantined; a "
+                    "pipeline quiesce releases them)"
+                    if self._quarantine else ""
+                ),
+                quarantined=len(self._quarantine),
+            )
+        rows = np.empty((len(names),), np.int64)
+        alloc = self._alloc_row
+        for i, name in enumerate(names):
+            rows[i] = alloc(name)
+        return rows
 
     def alloc_rows(self, names: list[str]) -> np.ndarray:
         """Bulk-allocate contiguous-ish rows for many new nodes.
@@ -295,9 +389,17 @@ class NodeTableHost:
                 f"node {node.name}: {len(taints)} taints > "
                 f"taint_slots={self.spec.taint_slots}"
             )
-        tk = np.zeros((self.spec.taint_slots,), np.int32)
-        te = np.zeros_like(tk)
+        tk = np.zeros((self.spec.taint_slots,), self.taint_id.dtype)
+        te = np.zeros((self.spec.taint_slots,), self.taint_effect.dtype)
         for i, taint in enumerate(taints):
+            if not 0 <= taint.effect < 4:
+                # Same fail-closed contract as pack_meta_np's 2-bit
+                # budget: an out-of-range effect must raise here, not
+                # truncate into the int8 mirror.
+                raise ValueError(
+                    f"node {node.name}: taint effect {taint.effect} "
+                    "outside the EFFECT_* range [0, 4)"
+                )
             tid = v.taints.intern((taint.key, taint.value, taint.effect))
             if tid >= self.spec.max_taint_ids:
                 raise ValueError(
@@ -323,6 +425,141 @@ class NodeTableHost:
         self.region[row] = region_id
         self.name_id[row] = v.node_names.intern(node.name)
         return row
+
+    def bulk_upsert(self, nodes) -> np.ndarray:
+        """Vectorized ``upsert`` over many nodes; returns their rows.
+
+        Byte-identical to ``[self.upsert(n) for n in nodes]`` — same
+        column bytes, same row mapping, same vocab contents in the same
+        intern order, same row-journal entries — but the per-node numpy
+        allocations and scattered row writes collapse into block fills
+        and one fancy-indexed write per column, the first wall a 1M-row
+        cold build hits (ISSUE 14).  A name repeated within the batch
+        resolves like repeated upserts: the later entry wins (numpy
+        fancy assignment applies in order).
+
+        Validation is front-loaded: any per-node error (label/taint
+        overflow, id past a TableSpec bound) raises BEFORE any table
+        column, row mapping or journal mutation — strictly cleaner than
+        the loop's partial application (interned strings from the batch
+        may remain; interners are append-only and ids are data).
+        """
+        spec = self.spec
+        v = self.vocab
+        b, nslots, tslots = len(nodes), spec.label_slots, spec.taint_slots
+        lk = np.zeros((b, nslots), np.int32)
+        lv = np.zeros((b, nslots), np.int32)
+        ln = np.zeros((b, nslots), np.int32)
+        tk = np.zeros((b, tslots), self.taint_id.dtype)
+        te = np.zeros((b, tslots), self.taint_effect.dtype)
+        zone = np.zeros((b,), np.int32)
+        region = np.zeros((b,), np.int32)
+        name_id = np.zeros((b,), np.int32)
+        cpu = np.zeros((b,), np.int32)
+        mem = np.zeros((b,), np.int32)
+        pods = np.zeros((b,), np.int32)
+        # Interner internals bound once per batch: the per-label
+        # ``intern`` method-call overhead is a measured slice of the 1M
+        # ingest wall (same-package access, mirrors Interner.intern).
+        lk_id, lk_val = v.label_keys._to_id, v.label_keys._to_val
+        lv_id, lv_val = v.label_values._to_id, v.label_values._to_val
+        # numeric_of memo keyed by interned value id: repeated label
+        # values (zones, groups) pay the parse once per distinct value.
+        num_of: dict[int, int] = {}
+        for i, node in enumerate(nodes):
+            labels = dict(node.labels)
+            labels.setdefault(HOSTNAME_LABEL, node.name)
+            if len(labels) > nslots:
+                raise ValueError(
+                    f"node {node.name}: {len(labels)} labels > "
+                    f"label_slots={nslots}"
+                )
+            for j, (k, val) in enumerate(sorted(labels.items())):
+                ik = lk_id.get(k)
+                if ik is None:
+                    ik = len(lk_val)
+                    lk_id[k] = ik
+                    lk_val.append(k)
+                if val is None:
+                    # Interner.intern's None -> NONE_ID mapping (a JSON
+                    # null label value reaches here via decode_node);
+                    # the inlined fast path must not intern None as a
+                    # fresh id or the bulk lane diverges from upsert.
+                    iv, num = NONE_ID, numeric_of(val)
+                else:
+                    iv = lv_id.get(val)
+                    if iv is None:
+                        iv = len(lv_val)
+                        lv_id[val] = iv
+                        lv_val.append(val)
+                    num = num_of.get(iv)
+                    if num is None:
+                        num = numeric_of(val)
+                        num_of[iv] = num
+                lk[i, j] = ik
+                lv[i, j] = iv
+                ln[i, j] = num
+            taints = list(node.taints)
+            if node.unschedulable:
+                taints.append(
+                    Taint(UNSCHEDULABLE_TAINT_KEY, "", EFFECT_NO_SCHEDULE)
+                )
+            if len(taints) > tslots:
+                raise ValueError(
+                    f"node {node.name}: {len(taints)} taints > "
+                    f"taint_slots={tslots}"
+                )
+            for j, taint in enumerate(taints):
+                if not 0 <= taint.effect < 4:
+                    raise ValueError(
+                        f"node {node.name}: taint effect {taint.effect} "
+                        "outside the EFFECT_* range [0, 4)"
+                    )
+                tid = v.taints.intern((taint.key, taint.value, taint.effect))
+                if tid >= spec.max_taint_ids:
+                    raise ValueError(
+                        "distinct taint triples overflow "
+                        "TableSpec.max_taint_ids"
+                    )
+                tk[i, j] = tid
+                te[i, j] = taint.effect
+            zid = (
+                v.zones.intern(labels[ZONE_LABEL])
+                if ZONE_LABEL in labels else NONE_ID
+            )
+            rid = (
+                v.regions.intern(labels[REGION_LABEL])
+                if REGION_LABEL in labels else NONE_ID
+            )
+            if zid >= spec.max_zones or rid >= spec.max_regions:
+                raise ValueError(
+                    "zone/region id overflow; grow "
+                    "TableSpec.max_zones/max_regions"
+                )
+            zone[i] = zid
+            region[i] = rid
+            name_id[i] = v.node_names.intern(node.name)
+            cpu[i] = node.cpu_milli
+            mem[i] = node.mem_kib
+            pods[i] = node.pods
+        # Every node validated: allocate rows (capacity pre-checked;
+        # journal + epoch side effects in batch order, exactly like the
+        # loop) and land the blocks in one write per column.
+        rows = self.bulk_alloc([node.name for node in nodes])
+        self.valid[rows] = True
+        self.cpu_alloc[rows] = cpu
+        self.mem_alloc[rows] = mem
+        self.pods_alloc[rows] = pods
+        self.label_key[rows] = lk
+        self.label_val[rows] = lv
+        self.label_num[rows] = ln
+        self.taint_id[rows] = tk
+        self.taint_effect[rows] = te
+        self.zone[rows] = zone
+        self.region[rows] = region
+        self.name_id[rows] = name_id
+        _BULK_ROWS.inc(b)
+        return rows
 
     def remove(self, name: str) -> int:
         row = self._row_of.pop(name)
@@ -400,6 +637,11 @@ class NodeTableHost:
 
     def to_device(self, sharding=None) -> NodeTable:
         def put(x):
+            if x.dtype != np.bool_:
+                # Narrow mirror columns (mirror_dtype rule) widen back
+                # to the canonical device int32; no-copy when already
+                # int32, so the wide columns transfer as before.
+                x = np.asarray(x, np.int32)
             return jax.device_put(jnp.asarray(x), sharding) if sharding else jnp.asarray(x)
 
         return NodeTable(

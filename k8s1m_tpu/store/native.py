@@ -474,6 +474,89 @@ def list_prefix(
     raise CompactedError()
 
 
+def list_prefix_values(store, prefix: bytes, *, page: int = 5000):
+    """Values-only ``list_prefix``: ``(values, revision)`` with the
+    same pinned-snapshot pagination contract, skipping per-KV object
+    construction entirely (``MemStore.range_values``).  The megarow
+    cold relist reads a million stored Nodes whose names live in the
+    objects — building a million KeyValue dataclasses plus key bytes
+    just to drop them was a measured slice of the cold-build wall.
+    Falls back to ``list_prefix`` for stores without the light parse
+    (remote wire clients)."""
+    rv = getattr(store, "range_values", None)
+    if rv is None:
+        kvs, rev = list_prefix(store, prefix, page=page)
+        return [kv.value for kv in kvs], rev
+    for _ in range(3):
+        start, end = prefix, prefix_end(prefix)
+        out: list = []
+        rev = 0
+        try:
+            while True:
+                r, more, vals, last = rv(
+                    start, end, limit=page, revision=rev
+                )
+                if rev == 0:
+                    rev = r
+                out.extend(vals)
+                if not more or not vals:
+                    return out, rev
+                start = last + b"\x00"
+        except CompactedError:
+            continue
+    raise CompactedError()
+
+
+def list_prefix_sharded(
+    store, prefix: bytes, *, shards: int = 8, page: int = 5000,
+):
+    """``list_prefix`` with the value fetch fanned out over key-range
+    shards: one keys-only paginated pass pins the snapshot revision and
+    yields shard boundaries, then ``shards`` concurrent range scans pull
+    the values at that revision.  Returns ``(kvs, revision)`` with kvs
+    in key order — byte-identical to ``list_prefix`` (tier-1 gate).
+
+    This is the megarow cold-relist shape for WIRE stores, where the
+    per-page round trip and proto decode overlap across shards.  For
+    the in-process MemStore the parse is GIL-bound and sharding buys
+    nothing — pass ``shards=1`` (or call ``list_prefix``) there; the
+    coordinator picks per store type (control/coordinator._relist).
+    """
+    if shards <= 1:
+        return list_prefix(store, prefix, page=page)
+    from concurrent.futures import ThreadPoolExecutor
+
+    for _ in range(3):
+        keys, rev = list_prefix(store, prefix, page=page, keys_only=True)
+        n = len(keys)
+        if n == 0:
+            return [], rev
+        nshards = min(shards, n)
+        bounds = [keys[i * n // nshards].key for i in range(nshards)]
+        bounds.append(prefix_end(prefix))
+
+        def fetch(i: int) -> list:
+            out: list = []
+            start, end = bounds[i], bounds[i + 1]
+            while True:
+                res = store.range(start, end, limit=page, revision=rev)
+                out.extend(res.kvs)
+                if not res.more or not res.kvs:
+                    return out
+                start = res.kvs[-1].key + b"\x00"
+
+        try:
+            with ThreadPoolExecutor(nshards) as ex:
+                parts = list(ex.map(fetch, range(nshards)))
+        except CompactedError:
+            # The pin fell out of the store's window mid-fetch (heavy
+            # write load + aggressive compaction): re-pin and restart,
+            # the same reflector-on-410 rule as list_prefix.
+            continue
+        return [kv for part in parts for kv in part], rev
+    raise CompactedError()
+
+
 def scan_prefix(
     store, prefix: bytes, *, page: int = 5000, keys_only: bool = False
 ):
@@ -715,6 +798,49 @@ class MemStore:
             kv, off = _parse_kv(buf, off)
             kvs.append(kv)
         return RangeResult(rev, count, bool(more), kvs)
+
+    def range_values(
+        self,
+        start: bytes,
+        end: bytes | None = None,
+        *,
+        revision: int = 0,
+        limit: int = 0,
+    ) -> tuple[int, bool, list, bytes | None]:
+        """``range`` minus everything but the value bytes: returns
+        ``(revision, more, values, last_key)`` (``last_key`` feeds the
+        pagination cursor).  Same wire frame, light parse — the per-KV
+        KeyValue/key-bytes construction that dominates a million-row
+        relist in Python is skipped (the range_light counterpart of
+        poll_light)."""
+        lib = _lib()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = lib.ms_range(
+            self._h, start, len(start),
+            end, 0 if end is None else len(end),
+            revision, limit, 0, 0,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if rc == _ERR_COMPACTED:
+            raise CompactedError(self.compact_revision)
+        if rc == _ERR_FUTURE_REV:
+            raise FutureRevError(f"revision {revision} > current")
+        data = _take_buf(lib, out, out_len)
+        buf = memoryview(data)
+        rev, _count, n, more = struct.unpack_from("<qqIB", buf, 0)
+        off = 21
+        values: list = []
+        unpack = _KV_FIXED.unpack_from
+        fixed = _KV_FIXED.size
+        kend = klen = 0
+        for _ in range(n):
+            klen, vlen = unpack(buf, off)[:2]
+            kend = off + fixed + klen
+            off = kend + vlen
+            values.append(bytes(buf[kend:off]))
+        last_key = bytes(buf[kend - klen:kend]) if n else None
+        return rev, bool(more), values, last_key
 
     def get(self, key: bytes, revision: int = 0) -> KeyValue | None:
         res = self.range(key, revision=revision)

@@ -21,10 +21,18 @@ from k8s1m_tpu.store.etcd_client import EtcdClient
 class RateReporter:
     """Prints ops/sec once per interval, like the reference's stdout logs."""
 
-    def __init__(self, label: str, interval_s: float = 1.0, quiet: bool = False):
+    def __init__(
+        self, label: str, interval_s: float = 1.0, quiet: bool = False,
+        milestone: int = 0,
+    ):
         self.label = label
         self.interval_s = interval_s
         self.quiet = quiet
+        # Progress line every ``milestone`` ops regardless of quiet —
+        # the heartbeat of an hour-scale bulk run (megarow: every 100k
+        # nodes), rare enough not to be the 1s rate spam --quiet mutes.
+        self.milestone = milestone
+        self._milestones = 0
         self.count = 0
         self.errors = 0
         self._t0 = time.perf_counter()
@@ -34,6 +42,16 @@ class RateReporter:
     def add(self, n: int = 1) -> None:
         self.count += n
         now = time.perf_counter()
+        if self.milestone and self.count // self.milestone > self._milestones:
+            self._milestones = self.count // self.milestone
+            rate = self.count / max(now - self._t0, 1e-9)
+            print(
+                f"{self.label}: {self.count:,} "
+                f"({now - self._t0:,.1f}s, {rate:,.0f}/s overall)",
+                flush=True,
+            )
+            self._last, self._last_count = now, self.count
+            return
         if not self.quiet and now - self._last >= self.interval_s:
             rate = (self.count - self._last_count) / (now - self._last)
             print(f"{self.label}: {self.count} total, {rate:,.0f}/s", flush=True)

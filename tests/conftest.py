@@ -33,6 +33,11 @@ def _needs_reexec() -> bool:
 
 
 def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute drills (the 1M megarow run) — excluded "
+        "from tier-1 via -m 'not slow'",
+    )
     if not _needs_reexec():
         return
     # Restore the real stdout/stderr before exec'ing, or the child's

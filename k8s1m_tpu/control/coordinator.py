@@ -539,6 +539,9 @@ class _VictimRows:
     # The incremental preemption-victims index mirrors _bound/_bind_meta
     # (same insert/delete sites, same cycle-thread confinement).
     _victims_by_node=THREAD_OWNER,
+    # The incremental fallback NodeInfo index is maintained at the node
+    # watch-drain sites (cycle-thread) and read by _fallback_nodes.
+    _node_infos=THREAD_OWNER,
     _trace_gaveup=THREAD_OWNER,
 )
 class Coordinator:
@@ -761,6 +764,15 @@ class Coordinator:
         # keyed on applied node events so node churn invalidates it.
         self._fallback_cache: tuple[int, list] | None = None
         self._node_gen = 0
+        # Incremental NodeInfo index under it: maintained at the watch-
+        # drain decode sites (zero added decode cost — the NodeInfo is
+        # already in hand there), lazily seeded from one store decode
+        # for rows that arrived via the bulk ingest lane (bootstrap and
+        # resync never build per-node objects), cleared on resync (the
+        # bulk relist refreshes rows without decoding).  Keeps the
+        # emergency path off the O(N)-per-node-gen store decode
+        # (ROADMAP item 1 leftover).
+        self._node_infos: dict[str, object] = {}
 
         # Packed snapshot mode; the PackingSpec itself is built lazily at
         # first table upload so the label-fusion fail-closed decision
@@ -1398,8 +1410,10 @@ class Coordinator:
                     else:
                         self._dirty_rows.add(self._upsert_node(node))
                         self._adopt_orphans(node.name)
+                    self._node_infos[node.name] = node
                 else:
                     name = key[len(NODES_PREFIX):].decode()
+                    self._node_infos.pop(name, None)
                     if name in row_of:
                         self._dirty_rows.add(self.host.remove(name))
         self._node_gen += n
@@ -1603,6 +1617,12 @@ class Coordinator:
         the store and restart both watches from the list revisions."""
         _RESYNCS.inc()
         self._node_gen += 1
+        # The bulk relist below refreshes every row WITHOUT building
+        # per-node objects; a kept index would serve pre-outage
+        # NodeInfos for rows whose values changed while the watch was
+        # broken.  Drop it wholesale — the next fallback call re-seeds
+        # lazily from the store.
+        self._node_infos.clear()
         if self._inflights:
             # Call sites quiesce first; this is the defensive backstop
             # (a driver calling drain_watches mid-flight) — the relist
@@ -1876,6 +1896,7 @@ class Coordinator:
                 log.exception("undecodable node in reconcile; skipping")
                 continue
             self._dirty_rows.add(self._upsert_node(node))
+            self._node_infos[node.name] = node
             self._adopt_orphans(name)
             rep["nodes_added"] += 1
         for name in list(row_of):
@@ -1885,6 +1906,7 @@ class Coordinator:
             # the node was created after the pin (the mirror is right).
             if self.store.get(node_key(name)) is not None:
                 continue
+            self._node_infos.pop(name, None)
             self._dirty_rows.add(self.host.remove(name))
             rep["nodes_removed"] += 1
         seen = set()
@@ -3169,14 +3191,57 @@ class Coordinator:
     def _fallback_nodes(self) -> list:
         """Decoded ``(row, NodeInfo)`` candidates for the breaker-open
         oracle fallback, ascending row (ties break earlier-row like the
-        device path's earlier-index rule).  Cached until a node event or
-        resync lands — the O(N) store decode is an emergency-path cost,
-        paid once per node-set generation, not per wave."""
+        device path's earlier-index rule).
+
+        Built from the incremental ``_node_infos`` index (maintained at
+        the watch-drain decode sites), so a node-gen bump costs
+        O(changed rows), not an O(N) store decode per generation.  Rows
+        the index has never seen — the bulk-ingest remainder from
+        bootstrap/resync — are seeded from ONE store decode, paid once
+        ever (per resync), after which churn keeps the index current
+        event by event.  Differentially gated against the full decode
+        (``_fallback_nodes_full``) in tests/test_loadshed.py."""
         if (
             self._fallback_cache is not None
             and self._fallback_cache[0] == self._node_gen
         ):
             return self._fallback_cache[1]
+        row_of = self.host._row_of
+        infos = self._node_infos
+        missing = {name for name in row_of if name not in infos}
+        if missing:
+            kvs, _ = list_prefix(self.store, NODES_PREFIX)
+            for kv in kvs:
+                name = kv.key[len(NODES_PREFIX):].decode()
+                if name not in missing:
+                    continue
+                try:
+                    infos[name] = decode_node(kv.value)
+                except Exception:
+                    # Same quarantine contract as the watch drains: one
+                    # malformed object must not silently shrink the
+                    # emergency fallback's candidate set.
+                    _DECODE_ERRORS.inc(kind="node")
+                    log.exception(
+                        "undecodable node in fallback seed; skipping"
+                    )
+        out = []
+        mask = self._row_mask_np
+        for name, row in row_of.items():
+            nd = infos.get(name)
+            if nd is None:
+                continue
+            if mask is not None and not mask[row]:
+                continue
+            out.append((row, nd))
+        out.sort(key=lambda t: t[0])
+        self._fallback_cache = (self._node_gen, out)
+        return out
+
+    def _fallback_nodes_full(self) -> list:
+        """The pre-watchplane full store decode, kept UNCACHED as the
+        differential oracle for the incremental index (the victims-
+        index precedent: megarow's ``_victims_index_full``)."""
         out = []
         kvs, _ = list_prefix(self.store, NODES_PREFIX)
         mask = self._row_mask_np
@@ -3184,9 +3249,6 @@ class Coordinator:
             try:
                 nd = decode_node(kv.value)
             except Exception:
-                # Same quarantine contract as the watch drains: one
-                # malformed object must not silently shrink the
-                # emergency fallback's candidate set.
                 _DECODE_ERRORS.inc(kind="node")
                 log.exception("undecodable node in fallback list; skipping")
                 continue
@@ -3197,7 +3259,6 @@ class Coordinator:
                 continue
             out.append((row, nd))
         out.sort(key=lambda t: t[0])
-        self._fallback_cache = (self._node_gen, out)
         return out
 
     def _fallback_schedule(self, batch_pods) -> int:

@@ -38,6 +38,7 @@ Metrics: ``faultline_injected_total{component,kind}``,
 
 from k8s1m_tpu.faultline.plan import (
     FAULT_KINDS,
+    NAMED_PLANS,
     FaultDecision,
     FaultPlan,
     FaultSpec,
@@ -64,6 +65,7 @@ from k8s1m_tpu.faultline.policy import (
 
 __all__ = [
     "FAULT_KINDS",
+    "NAMED_PLANS",
     "acheck",
     "FaultDecision",
     "FaultPlan",

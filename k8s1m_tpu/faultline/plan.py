@@ -17,7 +17,16 @@ component             operations
 ====================  =====================================================
 ``store.wire``        ``range`` ``put`` ``delete`` ``txn`` ``put_batch``
                       ``bind_batch`` ``compact`` ``status`` ``watch.recv``
-``watch.tier``        ``upstream.recv`` (the cache tier's store-event pump)
+``watch.tier``        ``upstream.recv`` (the cache tier's store-event
+                      pump: any failure kind breaks the stream — the
+                      tier resumes clients from the relist diff, or
+                      invalidates when the diff overflows the window);
+                      ``pump.stall`` (a fan-out pump lane stalls for
+                      ``delay_s`` — every kind expresses as a bounded
+                      stall, the pump never dies); ``subscriber.send``
+                      (one subscriber's socket: delay kinds wedge it,
+                      failure kinds break it — the tier cancels that
+                      watch and the client relists)
 ``coordinator.bind``  ``cas`` (the bind CAS, native wave and slow path)
 ``coordinator.watch`` ``poll`` (the intake watch drain)
 ``coordinator.cycle`` ``dispatch`` (the device-wave launch; ``stall``
@@ -200,11 +209,48 @@ class FaultPlan:
 
     @classmethod
     def from_arg(cls, arg: str) -> "FaultPlan":
-        """CLI form: inline JSON, or ``@path`` to a JSON file."""
+        """CLI form: a named plan (``NAMED_PLANS``), inline JSON, or
+        ``@path`` to a JSON file."""
+        named = NAMED_PLANS.get(arg)
+        if named is not None:
+            return named()
         if arg.startswith("@"):
             with open(arg[1:]) as f:
                 return cls.from_json(f.read())
         return cls.from_json(arg)
+
+
+def _watchstorm() -> FaultPlan:
+    """The watchplane kill-drill plan (``watch_fanout_ab --fault-plan
+    watchstorm``): upstream stream breaks (each must resolve by
+    diff-replay resume, not a relist storm), fan-out pump-lane stalls,
+    subscriber-socket wedges, and a few outright subscriber breaks —
+    composed, deterministic by seed.  Counter units: ``upstream.recv``
+    fires per received upstream batch (a coarse counter — writes
+    arrive in kilo-event batches, so the break spec draws by
+    probability to fire across drill scales), ``pump.stall`` per
+    pump-lane wake round, ``subscriber.send`` per delivered frame."""
+    return FaultPlan(
+        [
+            FaultSpec("watch.tier", "upstream.recv", kind="disconnect",
+                      after=4, probability=0.25, max_fires=12),
+            FaultSpec("watch.tier", "pump.stall", kind="delay",
+                      delay_s=0.25, after=20, every_n=97, max_fires=40),
+            FaultSpec("watch.tier", "subscriber.send", kind="delay",
+                      delay_s=0.01, after=500, every_n=4001, max_fires=200),
+            FaultSpec("watch.tier", "subscriber.send", kind="disconnect",
+                      after=1000, every_n=25013, max_fires=4),
+        ],
+        seed=1315,
+    )
+
+
+# Named plans accepted anywhere a --fault-plan flag is parsed
+# (FaultPlan.from_arg): drills reference a storm by name instead of
+# every driver copy-pasting the same JSON.
+NAMED_PLANS = {
+    "watchstorm": _watchstorm,
+}
 
 
 class Injector:
@@ -307,6 +353,19 @@ class Injector:
             for spec, n in zip(self.plan.faults, self._fired):
                 out[spec.kind] = out.get(spec.kind, 0) + n
             return out
+
+    def fire_report(self) -> list[dict]:
+        """Per-spec fire counts with their targets — the evidence shape
+        drills need when the same kind hooks several operations (the
+        watchstorm resume-rate gate divides by UPSTREAM breaks only)."""
+        with self._lock:
+            return [
+                {
+                    "component": s.component, "op": s.op, "kind": s.kind,
+                    "fires": n,
+                }
+                for s, n in zip(self.plan.faults, self._fired)
+            ]
 
 
 _NOOP = Injector()

@@ -273,6 +273,14 @@ DEFAULT_POLICIES: dict[str, RetryPolicy] = {
         "watch.tier", max_attempts=1_000_000, base_delay_s=0.05,
         max_delay_s=5.0, deadline_s=float("inf"),
     ),
+    # The tier's RESUME relist (watch_cache.run_upstream once primed):
+    # same retry-forever posture, but a tighter base/cap — a resume
+    # races client-visible delivery lag (the watchstorm p99 gate), not
+    # bootstrap, and the clients are all still attached and waiting.
+    "watch.resume": RetryPolicy(
+        "watch.resume", max_attempts=1_000_000, base_delay_s=0.02,
+        max_delay_s=1.0, deadline_s=float("inf"),
+    ),
     "coordinator.bind": RetryPolicy(
         "coordinator.bind", max_attempts=5, base_delay_s=0.01,
         max_delay_s=0.5, deadline_s=30.0,

@@ -55,6 +55,7 @@ from k8s1m_tpu.lint.rules_mesh import MeshPurity
 from k8s1m_tpu.lint.rules_metrics import MetricsRegistry
 from k8s1m_tpu.lint.rules_retry import RetryThroughPolicy
 from k8s1m_tpu.lint.rules_trace import TraceLazyEmit
+from k8s1m_tpu.lint.rules_watchbuf import BoundedWatchBuffer
 
 ALL_RULES: tuple[type[Rule], ...] = (
     HotPathHostSync,
@@ -71,6 +72,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     UndonatedDeviceUpdate,
     DeltaCacheEpochKeyed,
     TraceLazyEmit,
+    BoundedWatchBuffer,
 )
 
 # The linted slice of the repo (everything else is docs/artifacts).

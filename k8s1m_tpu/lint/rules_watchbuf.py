@@ -1,0 +1,98 @@
+"""bounded-watch-buffer: subscriber queues/rings in store/ carry a bound.
+
+The watch tier's failure economics (ISSUE 15 watchplane) hinge on one
+property: NOTHING between a store write and a client socket buffers
+without limit.  The history window is a bounded ring, every subscriber
+FIFO has a hard cap with coalesce-then-cancel semantics, the per-stream
+output queues bound how far a wedged socket can backpressure, and the
+wire clients cap their client-side buffers.  An unbounded queue added
+anywhere in that chain silently re-opens the storm amplifier: a slow
+consumer turns into unbounded tier memory instead of a counted
+degradation.
+
+This pass pins it statically: in ``k8s1m_tpu/store/``, every
+construction of
+
+- ``collections.deque(...)`` / ``deque(...)`` without a ``maxlen``
+  (second positional or keyword), and
+- ``asyncio.Queue(...)`` / ``queue.Queue(...)`` / bare ``Queue(...)``
+  without a ``maxsize`` (first positional or keyword)
+
+is a finding.  A bound of literal ``0``/``None`` (the stdlib spellings
+of "unbounded") counts as missing.
+
+Escape hatches (base.py): a ``# graftlint: disable=`` pragma carrying
+the reason the buffer is bounded by construction elsewhere (e.g. a
+ready-set whose producers latch, a caller-paced request queue), or a
+baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile
+
+_SCOPED_DIR = "k8s1m_tpu/store/"
+
+_MSG = (
+    "unbounded {what} construction in store/ — subscriber queues and "
+    "event rings must carry an explicit bound ({kw}=), or a pragma "
+    "explaining what bounds them by construction"
+)
+
+
+def _is_unbounded_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value in (0, None)
+
+
+def _call_name(node: ast.Call) -> str | None:
+    """Dotted tail of the constructor: 'deque', 'Queue', etc."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+class BoundedWatchBuffer(Rule):
+    id = "bounded-watch-buffer"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if not f.path.startswith(_SCOPED_DIR):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name == "deque":
+                # deque(iterable, maxlen) — bound is the 2nd positional
+                # or the maxlen kwarg.
+                bound = None
+                if len(node.args) >= 2:
+                    bound = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "maxlen":
+                        bound = kw.value
+                if bound is None or _is_unbounded_literal(bound):
+                    out.append(self.finding(
+                        f, node, _MSG.format(what="deque", kw="maxlen")
+                    ))
+            elif name in ("Queue", "LifoQueue", "PriorityQueue",
+                          "SimpleQueue"):
+                # Queue(maxsize) — 1st positional or the maxsize kwarg
+                # (SimpleQueue cannot be bounded at all).
+                bound = None
+                if name != "SimpleQueue":
+                    if node.args:
+                        bound = node.args[0]
+                    for kw in node.keywords:
+                        if kw.arg == "maxsize":
+                            bound = kw.value
+                if bound is None or _is_unbounded_literal(bound):
+                    out.append(self.finding(
+                        f, node, _MSG.format(what=name, kw="maxsize")
+                    ))
+        return out

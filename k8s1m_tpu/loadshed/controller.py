@@ -277,6 +277,22 @@ class HealthController:
         with self._admit_lock:
             return self.state
 
+    def lag_budget(self, full: int) -> int:
+        """Degradation knob for fan-out tiers (store/watch_cache.py):
+        the per-subscriber FIFO depth a consumer may lag before
+        latest-only coalescing engages.  HEALTHY keeps the configured
+        budget, DEGRADED quarters it, SHEDDING zeroes it (coalesce
+        immediately, tier-wide).  Depth-triggered enforcement means the
+        deepest-backlog — i.e. floodiest — watchers degrade first; this
+        method just sets how hard the controller squeezes."""
+        with self._admit_lock:
+            s = self.state
+        if s == SHEDDING:
+            return 0
+        if s == DEGRADED:
+            return max(1, full // 4)
+        return full
+
     # ---- admission -----------------------------------------------------
 
     def try_admit(

@@ -78,7 +78,11 @@ ROWS = [
     # Fault injection + the one shared RetryPolicy (k8s1m_tpu/faultline).
     ("Resilience (faultline)", ("faultline_", "retry_")),
     ("Store (mem-etcd)", ("memstore_",)),
-    ("Watch cache (apiserver tier)", ("watchcache_",)),
+    # The apiserver-tier fan-out under storm (ISSUE 15 watchplane):
+    # upstream breaks split into diff-replay resumes vs cancel-everyone
+    # invalidations, per-subscriber latest-only coalescing volume, and
+    # the live count of lag-degraded watchers.
+    ("Watch fanout (watchplane)", ("watchcache_",)),
     ("KWOK nodes", ("kwok_", "kubelet_")),
 ]
 

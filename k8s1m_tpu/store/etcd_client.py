@@ -251,8 +251,8 @@ class WatchSession:
                 prev_kv=prev_kv,
             )
         )
-        self._queue: asyncio.Queue = asyncio.Queue()
         self._call = None
+        self._read_task: asyncio.Task | None = None
         self.watch_id = None
         self.compact_revision = 0
 
@@ -283,6 +283,9 @@ class WatchSession:
             # the authoritative cleanup.
             except Exception:  # graftlint: disable=broad-except
                 pass
+            if self._read_task is not None:
+                self._read_task.cancel()
+                self._read_task = None
             self._call.cancel()
             self._call = None
 
@@ -297,7 +300,20 @@ class WatchSession:
         )
 
     async def next(self, timeout: float | None = None) -> WatchBatch:
-        resp = await asyncio.wait_for(self._live_call().read(), timeout)
+        # A timed-out wait must not cancel the underlying stream read:
+        # grpc.aio cancels the WHOLE call when its read future is
+        # cancelled, so wait_for's timeout used to kill the session the
+        # first time a quiet watch hit it.  Park the read on a task,
+        # shield it, and resume the SAME read on the next call — a read
+        # that completed between calls still hands over its batch (the
+        # await below returns a done task's buffered result instantly).
+        if self._read_task is None:
+            call = self._live_call()
+            self._read_task = asyncio.ensure_future(call.read())
+        resp = await asyncio.wait_for(
+            asyncio.shield(self._read_task), timeout
+        )
+        self._read_task = None
         return WatchBatch(
             events=list(resp.events),
             revision=resp.header.revision,

@@ -404,7 +404,11 @@ class EtcdService:
         watchers: dict[int, Watcher] = {}
         pumps: dict[int, asyncio.Task] = {}
         next_id = 1
-        out: asyncio.Queue = asyncio.Queue()
+        # Bounded reply queue (bounded-watch-buffer): a wedged client
+        # socket backpressures this stream's pumps at the bound — their
+        # native Watcher queues are themselves capped and cancel on
+        # overflow — instead of buffering responses without limit.
+        out: asyncio.Queue = asyncio.Queue(maxsize=1024)
         last_delivered = 0
         # Per-watch "delivered through" revision: every event <= cleared[wid]
         # matching the watch has been written to the stream.  Advances on

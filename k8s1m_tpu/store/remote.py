@@ -43,11 +43,18 @@ from k8s1m_tpu.store.native import (
     pack_bind_frame,
     pack_put_frame,
 )
+from k8s1m_tpu.obs.metrics import Counter
 from k8s1m_tpu.store.proto import batch_pb2, mvcc_pb2, rpc_pb2
 
 log = logging.getLogger("k8s1m.remote_store")
 
 _M = "etcdserverpb"
+
+_CLIENT_COALESCED = Counter(
+    "watchcache_client_coalesced_total",
+    "wire-watch events elided client-side by the opt-in latest-only "
+    "overflow coalescing (RemoteWatcher coalesce=True)", ()
+)
 
 
 def _check_unary(op: str, expressible: tuple = ()):
@@ -81,18 +88,34 @@ class RemoteWatcher:
 
     A dedicated reader thread drains the stream into a locked deque;
     ``poll`` is non-blocking like the native watcher's.
+
+    ``coalesce=True`` opts into bounded-lag degradation at the client
+    (the watchplane contract, mirroring the tier's per-subscriber
+    coalescing): events past the FIFO cap fold latest-only-per-key
+    into a bounded map instead of being dropped-and-resynced, legal for
+    level-triggered consumers; only a map past ``queue_cap`` distinct
+    keys starts dropping (``dropped`` goes positive, the owner
+    relists).  Default off: the coordinator's drains keep the
+    historical overflow->resync contract.
     """
 
     def __init__(
         self, store: "RemoteStore", key, end, start_revision, prev_kv,
-        queue_cap: int = 0,
+        queue_cap: int = 0, coalesce: bool = False,
     ):
-        self._events: collections.deque = collections.deque()
-        self._lock = threading.Lock()
         # Client-side cap mirroring the native watcher's bounded queue: a
         # consumer that stops draining sees dropped>0 and resyncs, instead
         # of the backlog growing without bound.
         self._queue_cap = queue_cap if queue_cap > 0 else 10_000
+        # maxlen is the explicit backstop; the manual cap below is the
+        # working limit (overflow must COUNT, never silently evict).
+        self._events: collections.deque = collections.deque(
+            maxlen=self._queue_cap
+        )
+        self._lock = threading.Lock()
+        self._coalesce = coalesce
+        # key -> (etype, kv, prev): latest-only overflow regime.
+        self._coalesced: dict[bytes, tuple] = {}
         self._dropped = 0
         self.canceled = False
         # Follow-mode bookkeeping (ISSUE 9): highest mod_revision this
@@ -104,8 +127,9 @@ class RemoteWatcher:
         # The request side must stay open for the watch's lifetime — a
         # finite iterator half-closes the stream and the server cancels
         # the watch.  Requests flow through a queue; cancel() enqueues a
-        # sentinel to end it.
-        self._requests: queue.Queue = queue.Queue()
+        # sentinel to end it.  Caller-paced (one create + one sentinel),
+        # not a subscriber event buffer.
+        self._requests: queue.Queue = queue.Queue()  # graftlint: disable=bounded-watch-buffer (request side: caller-paced create/cancel only)
         self._requests.put(
             rpc_pb2.WatchRequest(
                 create_request=rpc_pb2.WatchCreateRequest(
@@ -171,8 +195,28 @@ class RemoteWatcher:
                     for ev in resp.events:
                         if ev.kv.mod_revision > self.seen_revision:
                             self.seen_revision = ev.kv.mod_revision
-                        if len(self._events) >= self._queue_cap:
-                            self._dropped += 1
+                        if (
+                            len(self._events) >= self._queue_cap
+                            or self._coalesced
+                        ):
+                            if not self._coalesce:
+                                self._dropped += 1
+                                continue
+                            # Bounded-lag regime: latest-only per key
+                            # (sticky until drained, so emission stays
+                            # revision-ordered); past the key cap the
+                            # honest drop-and-resync contract resumes.
+                            key = ev.kv.key
+                            if key in self._coalesced:
+                                _CLIENT_COALESCED.inc()
+                            elif len(self._coalesced) >= self._queue_cap:
+                                self._dropped += 1
+                                continue
+                            self._coalesced[key] = (
+                                1 if ev.type == mvcc_pb2.Event.DELETE else 0,
+                                ev.kv,
+                                ev.prev_kv if ev.HasField("prev_kv") else None,
+                            )
                             continue
                         # Raw protobuf refs only; WatchEvent/KeyValue
                         # wrappers are built lazily in poll() so the
@@ -212,6 +256,18 @@ class RemoteWatcher:
         with self._lock:
             while self._events and len(out) < max_events:
                 out.append(self._events.popleft())
+            if not self._events and self._coalesced and len(out) < max_events:
+                # One batched merge of the coalesced frame, revision-
+                # ordered behind the FIFO (everything in the map
+                # postdates everything that was queued).
+                rest = sorted(
+                    self._coalesced.values(),
+                    key=lambda t: t[1].mod_revision,
+                )
+                take = rest[: max_events - len(out)]
+                for t in take:
+                    del self._coalesced[t[1].key]
+                out.extend(take)
         return out
 
     def poll(self, max_events: int = 1000, timeout_ms: int = 0) -> list[WatchEvent]:
@@ -245,7 +301,7 @@ class RemoteWatcher:
     @property
     def pending(self) -> int:
         with self._lock:
-            return len(self._events)
+            return len(self._events) + len(self._coalesced)
 
     @property
     def dropped(self) -> int:
@@ -531,14 +587,17 @@ class RemoteStore:
         start_revision: int = 0,
         prev_kv: bool = False,
         queue_cap: int = 0,
+        coalesce: bool = False,
     ) -> RemoteWatcher:
         """``queue_cap`` bounds the CLIENT-side buffer (default 10K like
         the native watcher): the server drains continuously into the
         stream, so overflow protection has to live where the backlog
         accumulates.  On overflow ``dropped`` goes positive and the owner
-        resyncs, the same contract as a native-watcher overflow."""
+        resyncs, the same contract as a native-watcher overflow —
+        unless ``coalesce=True``, which degrades to latest-only-per-key
+        first (see RemoteWatcher; for level-triggered consumers)."""
         return RemoteWatcher(
-            self, start, end, start_revision, prev_kv, queue_cap
+            self, start, end, start_revision, prev_kv, queue_cap, coalesce
         )
 
     # ---- maintenance ---------------------------------------------------

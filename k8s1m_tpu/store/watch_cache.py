@@ -24,9 +24,43 @@ Downstream watch semantics mirror the store server
 (k8s1m_tpu/store/etcd_server.py): created:true response, past-events
 replay from the bounded history window, live batches, ProgressRequest,
 CancelRequest; a start revision older than the window yields a cancel
-response with ``compact_revision`` set, and a slow consumer that
-overflows its queue is canceled so it relists — the same contract as a
-store-watcher overflow.
+response with ``compact_revision`` set.
+
+Storm-proofing (ISSUE 15 watchplane) — the tier degrades instead of
+detonating:
+
+- **Resume-from-revision.**  An upstream watch break no longer cancels
+  every client for a full relist: ``reprime`` diffs the relisted
+  snapshot against the cached objects and replays the NET difference
+  (latest value per changed key, deletes stamped at the relist
+  revision) through the ordinary fan-out, so clients keep their watches
+  across the outage (``watchcache_resumes_total``).  Only when the net
+  diff cannot fit the bounded history window does the tier fall back to
+  the old cancel-everyone hammer (``watchcache_invalidations_total``).
+  Net replay is legal because the tier's consumers are level-triggered
+  caches (see MIGRATION "Watch resume & degradation contract").
+
+- **Bounded-lag degradation.**  A slow consumer is coalesced before it
+  is canceled: each subscriber buffers FIFO up to the tier's effective
+  lag budget, then folds further events latest-only-per-key
+  (``watchcache_coalesced_events_total``) — the deepest-backlog (i.e.
+  floodiest) watchers degrade first, and the loadshed HealthController
+  shrinks the budget tier-wide as total backlog climbs
+  (HEALTHY -> full, DEGRADED -> quarter, SHEDDING -> coalesce
+  immediately).  Only a subscriber whose coalesce map ALSO overflows
+  its hard cap is canceled so it relists.
+
+- **Sharded fan-out pumps.**  Dispatch splits across N pump lanes
+  (watcher id hash) with a bounded per-stream output queue, so one
+  wedged subscriber socket backpressures its own lane instead of
+  head-of-line-blocking every watcher, and a 100K-watch stream costs N
+  tasks, not 100K.
+
+- **Faultline hooks** ``watch.tier/pump.stall`` and
+  ``watch.tier/subscriber.send`` (plus the existing ``upstream.recv``)
+  make all three failure modes injectable by seed — the watchstorm
+  drill (tools/watch_fanout_ab.py) gates delivery-lag p99, zero event
+  loss by ledger, and the resume rate under the composed storm.
 """
 
 from __future__ import annotations
@@ -46,6 +80,7 @@ from grpc import aio
 from k8s1m_tpu import faultline
 from k8s1m_tpu.faultline import InjectedFault, policy_for
 from k8s1m_tpu.lint import THREAD_OWNER, guarded_by
+from k8s1m_tpu.loadshed import HealthController, LoadshedConfig, Signals
 from k8s1m_tpu.obs.metrics import Counter, Gauge
 from k8s1m_tpu.store.etcd_client import EtcdClient
 from k8s1m_tpu.store.native import prefix_end
@@ -72,6 +107,21 @@ _REPLAYS = Counter(
     "and the client was told to relist",
     ("outcome",),
 )
+_RESUMES = Counter(
+    "watchcache_resumes_total",
+    "upstream watch breaks absorbed by diff-replay resume: clients "
+    "kept their watches, the net snapshot difference was replayed "
+    "(the split's other half is watchcache_invalidations_total)", ()
+)
+_COALESCED = Counter(
+    "watchcache_coalesced_events_total",
+    "events elided by per-subscriber latest-only-per-key coalescing "
+    "under the bounded-lag budget", ()
+)
+_DEGRADED_WATCHERS = Gauge(
+    "watchcache_degraded_watchers",
+    "client watches currently in coalescing (bounded-lag) delivery", ()
+)
 
 _DEFAULT_WINDOW = 65536
 
@@ -80,6 +130,18 @@ _DEFAULT_WINDOW = 65536
 _PRIME_PAGE = 10_000
 _QUEUE_CAP = 10_000
 _WATCH_BATCH = 1000
+# Per-subscriber FIFO budget before latest-only coalescing engages
+# (the loadshed controller shrinks it tier-wide under backlog).
+_LAG_BUDGET = 4096
+# Per-stream output queue bound: a wedged subscriber socket
+# backpressures its own stream's pump lanes at this depth instead of
+# buffering responses without bound.
+_OUT_CAP = 1024
+# Fan-out pump lanes per Watch stream (watcher id hash).
+_PUMP_SHARDS = 8
+# Bounded stall applied at the pump.stall hook when the firing spec
+# carries no delay of its own.
+_STALL_S = 0.05
 
 
 @dataclasses.dataclass
@@ -101,17 +163,46 @@ class CacheEvent:
 
 
 class Downstream:
-    """One client watch served from the cache."""
+    """One client watch served from the cache.
+
+    Delivery runs in two regimes: a bounded FIFO queue up to the tier's
+    effective lag budget, then latest-only-per-key coalescing — legal
+    for the level-triggered caches this tier serves (the net state at
+    quiesce is identical to the uncoalesced stream; the differential
+    gate in tests/test_watch_cache.py holds it).  Once coalescing
+    engages it sticks until the subscriber fully drains, so emission
+    stays revision-ordered (everything in the map postdates everything
+    in the queue).  Only a coalesce map overflowing ``hard_cap``
+    distinct keys cancels the watch (the client relists) — the old
+    cancel-at-queue-cap hammer demoted to the last resort.
+    """
 
     def __init__(self, wid: int, key: bytes, end: bytes | None,
-                 min_rev: int = 0):
+                 min_rev: int = 0, hard_cap: int = _QUEUE_CAP):
         self.id = wid
         self.key = key
         self.end = end          # None = single key; b"\0" = to infinity
         self.min_rev = min_rev  # suppress live events below this revision
-        self.queue: collections.deque[CacheEvent] = collections.deque()
+        self.service_id = wid   # stream-side watch id (service assigns)
+        self.hard_cap = hard_cap
+        # Explicit bound (bounded-watch-buffer): coalescing engages at
+        # the (smaller) effective lag budget, so maxlen is a never-hit
+        # backstop, not the working limit.
+        self.queue: collections.deque[CacheEvent] = collections.deque(
+            maxlen=hard_cap
+        )
+        self.coalesced: dict[bytes, CacheEvent] = {}
+        self.coalescing = False
+        # Newest mod_revision handed to this watch — the delivery
+        # high-water mark the byte-identity differential asserts
+        # against (tests/test_watch_cache.py); not read on any
+        # production path.
+        self.last_pushed = 0
         self.wakeup = asyncio.Event()
         self.overflowed = False
+        self.owner: "WatchCache | None" = None   # set by register()
+        self.on_ready = None    # pump-shard callback (service side)
+        self._ready = False     # latched onto a shard's ready set
 
     def matches(self, key: bytes) -> bool:
         if self.end is None:
@@ -122,14 +213,66 @@ class Downstream:
             return True
         return key < self.end
 
-    def push(self, ev: CacheEvent) -> None:
-        if len(self.queue) >= _QUEUE_CAP:
-            # Slow consumer: cancel rather than gap silently (store
-            # watcher overflow contract — the client relists).
-            self.overflowed = True
+    def push(self, ev: CacheEvent, lag_budget: int | None = None) -> None:
+        budget = self.hard_cap if lag_budget is None else lag_budget
+        if self.coalescing or len(self.queue) >= budget:
+            if not self.coalescing:
+                self.coalescing = True
+                _DEGRADED_WATCHERS.inc()
+            if ev.key in self.coalesced:
+                # Latest-only elision: the superseded event is the one
+                # a level-triggered consumer never needed.
+                _COALESCED.inc()
+                self.coalesced[ev.key] = ev
+            elif len(self.coalesced) >= self.hard_cap:
+                # Even latest-per-key cannot keep up (more distinct
+                # keys lagging than the hard cap): cancel rather than
+                # gap silently — the client relists.
+                self.overflowed = True
+            else:
+                self.coalesced[ev.key] = ev
+                if self.owner is not None:
+                    self.owner._backlog += 1
         else:
             self.queue.append(ev)
+            if self.owner is not None:
+                self.owner._backlog += 1
+        if ev.mod_revision > self.last_pushed:
+            self.last_pushed = ev.mod_revision
+        self._notify()
+
+    def pop_batch(self, n: int) -> list[CacheEvent]:
+        """Drain up to ``n`` events in revision order: the FIFO first,
+        then the coalesce map (all of whose events postdate the
+        queue's, since coalescing sticks until fully drained)."""
+        out: list[CacheEvent] = []
+        q = self.queue
+        while q and len(out) < n:
+            out.append(q.popleft())
+        if not q and self.coalesced and len(out) < n:
+            rest = sorted(
+                self.coalesced.values(), key=lambda e: e.mod_revision
+            )
+            take = rest[: n - len(out)]
+            for e in take:
+                del self.coalesced[e.key]
+            out.extend(take)
+            if not self.coalesced:
+                self.coalescing = False
+                _DEGRADED_WATCHERS.dec()
+        if self.owner is not None:
+            self.owner._backlog -= len(out)
+        return out
+
+    @property
+    def backlog(self) -> int:
+        return len(self.queue) + len(self.coalesced)
+
+    def _notify(self) -> None:
         self.wakeup.set()
+        cb = self.on_ready
+        if cb is not None:
+            cb(self)
 
 
 @guarded_by(
@@ -143,11 +286,17 @@ class Downstream:
     history=THREAD_OWNER,
     _exact=THREAD_OWNER,
     _ranges=THREAD_OWNER,
+    _backlog=THREAD_OWNER,
+    _lag_now=THREAD_OWNER,
 )
 class WatchCache:
     """Cached objects + bounded event history + downstream fan-out."""
 
-    def __init__(self, index: str = "hash", window: int = _DEFAULT_WINDOW):
+    def __init__(
+        self, index: str = "hash", window: int = _DEFAULT_WINDOW,
+        lag_budget: int = _LAG_BUDGET,
+        shed: HealthController | None = None,
+    ):
         if index not in ("hash", "btree"):
             raise ValueError(f"index must be hash|btree, got {index!r}")
         self.index = index
@@ -170,6 +319,34 @@ class WatchCache:
         self._exact: dict[bytes, set[Downstream]] = {}
         self._ranges: set[Downstream] = set()
         self._next_id = 1
+        # Bounded-lag degradation: the health controller watches total
+        # fan-out backlog (every subscriber's queued + coalesced
+        # events, maintained incrementally) and derives the effective
+        # per-subscriber FIFO budget — degradation is controller-driven
+        # and depth-triggered, so under a lease flood the floodiest
+        # (deepest-backlog) watchers degrade first.
+        self.lag_budget = lag_budget
+        self._shed = shed or HealthController(
+            LoadshedConfig(
+                queue_degraded=16 * lag_budget,
+                queue_shed=64 * lag_budget,
+                queue_cap=1 << 30,
+                queue_recover=4 * lag_budget,
+                recover_cycles=2,
+            ),
+            name="watch.tier",
+        )
+        self._backlog = 0
+        self._lag_now = lag_budget
+
+    def loadshed_tick(self) -> None:
+        """Feed the current fan-out backlog to the tier's health
+        controller and refresh the effective lag budget.  Ticked by the
+        upstream pump once per applied batch: decisions only matter
+        while events are flowing, so a quiet prefix's stale budget is
+        harmless until traffic (and with it ticking) resumes."""
+        self._shed.tick(Signals(queue_depth=self._backlog))
+        self._lag_now = self._shed.lag_budget(self.lag_budget)
 
     # ---- window bounds -------------------------------------------------
 
@@ -195,12 +372,20 @@ class WatchCache:
         self.last_revision = max(self.last_revision, revision)
         self.prime_revision = max(self.prime_revision, revision)
 
-    def invalidate(self) -> None:
-        """Upstream watch broke: events were lost and a latest-only cache
-        cannot reconstruct them (deletes during the outage would linger,
-        and the history window would silently gap).  Cancel every client
-        watch so each one relists — the same contract as a store-watcher
-        overflow — and reset state for re-priming."""
+    def invalidate(self, key: bytes = b"", end: bytes = b"\x00") -> None:
+        """The cancel-everyone hammer, now the FALLBACK: an upstream
+        outage whose net effect cannot be represented in the bounded
+        history window (see ``reprime``) cancels every client watch so
+        each one relists — the same contract as a store-watcher
+        overflow — and resets state for re-priming.
+
+        ``[key, end)`` scopes the OBJECT clearing to the broken
+        stream's prefix: a healthy prefix's objects stay, so its
+        cache-served Ranges don't turn confidently empty while only
+        another prefix's stream is down.  Watcher cancellation and the
+        history window stay global — the ring is shared, and with it
+        cleared every replay window resets, so a kept prefix's
+        relisting clients converge through compact-cancel + relist."""
         n = sum(len(p) for p in self._exact.values()) + len(self._ranges)
         log.warning(
             "cache invalidated at revision %d: canceling %d client "
@@ -210,18 +395,132 @@ class WatchCache:
         for peers in self._exact.values():
             for w in peers:
                 w.overflowed = True
-                w.wakeup.set()
+                w._notify()
         for w in self._ranges:
             w.overflowed = True
-            w.wakeup.set()
-        self.objects.clear()
-        self.sorted_keys = []
+            w._notify()
+        if not key and end == b"\x00":
+            self.objects.clear()
+            self.sorted_keys = []
+        else:
+            for k in [
+                k for k in self.objects
+                if k >= key and (end == b"\x00" or k < end)
+            ]:
+                del self.objects[k]
+            if self.index == "btree":
+                self.sorted_keys = sorted(self.objects)
         self.history.clear()
 
+    def reprime(
+        self, kvs, revision: int,
+        key: bytes = b"", end: bytes = b"\x00",
+    ) -> bool:
+        """Resume path after an upstream break: diff the relisted
+        snapshot (``kvs`` at ``revision``) against the cached objects
+        and replay the NET difference — latest value per changed key,
+        one DELETE per vanished key — through the ordinary fan-out, so
+        every client watch survives the outage in place.
+
+        ``[key, end)`` scopes the deletion sweep to the prefix the
+        broken stream actually covered: the object map is the UNION of
+        every watched prefix, and an unscoped diff would read every
+        other prefix's keys as deleted (found by the storm drill's
+        idle-watch population, which must deliver nothing, ever).
+
+        Deletes lost with the stream have no knowable revision; they
+        are stamped at the relist revision (an upper bound).  Keys
+        created AND deleted inside the outage are invisible in both
+        snapshots and produce nothing.  Both are exactly the latest-
+        only elisions coalescing already performs, and legal for the
+        same reason: the tier's consumers are level-triggered caches,
+        and the net state at quiesce is byte-identical to a full
+        relist (the tests/test_watch_cache.py differential).
+
+        Every replayed event goes out ON THE WIRE stamped at the
+        relist revision (>= the tier's global header revision by store
+        monotonicity): a client whose last-seen revision came from a
+        header on ANOTHER prefix's progress re-attaches with a
+        start_revision a back-dated event would never clear (review
+        catch — the delete-stamping rationale applies to the puts
+        too).  The object map keeps the true MVCC revisions so the
+        NEXT reprime's diff still compares real facts.
+
+        Returns True when clients were resumed; False when the net
+        diff exceeds the bounded history window — appending it would
+        evict genuine history under replaying followers' feet — and
+        the tier fell back to ``invalidate()`` (counted there)."""
+        changed: list[CacheEvent] = []
+        new_keys = set()
+        for kv in kvs:
+            new_keys.add(kv.key)
+            old = self.objects.get(kv.key)
+            if old is not None and kv.mod_revision < old.mod_revision:
+                # Per-key revision ROLLBACK: the store restarted having
+                # lost its tail (buffered-WAL crash).  Forward
+                # net-replay cannot represent history moving backwards
+                # — fail closed to the hammer, the old contract.
+                self.invalidate(key, end)
+                return False
+            if old is None or old.mod_revision != kv.mod_revision:
+                changed.append(CacheEvent(
+                    0, kv.key, kv.value, kv.create_revision,
+                    kv.mod_revision, kv.version,
+                ))
+        deleted = []
+        local_max = 0       # this PREFIX's cached high-water revision
+        for k, o in self.objects.items():
+            if k < key or not (end == b"\x00" or k < end):
+                continue
+            if o.mod_revision > local_max:
+                local_max = o.mod_revision
+            if k not in new_keys:
+                deleted.append(k)
+        if revision < local_max:
+            # The whole-prefix rollback form of the same story: the
+            # relist pins a revision BELOW state this prefix already
+            # held.  Judged against the PREFIX-LOCAL high-water mark,
+            # not the cache's global last_revision — on a multi-prefix
+            # tier a healthy prefix's live events advance the global
+            # mark past a broken prefix's relist pin as a matter of
+            # course, and that must not read as a rollback.
+            self.invalidate(key, end)
+            return False
+        if len(changed) + len(deleted) > (self.history.maxlen or 0):
+            self.invalidate(key, end)
+            return False
+        for k in deleted:
+            changed.append(CacheEvent(1, k, b"", 0, revision, 0))
+        changed.sort(key=lambda e: e.mod_revision)
+        for e in changed:
+            self.apply(
+                e.type, e.key, e.value, e.create_revision,
+                e.mod_revision, e.version, wire_revision=revision,
+            )
+        self.last_revision = max(self.last_revision, revision)
+        _RESUMES.inc()
+        self.loadshed_tick()
+        log.info(
+            "upstream resumed at revision %d: %d net event(s) replayed "
+            "to %d client watch(es), no relists",
+            revision, len(changed), self.watcher_count,
+        )
+        return True
+
     def apply(self, ev_type: int, key: bytes, value: bytes,
-              create_revision: int, mod_revision: int, version: int) -> None:
+              create_revision: int, mod_revision: int, version: int,
+              wire_revision: int | None = None) -> None:
         """Apply one upstream store event: update the cached object map
-        (hash or btree storage), append to the history window, fan out."""
+        (hash or btree storage), append to the history window, fan out.
+
+        ``wire_revision`` (reprime's resume replay) splits the two
+        roles a revision plays: the OBJECT MAP keeps the true MVCC
+        ``mod_revision`` — the next reprime's diff compares against it
+        — while the history window and the fanned-out event carry the
+        stamped wire revision, so the resumed stream stays monotonic
+        for clients whose last-seen revision is the tier's GLOBAL
+        header revision (a back-dated event would be filtered by their
+        re-attach ``start_revision`` and lost forever)."""
         if ev_type == 0:
             existed = key in self.objects
             self.objects[key] = CachedObject(
@@ -239,21 +538,23 @@ class WatchCache:
                 i = bisect.bisect_left(self.sorted_keys, key)
                 if i < len(self.sorted_keys) and self.sorted_keys[i] == key:
                     del self.sorted_keys[i]
+        wr = mod_revision if wire_revision is None else wire_revision
         ev = CacheEvent(
-            ev_type, key, value, create_revision, mod_revision, version
+            ev_type, key, value, create_revision, wr, version
         )
         self.history.append(ev)
-        self.last_revision = max(self.last_revision, mod_revision)
+        self.last_revision = max(self.last_revision, wr)
         self.events_in += 1
         _EVENTS_IN.inc()
         delivered = 0
+        lag = self._lag_now
         for w in self._exact.get(key, ()):
-            if mod_revision >= w.min_rev:
-                w.push(ev)
+            if wr >= w.min_rev:
+                w.push(ev, lag)
                 delivered += 1
         for w in self._ranges:
-            if mod_revision >= w.min_rev and w.matches(key):
-                w.push(ev)
+            if wr >= w.min_rev and w.matches(key):
+                w.push(ev, lag)
                 delivered += 1
         self.events_out += delivered
         if delivered:
@@ -264,8 +565,17 @@ class WatchCache:
     def register(
         self, key: bytes, end: bytes | None, min_rev: int = 0
     ) -> Downstream:
-        w = Downstream(self._next_id, key, end, min_rev)
+        # hard_cap >= lag_budget keeps the deque's maxlen a never-hit
+        # backstop: an operator budget past _QUEUE_CAP must raise the
+        # cancel threshold with it, or push() would stop engaging
+        # coalescing and maxlen would silently evict the oldest event.
+        w = Downstream(
+            self._next_id, key, end, min_rev,
+            hard_cap=max(_QUEUE_CAP, self.lag_budget),
+        )
         self._next_id += 1
+        w.owner = self
+        w.last_pushed = min_rev - 1 if min_rev > 0 else self.last_revision
         if end is None:
             self._exact.setdefault(key, set()).add(w)
         else:
@@ -282,6 +592,13 @@ class WatchCache:
                     del self._exact[w.key]
         else:
             self._ranges.discard(w)
+        if w.owner is self:
+            # Undelivered backlog leaves the tier with the watcher.
+            self._backlog -= w.backlog
+            w.owner = None
+        if w.coalescing:
+            w.coalescing = False
+            _DEGRADED_WATCHERS.dec()
         _WATCHERS.dec()
 
     @property
@@ -299,9 +616,10 @@ class WatchCache:
             _REPLAYS.inc(outcome="compact_relist")
             return self.replayable_from
         _REPLAYS.inc(outcome="resumed")
+        lag = self._lag_now
         for ev in self.history:
             if ev.mod_revision >= start_revision and w.matches(ev.key):
-                w.push(ev)
+                w.push(ev, lag)
         return None
 
     # ---- cache-served Range --------------------------------------------
@@ -340,6 +658,8 @@ class WatchCache:
             "events_delivered": self.events_out,
             "last_revision": self.last_revision,
             "window": len(self.history),
+            "backlog": self._backlog,
+            "lag_budget_now": self._lag_now,
         }
 
 
@@ -359,24 +679,30 @@ async def run_upstream(
     consistent-read gate (event-less batches on a revision-ordered
     stream are progress notifications).
 
-    Relist pacing comes from the shared ``watch.tier`` RetryPolicy
-    (capped exponential backoff + jitter, effectively retrying forever —
-    the tier's job is to outlive store outages), reset after every
-    successful prime.  The event pump is a faultline hook (component
-    ``watch.tier``, op ``upstream.recv``): an injected failure breaks
-    the stream exactly like a real one — invalidate + relist — so cache
-    consistency under upstream loss is reproducible by seed."""
+    Relist pacing comes from the shared RetryPolicies (capped
+    exponential backoff + jitter, effectively retrying forever — the
+    tier's job is to outlive store outages), reset after every
+    successful prime: ``watch.tier`` for the cold prime, the snappier
+    ``watch.resume`` once primed (a resume relist races client-visible
+    delivery lag, not bootstrap).  The event pump is a faultline hook
+    (component ``watch.tier``, op ``upstream.recv``): an injected
+    failure breaks the stream exactly like a real one — resume or
+    invalidate + relist — so cache consistency under upstream loss is
+    reproducible by seed.
+
+    An upstream break does NOT cancel the clients up front: the cache
+    keeps serving (the consistent-read progress gate fails while the
+    stream is down, so rev=0 reads fall through to the store) while the
+    relist runs, and ``reprime`` then replays the net difference to the
+    live watches (``invalidate`` only when the diff overflows the
+    window)."""
     end = prefix_end(prefix)
     policy = policy_for("watch.tier")
+    resume_policy = policy_for("watch.resume")
     failures = 0
     primed_once = False
     while True:
         try:
-            if primed_once:
-                # Events were lost between the broken stream and this
-                # relist; cancel every client watch (they relist) and
-                # rebuild.
-                cache.invalidate()
             # Paginated prime at a pinned revision: one unpaginated list
             # of a six-figure prefix is a single multi-MB response (the
             # 100K-watch scale run measured 6.3MB — over default client
@@ -391,7 +717,19 @@ async def run_upstream(
                     limit=_PRIME_PAGE, revision=rev,
                 )
                 kvs.extend(page.kvs)
-            cache.prime(kvs, rev)
+            if primed_once:
+                # Events were lost between the broken stream and this
+                # relist; resume the clients from the snapshot diff
+                # (reprime falls back to invalidate when it can't),
+                # scoped to THIS stream's prefix.
+                if not cache.reprime(kvs, rev, prefix, end):
+                    # Fallback invalidated (clients canceled, this
+                    # prefix's objects dropped); the relist in hand IS
+                    # the fresh snapshot — load it, or the tier would
+                    # serve an empty prefix until the next event.
+                    cache.prime(kvs, rev)
+            else:
+                cache.prime(kvs, rev)
             primed_once = True
             failures = 0
             if primed is not None:
@@ -435,7 +773,9 @@ async def run_upstream(
                                 ev.kv.mod_revision,
                                 ev.kv.version,
                             )
-                        if not batch.events and handle is not None:
+                        if batch.events:
+                            cache.loadshed_tick()
+                        elif handle is not None:
                             handle.note_progress()
                 finally:
                     if handle is not None:
@@ -444,7 +784,9 @@ async def run_upstream(
             raise
         except Exception as e:
             failures += 1
-            delay = policy.delay_for(failures)
+            delay = (resume_policy if primed_once else policy).delay_for(
+                failures
+            )
             log.warning(
                 "upstream watch for %r broke (%s); relisting in %.2fs",
                 prefix, e, delay, exc_info=True,
@@ -561,16 +903,65 @@ class UpstreamHandle:
             return False
 
 
+def encode_event_batch(header, watch_id: int, events) -> rpc_pb2.WatchResponse:
+    """Batched proto encoding of one event frame: the whole response —
+    header, watch id, and every (possibly coalesced) event — is built
+    in one constructor call instead of a per-event ``events.add()`` +
+    per-field assignment loop, which is measurably cheaper per frame at
+    fan-out rates (shared by the tier's pump lanes and tests)."""
+    return rpc_pb2.WatchResponse(
+        header=header,
+        watch_id=watch_id,
+        events=[
+            mvcc_pb2.Event(
+                type=mvcc_pb2.Event.DELETE if e.type else mvcc_pb2.Event.PUT,
+                kv=mvcc_pb2.KeyValue(
+                    key=e.key,
+                    value=e.value,
+                    create_revision=e.create_revision,
+                    mod_revision=e.mod_revision,
+                    version=e.version,
+                ),
+            )
+            for e in events
+        ],
+    )
+
+
+class _PumpShard:
+    """One fan-out pump lane of a Watch stream: watchers hash onto a
+    lane by id, and each lane services its ready-set sequentially.  The
+    lane count bounds the task cost of a 100K-watch stream (N tasks,
+    not 100K), and the bounded output queue means a wedged subscriber
+    socket backpressures its own lane instead of head-of-line-blocking
+    the whole tier."""
+
+    def __init__(self) -> None:
+        # Bounded by construction: each watcher latches onto the ready
+        # set at most once (the _ready flag), so depth <= the lane's
+        # member count.
+        self.ready: collections.deque[Downstream] = collections.deque()  # graftlint: disable=bounded-watch-buffer (ready-set: the _ready latch admits each watcher at most once)
+        self.event = asyncio.Event()
+
+    def mark_ready(self, w: Downstream) -> None:
+        if not w._ready:
+            w._ready = True
+            self.ready.append(w)
+        self.event.set()
+
+
 class WatchCacheService:
     """etcd wire services served from the cache tier."""
 
     def __init__(
         self, cache: WatchCache, upstream: EtcdClient,
         handles: list[UpstreamHandle] | None = None,
+        n_pumps: int = _PUMP_SHARDS,
     ):
         self.cache = cache
         self.upstream = upstream
         self.handles = handles or []
+        self.n_pumps = max(1, n_pumps)
 
     async def _confirm_progress(
         self, key: bytes, end: bytes, timeout: float = 5.0
@@ -658,63 +1049,93 @@ class WatchCacheService:
     async def Watch(self, request_iterator, ctx):
         cache = self.cache
         watchers: dict[int, Downstream] = {}
-        out: asyncio.Queue = asyncio.Queue()
+        # Bounded output queue: the backpressure point for a wedged
+        # subscriber socket (see _PumpShard).
+        out: asyncio.Queue = asyncio.Queue(maxsize=_OUT_CAP)
         next_id = 1
         # Delivered-through revisions + barrier tasks: progress responses
         # are ordered after prior events, same contract as the store
         # server (see etcd_server.py Watch).
         cleared: dict[int, int] = {}
         barriers: set = set()
+        shards = [_PumpShard() for _ in range(self.n_pumps)]
 
-        async def pump(wid: int, w: Downstream):
+        async def cancel_watch(w: Downstream, reason: str) -> None:
+            wid = w.service_id
+            if watchers.get(wid) is not w:
+                return      # a cancel_request already unregistered it
+            cache.unregister(w)
+            watchers.pop(wid, None)
+            await out.put(
+                rpc_pb2.WatchResponse(
+                    header=self._header(),
+                    watch_id=wid,
+                    canceled=True,
+                    cancel_reason=reason,
+                )
+            )
+
+        async def drain_one(w: Downstream) -> None:
+            wid = w.service_id
+            r0 = cache.last_revision
+            while w.queue or w.coalesced:
+                evs = w.pop_batch(_WATCH_BATCH)
+                # Subscriber-wedge fault hook: delay kinds stall this
+                # one socket's delivery; any failure kind means the
+                # subscriber's socket is gone — cancel it (the client
+                # relists, which covers the popped batch) rather than
+                # let one wedged socket hold the lane.
+                d = faultline.decide("watch.tier", "subscriber.send")
+                if d is not None:
+                    if d.kind in ("delay", "slow_cycle"):
+                        await asyncio.sleep(d.delay_s)
+                    else:
+                        w.overflowed = True
+                        break
+                await out.put(encode_event_batch(self._header(), wid, evs))
+                last = evs[-1].mod_revision
+                if cleared.get(wid, 0) < last:
+                    cleared[wid] = last
+                r0 = cache.last_revision
+            if w.overflowed:
+                await cancel_watch(
+                    w, "watcher overflowed; events dropped"
+                )
+                return
+            # Queue observed empty at r0 (snapshot taken before the
+            # check, no await between): delivered through r0.
+            if cleared.get(wid, 0) < r0:
+                cleared[wid] = r0
+
+        async def pump_shard(shard: _PumpShard):
             try:
                 while True:
-                    await w.wakeup.wait()
-                    w.wakeup.clear()
-                    if w.overflowed:
-                        cache.unregister(w)
-                        watchers.pop(wid, None)
-                        await out.put(
-                            rpc_pb2.WatchResponse(
-                                header=self._header(),
-                                watch_id=wid,
-                                canceled=True,
-                                cancel_reason="watcher overflowed; events dropped",
+                    await shard.event.wait()
+                    shard.event.clear()
+                    # Pump-stall fault hook: every firing kind
+                    # expresses as a bounded stall of this lane — the
+                    # pump never dies, it lags (and the lag shows up in
+                    # the drill's delivery p99, never as loss).
+                    d = faultline.decide("watch.tier", "pump.stall")
+                    if d is not None:
+                        await asyncio.sleep(d.delay_s or _STALL_S)
+                    while shard.ready:
+                        w = shard.ready.popleft()
+                        w._ready = False
+                        if watchers.get(w.service_id) is not w:
+                            continue    # canceled while queued
+                        if w.overflowed:
+                            await cancel_watch(
+                                w, "watcher overflowed; events dropped"
                             )
-                        )
-                        return
-                    r0 = cache.last_revision
-                    while w.queue:
-                        resp = rpc_pb2.WatchResponse(
-                            header=self._header(), watch_id=wid
-                        )
-                        last = 0
-                        for _ in range(min(len(w.queue), _WATCH_BATCH)):
-                            ev = w.queue.popleft()
-                            pb = resp.events.add()
-                            pb.type = (
-                                mvcc_pb2.Event.DELETE
-                                if ev.type
-                                else mvcc_pb2.Event.PUT
-                            )
-                            pb.kv.key = ev.key
-                            pb.kv.value = ev.value
-                            pb.kv.create_revision = ev.create_revision
-                            pb.kv.mod_revision = ev.mod_revision
-                            pb.kv.version = ev.version
-                            last = ev.mod_revision
-                        await out.put(resp)
-                        if cleared.get(wid, 0) < last:
-                            cleared[wid] = last
-                        r0 = cache.last_revision
-                    # Queue observed empty at r0 (snapshot taken before the
-                    # check, no await between): delivered through r0.
-                    if cleared.get(wid, 0) < r0:
-                        cleared[wid] = r0
+                            continue
+                        await drain_one(w)
             except asyncio.CancelledError:
                 raise
 
-        pumps: dict[int, asyncio.Task] = {}
+        pumps = [
+            asyncio.create_task(pump_shard(shard)) for shard in shards
+        ]
 
         async def reader():
             nonlocal next_id
@@ -754,24 +1175,25 @@ class WatchCacheService:
                         )
                         continue
                     watchers[wid] = w
+                    w.service_id = wid
+                    shard = shards[wid % len(shards)]
+                    w.on_ready = shard.mark_ready
                     # Owes nothing below the registration point unless a
                     # replay queued history to deliver first.
-                    if not w.queue:
+                    if not w.backlog:
                         cleared[wid] = cache.last_revision
                     await out.put(
                         rpc_pb2.WatchResponse(
                             header=self._header(), watch_id=wid, created=True
                         )
                     )
-                    pumps[wid] = asyncio.create_task(pump(wid, w))
+                    if w.backlog or w.overflowed:
+                        shard.mark_ready(w)
                 elif which == "cancel_request":
                     wid = req.cancel_request.watch_id
                     w = watchers.pop(wid, None)
                     if w is not None:
                         cache.unregister(w)
-                        task = pumps.pop(wid, None)
-                        if task:
-                            task.cancel()
                         await out.put(
                             rpc_pb2.WatchResponse(
                                 header=self._header(),
@@ -796,10 +1218,11 @@ class WatchCacheService:
                 ]
                 if not pending:
                     break
-                # Idle pumps sleep on wakeup; nudge them so an event-less
-                # watch still advances its delivered-through point.
+                # Idle watchers sit off the ready sets; nudge them so an
+                # event-less watch still advances its delivered-through
+                # point at its pump lane.
                 for wid in pending:
-                    watchers[wid].wakeup.set()
+                    watchers[wid]._notify()
                 await asyncio.sleep(0.002)
             await out.put(
                 rpc_pb2.WatchResponse(
@@ -816,7 +1239,7 @@ class WatchCacheService:
                 yield resp
         finally:
             rtask.cancel()
-            for task in pumps.values():
+            for task in pumps:
                 task.cancel()
             for task in list(barriers):
                 task.cancel()
@@ -952,6 +1375,8 @@ async def serve_watch_cache(
     window: int = _DEFAULT_WINDOW,
     tls=None,
     auth_token: str | None = None,
+    lag_budget: int = _LAG_BUDGET,
+    pumps: int = _PUMP_SHARDS,
 ) -> WatchCacheTier:
     """Start the tier: one upstream watch per prefix, etcd wire served on
     ``port``.
@@ -961,10 +1386,10 @@ async def serve_watch_cache(
     on every RPC — together the client-facing posture of the apiserver
     the tier stands in for (the reference's k3s serves TLS and
     authenticates clients; its plaintext side faces only mem_etcd)."""
-    cache = WatchCache(index=index, window=window)
+    cache = WatchCache(index=index, window=window, lag_budget=lag_budget)
     upstream = EtcdClient(upstream_target)
     handles = [UpstreamHandle(p) for p in prefixes]
-    svc = WatchCacheService(cache, upstream, handles)
+    svc = WatchCacheService(cache, upstream, handles, n_pumps=pumps)
 
     def _unary(fn, req_cls, resp_cls):
         return grpc.unary_unary_rpc_method_handler(
@@ -1080,6 +1505,12 @@ def main(argv=None) -> None:
                     help="cache storage structure (the reference's "
                          "BtreeWatchCache experiment axis)")
     ap.add_argument("--window", type=int, default=_DEFAULT_WINDOW)
+    ap.add_argument("--lag-budget", type=int, default=_LAG_BUDGET,
+                    help="per-subscriber FIFO depth before latest-only "
+                    "coalescing engages (the loadshed controller "
+                    "shrinks it under backlog)")
+    ap.add_argument("--pumps", type=int, default=_PUMP_SHARDS,
+                    help="fan-out pump lanes per Watch stream")
     ap.add_argument("--metrics-port", type=int, default=0)
     ap.add_argument("--tls-cert", default=None,
                     help="serve TLS: path to the server cert PEM")
@@ -1109,6 +1540,7 @@ def main(argv=None) -> None:
             args.upstream, prefixes, port=args.port, host=args.host,
             index=args.index, window=args.window,
             tls=tls, auth_token=args.auth_token,
+            lag_budget=args.lag_budget, pumps=args.pumps,
         )
         if args.metrics_port:
             from k8s1m_tpu.obs.http import start_metrics_server

@@ -3,6 +3,12 @@
 etcd-lease-flood/main.go:117-149: 1M kubelets renewing a 40s lease every
 10s is ~100K writes/s, README.adoc:142-151).
 
+Progress prints every 100K leases (the make_nodes ``--bulk``
+convention — an hour-scale flood's heartbeat, not 1s rate spam), and
+``--fault-plan`` (tools/common.py; named plans like ``watchstorm``
+work) installs a deterministic injector so the storm drill can break
+the tier's upstream watch MID-flood.
+
     python -m k8s1m_tpu.tools.lease_flood --nodes 10000 --rounds 10
 """
 
@@ -17,6 +23,7 @@ from k8s1m_tpu.control.objects import lease_key
 from k8s1m_tpu.tools.common import (
     RateReporter,
     add_common_args,
+    apply_fault_plan,
     client_factory,
     run_sharded,
 )
@@ -57,7 +64,10 @@ def parse_args(argv=None):
 
 
 async def amain(args) -> dict:
-    reporter = RateReporter("lease puts", quiet=args.quiet)
+    apply_fault_plan(args)
+    reporter = RateReporter(
+        "lease puts", quiet=args.quiet, milestone=100_000
+    )
     total = args.nodes * args.rounds
 
     async def work(client, i):

@@ -44,16 +44,97 @@ CPU with the virtual device mesh::
 
     python -m k8s1m_tpu.tools.steady_drill --smoke \
         --out artifacts/steady_state_drill.json
+
+**The failover lane** (``--failover``, ISSUE 15: the failover drill's
+kill scenarios folded into the composed drill — the benchtrue-part-3
+remainder): the coordinator runs as an HA pair (alpha leading, beta a
+warm standby following the watch stream), the watch-cache TIER runs
+over the same store (native wire front, one client watch on the pods
+prefix) on a sidecar loop, and the installed fault plan lands BOTH
+storm legs mid-drill: a ``kill_process`` SIGKILLs alpha late in the
+overload phase (beta must take over on lease expiry and drain
+everything — still 0 lost), and an upstream watch break hits the tier
+(which must RESUME its client in place: resumes +1, invalidations 0,
+zero client cancels).  Composes with ``--mesh``/``--packing``.
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
+import threading
 import time
 
 IDLE_DRAIN_TICKS = 4000
+
+
+class _WatchTierLane:
+    """The composed lane's watch-tier leg: the fan-out tier over the
+    SAME store (served through a native wire front), with one client
+    watch on the pods prefix counting deliveries, on a private asyncio
+    loop in a worker thread.  The installed fault plan breaks its
+    upstream stream mid-drill; the lane's gates are a diff-replay
+    resume (client kept, ``watchcache_resumes_total`` +1, zero
+    invalidations) and zero client cancels."""
+
+    def __init__(self, store):
+        self.events = 0
+        self.cancels = 0
+        self.errors = 0
+        self._stop = False
+        self._store = store
+        self._ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="watch-tier-lane", daemon=True
+        )
+        self._thread.start()
+        if not self._ready.wait(timeout=60):
+            raise RuntimeError("watch-tier lane failed to come up")
+
+    def _run(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        from k8s1m_tpu.control.coordinator import PODS_PREFIX
+        from k8s1m_tpu.store.etcd_client import EtcdClient
+        from k8s1m_tpu.store.native import WireFront, prefix_end
+        from k8s1m_tpu.store.watch_cache import serve_watch_cache
+
+        wf = WireFront(self._store)
+        tier = await serve_watch_cache(
+            f"127.0.0.1:{wf.port}", [PODS_PREFIX], port=0
+        )
+        client = EtcdClient(f"127.0.0.1:{tier.port}")
+        s = client.watch(PODS_PREFIX, prefix_end(PODS_PREFIX))
+        await s.__aenter__()
+        self._ready.set()
+        try:
+            while not self._stop:
+                try:
+                    b = await s.next(timeout=0.2)
+                except asyncio.TimeoutError:
+                    continue
+                # Counted, not logged: errors fail the lane's gate.
+                except Exception:  # graftlint: disable=broad-except
+                    self.errors += 1
+                    break
+                if b.canceled:
+                    # The cancel-everyone hammer reached the client:
+                    # exactly what the resume path must prevent.
+                    self.cancels += 1
+                    break
+                self.events += len(b.events)
+            await s.cancel()
+        finally:
+            await client.close()
+            await tier.close()
+            wf.close()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=30)
 
 
 def parse_args(argv=None):
@@ -87,6 +168,13 @@ def parse_args(argv=None):
                     "production path), else 'off'.  A packed drill "
                     "additionally gates device_packing_fallback_total "
                     "== 0 over the window")
+    ap.add_argument("--failover", action="store_true",
+                    help="compose the failover-drill kill scenarios "
+                    "into this run: HA coordinator pair with a "
+                    "mid-overload SIGKILL of the leader (warm standby "
+                    "takes over, still 0 lost) plus a watch-cache tier "
+                    "sidecar whose upstream stream is broken mid-drill "
+                    "(must resume, not relist-storm)")
     ap.add_argument("--trace", type=int, default=0, metavar="N",
                     help="podtrace (obs/podtrace.py): trace 1-in-N "
                     "pods through the composed drill; the stage-"
@@ -151,6 +239,7 @@ def run(args) -> dict:
     from k8s1m_tpu.snapshot.node_table import NodeInfo
     from k8s1m_tpu.snapshot.pod_encoding import PodInfo
     from k8s1m_tpu.store.native import MemStore
+    from k8s1m_tpu.store import watch_cache as _wc  # noqa: F401  (register watchcache_* metrics for the failover lane's deltas)
     from k8s1m_tpu.tenancy import TenancyController, TenancyPolicy
 
     b = args.batch
@@ -165,15 +254,32 @@ def run(args) -> dict:
         queue_degraded=3 * b, queue_shed=6 * b, queue_cap=64 * b,
         queue_recover=b, recover_cycles=3,
     )
-    tn = TenancyController(
-        TenancyPolicy(weights=weights), loadshed_config=cfg,
-        name="steady_drill",
-    )
-    plan = FaultPlan(
-        [FaultSpec("coordinator.bind", "cas", kind="err5xx",
-                   every_n=args.conflict_every)],
-        seed=args.seed,
-    )
+    controllers: list = []
+
+    def make_tn():
+        tn = TenancyController(
+            TenancyPolicy(weights=weights), loadshed_config=cfg,
+            name=f"steady_drill-{len(controllers)}",
+        )
+        controllers.append(tn)
+        return tn
+
+    specs = [FaultSpec("coordinator.bind", "cas", kind="err5xx",
+                       every_n=args.conflict_every)]
+    # The failover lane's two storm legs, by schedule: SIGKILL alpha on
+    # its lease tick 3/4 into the overload phase (counters start at
+    # install, after warmup), and break the tier's upstream stream at
+    # its 31st post-install batch.
+    kill_tick = args.steady_ticks + (3 * args.overload_ticks) // 4
+    if args.failover:
+        specs += [
+            FaultSpec("coordinator.lease", "tick/alpha",
+                      kind="kill_process", after=kill_tick, every_n=1,
+                      max_fires=1),
+            FaultSpec("watch.tier", "upstream.recv", kind="disconnect",
+                      after=30, every_n=1, max_fires=1),
+        ]
+    plan = FaultPlan(specs, seed=args.seed)
 
     quiesce = REGISTRY.get("pipeline_quiesce_total")
     q0 = {r: quiesce.value(reason=r) for r in ("structural", "resync")}
@@ -186,6 +292,9 @@ def run(args) -> dict:
 
     pack_fb = REGISTRY.get("device_packing_fallback_total")
     fb0 = {r: pack_fb.value(reason=r) for r in FALLBACK_REASONS}
+    wc_resumes = REGISTRY.get("watchcache_resumes_total")
+    wc_invals = REGISTRY.get("watchcache_invalidations_total")
+    wr0, wi0 = wc_resumes.value(), wc_invals.value()
 
     store = MemStore()
 
@@ -206,13 +315,48 @@ def run(args) -> dict:
         from k8s1m_tpu.obs.podtrace import PodTracer
 
         tracer = PodTracer(sample_n=args.trace)
-    coord = Coordinator(
-        store, TableSpec(max_nodes=args.nodes, max_zones=16, max_regions=8),
-        PodSpec(batch=b), Profile(topology_spread=0, interpod_affinity=0),
-        chunk=args.chunk, k=4, with_constraints=False, seed=args.seed,
-        score_pct=50, pipeline=True, depth=args.depth, tenancy=tn,
-        mesh=args.mesh or "none", packing=args.packing, tracer=tracer,
-    )
+
+    def make_coord():
+        return Coordinator(
+            store,
+            TableSpec(max_nodes=args.nodes, max_zones=16, max_regions=8),
+            PodSpec(batch=b), Profile(topology_spread=0, interpod_affinity=0),
+            chunk=args.chunk, k=4, with_constraints=False, seed=args.seed,
+            score_pct=50, pipeline=True, depth=args.depth, tenancy=make_tn(),
+            mesh=args.mesh or "none", packing=args.packing, tracer=tracer,
+        )
+
+    alpha = beta = coord = None
+    if args.failover:
+        from k8s1m_tpu.control.leader import HACoordinator, LeaderElector
+
+        alpha = HACoordinator(LeaderElector(store, "alpha"), make_coord)
+        beta = HACoordinator(
+            LeaderElector(store, "beta", retry_period_s=1.0),
+            make_coord, warm_standby=True,
+        )
+    else:
+        coord = make_coord()
+
+    now = 0.0
+
+    def active_coord():
+        """The live scheduling coordinator (post-kill: the standby's)."""
+        if not args.failover:
+            return coord
+        if alpha.elector.is_leader and not alpha._killed:
+            return alpha.coord
+        return beta.coord
+
+    def step_once() -> None:
+        nonlocal now
+        if not args.failover:
+            coord.step()
+            return
+        now += 1.0
+        if not alpha._killed:
+            alpha.tick(now)
+        beta.tick(now)
 
     seq = 0
     churned = 0
@@ -235,7 +379,15 @@ def run(args) -> dict:
                           cpu_milli=10, mem_kib=1 << 10)
             obj = json.loads(encode_pod(pod))
             try:
-                coord.submit_external(obj)
+                if args.failover:
+                    # The live replica's sink (queue-or-429 while no
+                    # leader holds the lease).
+                    ha = alpha if (
+                        alpha.elector.is_leader and not alpha._killed
+                    ) else beta
+                    ha.submit_external(obj)
+                else:
+                    coord.submit_external(obj)
             except Overloaded:
                 rejected += 1
                 continue
@@ -252,16 +404,39 @@ def run(args) -> dict:
     def tick(phase: str, n: int, producing: bool) -> None:
         submit(n)
         churn_tick()
-        coord.step()
-        states_seen.add(tn.controller.current_state())
+        step_once()
+        c = active_coord()
+        if c is not None:
+            states_seen.add(c.tenancy.controller.current_state())
         if producing:
-            depth_samples.append(len(coord._inflights))
+            depth_samples.append(
+                len(c._inflights) if c is not None else 0
+            )
 
+    lane = _WatchTierLane(store) if args.failover else None
     try:
-        coord.bootstrap()
+        if args.failover:
+            now += 1.0
+            alpha.tick(now)      # alpha cold-boots and leads
+            assert alpha.elector.is_leader
+        else:
+            coord.bootstrap()
         # Warm the compile caches outside the gated window.
         submit(b)
-        coord.run_until_idle()
+        if args.failover:
+            for _ in range(IDLE_DRAIN_TICKS):
+                c = active_coord()
+                if c is not None and (
+                    not c.queue and not c._backoff
+                    and not c._external_pending() and not c._inflights
+                ):
+                    break
+                step_once()
+                w = c.backoff_wait_s() if c is not None else 0
+                if w:
+                    time.sleep(min(w, 0.05))
+        else:
+            coord.run_until_idle()
         install_plan(plan)
         for _ in range(args.steady_ticks):
             tick("steady", b, True)
@@ -269,33 +444,68 @@ def run(args) -> dict:
             tick("overload", args.factor * b, True)
         for t in range(args.recover_ticks):
             tick("recovery", b // 2, False)
+            c = active_coord()
             if (
-                tn.controller.current_state() == HEALTHY
+                c is not None
+                and c.tenancy.controller.current_state() == HEALTHY
                 and recovered_at is None
             ):
                 recovered_at = t + 1
-        for _ in range(IDLE_DRAIN_TICKS):
-            if (
-                not coord.queue and not coord._backoff
-                and not coord._external_pending() and not coord._inflights
+        for dt in range(IDLE_DRAIN_TICKS):
+            c = active_coord()
+            if c is not None and (
+                not c.queue and not c._backoff
+                and not c._external_pending() and not c._inflights
             ):
                 break
-            coord.step()
-            w = coord.backoff_wait_s()
-            if w:
-                time.sleep(min(w, 0.05))
-        coord.flush()
+            step_once()
+            if c is not None:
+                # A mid-overload leader kill pushes the takeover
+                # backlog past the recovery window; the autonomous
+                # walk-back to HEALTHY is still the gate — it just
+                # completes during the drain.
+                if (
+                    args.failover and recovered_at is None
+                    and c.tenancy.controller.current_state() == HEALTHY
+                ):
+                    recovered_at = args.recover_ticks + dt + 1
+                w = c.backoff_wait_s()
+                if w:
+                    time.sleep(min(w, 0.05))
+        c = active_coord()
+        if c is not None:
+            c.flush()
         fired = faultline.active_injector().fire_counts()
         install_plan(None)
+        # Leadership read BEFORE the finally's stop() releases the
+        # lease (a post-stop read is always False).
+        beta_led = bool(args.failover and beta.elector.is_leader)
         lost = 0
         for t, name in admitted:
             kv = store.get(pod_key(t, name))
             if kv is None or b'"nodeName"' not in kv.value:
                 lost += 1
-        counters = tn.admission.counters()
+        counters = {"admitted": {}, "rejected": {}}
+        for tn in controllers:
+            for side, per in tn.admission.counters().items():
+                if side not in counters:
+                    continue
+                for tenant, v in per.items():
+                    counters[side][tenant] = (
+                        counters[side].get(tenant, 0) + v
+                    )
     finally:
         install_plan(None)
-        coord.close()
+        if lane is not None:
+            lane.stop()
+        if args.failover:
+            for ha in (alpha, beta):
+                try:
+                    ha.stop()
+                except Exception:  # graftlint: disable=broad-except (drill teardown must reach store.close)
+                    pass
+        else:
+            coord.close()
         store.close()
 
     import numpy as np
@@ -316,10 +526,43 @@ def run(args) -> dict:
     from k8s1m_tpu.obs.podtrace import trace_report_detail
 
     trace_detail = trace_report_detail(tracer, args.trace_out)
+    failover_ev = None
+    failover_ok = True
+    if args.failover:
+        resumes_d = int(wc_resumes.value() - wr0)
+        invals_d = int(wc_invals.value() - wi0)
+        failover_ev = {
+            "kill_fired": fired.get("kill_process", 0),
+            "kill_after_tick": kill_tick,
+            "beta_leader": beta_led,
+            "takeover_mode": beta.takeover_mode,
+            "recovery_s": beta.last_recovery_s,
+            "watch_tier": {
+                "events": lane.events,
+                "client_cancels": lane.cancels,
+                "client_errors": lane.errors,
+                "resumes": resumes_d,
+                "invalidations": invals_d,
+            },
+        }
+        # The lane's gates: the SIGKILL actually fired and the warm
+        # standby leads; the tier's upstream break resolved by resume
+        # (client watch kept — zero cancels/invalidations) and the
+        # sidecar actually observed traffic.
+        failover_ok = bool(
+            failover_ev["kill_fired"] == 1
+            and failover_ev["beta_leader"]
+            and resumes_d >= 1
+            and invals_d == 0
+            and lane.cancels == 0
+            and lane.errors == 0
+            and lane.events > 0
+        )
     return {
         "weights": weights,
         "mesh": args.mesh,
         "packing": args.packing,
+        "failover": failover_ev,
         **trace_detail,
         "packing_fallbacks": packing_fallbacks,
         "mesh_sharded_scatters": mesh_scatters,
@@ -353,6 +596,10 @@ def run(args) -> dict:
             # Packed lane (meshpack): the composed window must hold the
             # packed layout end to end — zero fail-closed rebuilds.
             and (args.packing != "packed" or packing_fallbacks == 0)
+            # Failover lane (watchplane): leader SIGKILL absorbed by
+            # the warm standby AND the tier's upstream break absorbed
+            # by resume, inside the same composed window.
+            and failover_ok
         ),
     }
 
@@ -363,6 +610,7 @@ def main(argv=None) -> dict:
     result = {
         "metric": "steady_state_drill"
         + ("_mesh" if args.mesh else "")
+        + ("_failover" if args.failover else "")
         + ("_smoke" if args.smoke else ""),
         "value": evidence["sustained_inflight_depth"],
         "unit": "sustained in-flight depth under composed load",
@@ -374,7 +622,7 @@ def main(argv=None) -> dict:
             "tenants": args.tenants, "tenant_skew": args.tenant_skew,
             "factor": args.factor, "churn_per_tick": args.churn_per_tick,
             "conflict_every": args.conflict_every, "mesh": args.mesh,
-            "packing": args.packing,
+            "packing": args.packing, "failover": args.failover,
         },
         "evidence": evidence,
     }

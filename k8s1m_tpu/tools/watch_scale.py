@@ -138,6 +138,13 @@ def parse_args(argv=None):
                     help="bidi streams the watches multiplex over")
     ap.add_argument("--writes", type=int, default=20_000)
     ap.add_argument("--index", choices=("hash", "btree"), default="hash")
+    ap.add_argument("--lag-budget", type=int, default=0,
+                    help="tier per-subscriber FIFO budget before "
+                    "latest-only coalescing (watchplane; 0 = tier "
+                    "default)")
+    ap.add_argument("--pumps", type=int, default=0,
+                    help="tier fan-out pump lanes per Watch stream "
+                    "(watchplane; 0 = tier default)")
     ap.add_argument(
         "--replicas", type=int, default=1,
         help="tier replica processes over the ONE store; client streams "
@@ -189,6 +196,11 @@ async def amain(args) -> dict:
     if args.streams < n_rep:
         args.streams = n_rep        # at least one stream per replica
     tier_ports = [_free_port() for _ in range(n_rep)]
+    tier_flags = []
+    if args.lag_budget:
+        tier_flags += ["--lag-budget", str(args.lag_budget)]
+    if args.pumps:
+        tier_flags += ["--pumps", str(args.pumps)]
     tier_procs = [
         subprocess.Popen(
             [
@@ -198,6 +210,7 @@ async def amain(args) -> dict:
                 "--prefix", IDLE_PREFIX.decode(),
                 "--prefix", HOT_PREFIX.decode(),
                 "--index", args.index,
+                *tier_flags,
             ],
             env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
         )

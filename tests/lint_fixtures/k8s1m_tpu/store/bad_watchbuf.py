@@ -1,0 +1,10 @@
+"""bounded-watch-buffer true positive: a subscriber event queue in
+store/ constructed without an explicit bound — the storm amplifier the
+watchplane rule exists to keep out of the tier."""
+
+import collections
+
+
+class Subscriber:
+    def __init__(self):
+        self.queue = collections.deque()

@@ -1,0 +1,10 @@
+"""bounded-watch-buffer pragma twin: same construction, bounded-by-
+construction reason declared."""
+
+import collections
+
+
+class Subscriber:
+    def __init__(self):
+        # Producers latch: each pushes itself at most once.
+        self.queue = collections.deque()  # graftlint: disable=bounded-watch-buffer (ready-set, producers latch)

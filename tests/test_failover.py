@@ -384,3 +384,27 @@ def test_steady_drill_mesh_smoke_passes(tmp_path):
     assert result["passed"], result
     assert result["evidence"]["mesh"] == "2x4"
     assert result["evidence"]["mesh_sharded_scatters"]["cap"] > 0
+
+
+def test_steady_drill_failover_smoke_passes(tmp_path):
+    """ISSUE 15: the failover drill's kill scenarios folded into the
+    composed steady drill — a mid-overload leader SIGKILL (warm standby
+    takes over, still 0 lost) AND an upstream watch break against the
+    tier sidecar (absorbed by diff-replay resume, zero client cancels)
+    in ONE composed lane, same gates as ever on top."""
+    from k8s1m_tpu.tools.steady_drill import main
+
+    out = tmp_path / "steady_failover.json"
+    result = main(["--smoke", "--failover", "--out", str(out)])
+    assert result["passed"], result
+    ev = result["evidence"]
+    assert ev["lost"] == 0
+    f = ev["failover"]
+    assert f["kill_fired"] == 1
+    assert f["beta_leader"] and f["takeover_mode"] == "warm"
+    assert f["recovery_s"] is not None
+    wt = f["watch_tier"]
+    assert wt["events"] > 0
+    assert wt["resumes"] >= 1
+    assert wt["invalidations"] == 0
+    assert wt["client_cancels"] == 0 and wt["client_errors"] == 0

@@ -137,6 +137,23 @@ def test_wildcards_and_json_roundtrip(tmp_path):
     assert Injector(again).decide("anything", "at-all") is not None
 
 
+def test_named_plan_watchstorm():
+    """--fault-plan accepts plan NAMES: 'watchstorm' resolves to the
+    composed watchplane storm (upstream breaks + pump stalls +
+    subscriber wedges), identically on every resolution; an unknown
+    name still falls through to JSON parsing (and fails loudly)."""
+    plan = FaultPlan.from_arg("watchstorm")
+    by_op = {}
+    for s in plan.faults:
+        assert s.component == "watch.tier"
+        by_op.setdefault(s.op, []).append(s)
+    assert set(by_op) == {"upstream.recv", "pump.stall", "subscriber.send"}
+    assert any(s.kind == "disconnect" for s in by_op["upstream.recv"])
+    assert FaultPlan.from_arg("watchstorm").to_json() == plan.to_json()
+    with pytest.raises(ValueError):
+        FaultPlan.from_arg("no-such-storm")
+
+
 def test_spec_validation_rejects_garbage():
     with pytest.raises(ValueError):
         FaultSpec("c", kind="meteor-strike", probability=0.1)

@@ -48,6 +48,7 @@ EXPECTED = {
     "undonated-device-update": "k8s1m_tpu/engine/bad_donate.py",
     "deltacache-epoch-keyed": "k8s1m_tpu/engine/bad_deltacache.py",
     "trace-lazy-emit": "k8s1m_tpu/control/bad_trace_emit.py",
+    "bounded-watch-buffer": "k8s1m_tpu/store/bad_watchbuf.py",
 }
 
 

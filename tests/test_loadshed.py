@@ -460,6 +460,58 @@ def test_breaker_fallback_binds_byte_identical_to_oracle():
             coord.close()
 
 
+def test_fallback_nodes_incremental_matches_full_decode():
+    """ISSUE 15 satellite (ROADMAP item 1 leftover): the breaker-open
+    fallback candidate list is maintained incrementally from watch
+    events (one lazy store-decode seed for bulk-ingested rows), so a
+    node-gen bump costs O(changed), not an O(N) decode.  Differential
+    vs the kept full decode across the lifecycle: bootstrap seed,
+    capacity updates, structural add, remove, and a resync."""
+    def snap(pairs):
+        return [
+            (row, nd.name, nd.cpu_milli, nd.mem_kib, nd.pods,
+             sorted(nd.labels.items()) if nd.labels else [])
+            for row, nd in pairs
+        ]
+
+    with MemStore() as store:
+        _seed_nodes(store, 32)
+        coord = _coord(store)
+        coord.bootstrap()
+        try:
+            def check():
+                got = snap(coord._fallback_nodes())
+                want = snap(coord._fallback_nodes_full())
+                assert got == want and len(got) > 0
+            check()                      # lazy seed over the bulk boot
+            # Capacity update + structural add + remove, drained.
+            store.put(node_key("n3"), encode_node(NodeInfo(
+                name="n3", cpu_milli=1234, mem_kib=1 << 21, pods=8,
+            )))
+            store.put(node_key("zz-new"), encode_node(NodeInfo(
+                name="zz-new", cpu_milli=999, mem_kib=1 << 20, pods=4,
+                labels={"zone": "z-1"},
+            )))
+            store.delete(node_key("n7"))
+            coord.step()
+            check()
+            assert len(coord._node_infos) == 32   # 32 - removed + added
+            # Resync drops the index wholesale (the bulk relist
+            # refreshes rows without decoding); the next call re-seeds.
+            store.put(node_key("n5"), encode_node(NodeInfo(
+                name="n5", cpu_milli=777, mem_kib=1 << 20, pods=6,
+            )))
+            coord.resync()
+            check()
+            got = dict(
+                (nd.name, nd.cpu_milli) for _r, nd in coord._fallback_nodes()
+            )
+            assert got["n5"] == 777 and got["n3"] == 1234
+            assert "n7" not in got and "zz-new" in got
+        finally:
+            coord.close()
+
+
 # ---- 6. the drill (committed-evidence gate) --------------------------
 
 

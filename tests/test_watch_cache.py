@@ -1,18 +1,43 @@
 """Watch-cache fan-out tier tests: one store watch serving N client
 watches (the apiserver amplification role, reference README.adoc:410-416),
-replay/compaction semantics, and the hash|btree storage axis
-(README.adoc:495-499)."""
+replay/compaction semantics, the hash|btree storage axis
+(README.adoc:495-499), and the ISSUE 15 watchplane contract —
+resume-from-revision (diff-replay reprime), bounded-lag coalescing, and
+the byte-identity differential (resumed/coalesced stream == full relist
+at quiesce)."""
 
 import asyncio
 
 import pytest
 
+from k8s1m_tpu.obs.metrics import REGISTRY
 from k8s1m_tpu.store.etcd_client import EtcdClient
 from k8s1m_tpu.store.etcd_server import serve
-from k8s1m_tpu.store.native import MemStore, prefix_end
+from k8s1m_tpu.store.native import KeyValue, MemStore, prefix_end
 from k8s1m_tpu.store.watch_cache import WatchCache, serve_watch_cache
 
 PFX = b"/registry/leases/ns/"
+
+
+def _kv(key: bytes, value: bytes, rev: int, version: int = 1) -> KeyValue:
+    return KeyValue(
+        key=key, value=value, create_revision=rev, mod_revision=rev,
+        version=version,
+    )
+
+
+def _drain_state(w, state: dict) -> None:
+    """Fold a watcher's pending events into its level-triggered view
+    (key -> value or absent), asserting revision order on the way."""
+    last = 0
+    while w.queue or w.coalesced:
+        for ev in w.pop_batch(1000):
+            assert ev.mod_revision >= last
+            last = ev.mod_revision
+            if ev.type:
+                state.pop(ev.key, None)
+            else:
+                state[ev.key] = ev.value
 
 
 @pytest.fixture()
@@ -446,6 +471,428 @@ def test_range_outside_watched_prefixes_goes_upstream(env):
         assert len(resp.kvs) == 1
 
     loop.run_until_complete(go())
+
+
+# ---- ISSUE 15 watchplane: resume-from-revision -----------------------
+
+
+def test_reprime_resumes_clients_with_net_diff():
+    """An upstream break followed by a relist at R replays the NET
+    difference to the live watches — changed keys at their new
+    revisions, vanished keys as deletes stamped at R — with no
+    cancels."""
+    resumes = REGISTRY.get("watchcache_resumes_total")
+    invals = REGISTRY.get("watchcache_invalidations_total")
+    r0, i0 = resumes.value(), invals.value()
+    cache = WatchCache()
+    cache.prime(
+        [_kv(PFX + b"a", b"1", 5), _kv(PFX + b"b", b"1", 6),
+         _kv(PFX + b"c", b"1", 7)],
+        revision=7,
+    )
+    w = cache.register(PFX, b"\x00")
+    # The outage: a changed twice (net: @12), b deleted, d created.
+    assert cache.reprime(
+        [_kv(PFX + b"a", b"3", 12), _kv(PFX + b"c", b"1", 7),
+         _kv(PFX + b"d", b"1", 11)],
+        revision=12,
+    )
+    assert not w.overflowed
+    evs = w.pop_batch(100)
+    # EVERY net event is stamped at the relist revision on the wire
+    # (monotonicity for re-attaching clients); order keeps the true
+    # revision order.
+    assert [(e.type, e.key, e.value, e.mod_revision) for e in evs] == [
+        (0, PFX + b"d", b"1", 12),
+        (0, PFX + b"a", b"3", 12),
+        (1, PFX + b"b", b"", 12),
+    ]
+    assert cache.last_revision == 12
+    # The object map keeps the TRUE MVCC revisions (the next reprime's
+    # diff compares against them).
+    assert cache.objects[PFX + b"a"].mod_revision == 12
+    assert cache.objects[PFX + b"d"].mod_revision == 11
+    assert PFX + b"b" not in cache.objects
+    assert resumes.value() - r0 == 1
+    assert invals.value() - i0 == 0
+
+
+def test_resume_events_clear_a_reattach_start_revision():
+    """The reconnect hole (review catch, reproduced): a client whose
+    last-seen revision is the tier's GLOBAL header revision re-attaches
+    with a start_revision ABOVE an outage change's true revision — the
+    resume event must still clear its filter (stamped at the relist
+    revision), or the client keeps the stale value forever."""
+    other = b"/registry/configmaps/ns/"
+    cache = WatchCache()
+    cache.prime([_kv(PFX + b"y", b"1", 8)], revision=8)
+    # Another prefix's traffic advances the global header revision.
+    cache.apply(0, other + b"cm", b"v", 6, 24, 2)
+    # The client re-attaches from its last-seen GLOBAL revision.
+    w = cache.register(PFX + b"y", None, min_rev=25)
+    # The outage change's TRUE revision (9) is far below that.
+    assert cache.reprime(
+        [_kv(PFX + b"y", b"2", 9)], revision=30,
+        key=PFX, end=prefix_end(PFX),
+    )
+    assert [(e.value, e.mod_revision) for e in w.pop_batch(10)] == [
+        (b"2", 30)
+    ]
+    assert cache.objects[PFX + b"y"].mod_revision == 9   # true MVCC fact
+
+
+def test_reprime_scopes_deletes_to_prefix():
+    """The object map is the union of every watched prefix; a relist of
+    ONE prefix must not read the others' keys as deleted (the storm
+    drill's idle population found this)."""
+    other = b"/registry/configmaps/ns/"
+    cache = WatchCache()
+    cache.prime(
+        [_kv(PFX + b"a", b"1", 5), _kv(other + b"cm", b"1", 6)],
+        revision=6,
+    )
+    idle = cache.register(other + b"cm", None)
+    assert cache.reprime(
+        [_kv(PFX + b"a", b"2", 9)], revision=9,
+        key=PFX, end=prefix_end(PFX),
+    )
+    assert idle.backlog == 0                  # no phantom delete
+    assert other + b"cm" in cache.objects
+
+
+def test_reprime_window_overflow_falls_back_to_invalidate():
+    """A net diff bigger than the bounded history window cannot be
+    represented (appending it would evict genuine history); the tier
+    takes the old cancel-everyone hammer and counts it as an
+    invalidation, not a resume."""
+    resumes = REGISTRY.get("watchcache_resumes_total")
+    invals = REGISTRY.get("watchcache_invalidations_total")
+    r0, i0 = resumes.value(), invals.value()
+    cache = WatchCache(window=4)
+    cache.prime([_kv(PFX + b"k%d" % i, b"1", 2 + i) for i in range(3)],
+                revision=5)
+    w = cache.register(PFX, b"\x00")
+    ok = cache.reprime(
+        [_kv(PFX + b"k%d" % i, b"2", 10 + i) for i in range(6)],
+        revision=16,
+    )
+    assert not ok
+    assert w.overflowed
+    assert resumes.value() - r0 == 0
+    assert invals.value() - i0 == 1
+    # The pump (run_upstream) then primes the relist it already holds;
+    # the tier must serve the FRESH snapshot, not an empty prefix.
+    cache.prime(
+        [_kv(PFX + b"k%d" % i, b"2", 10 + i) for i in range(6)],
+        revision=16,
+    )
+    assert len(cache.objects) == 6
+    assert cache.objects[PFX + b"k0"].value == b"2"
+    assert cache.last_revision == 16
+
+
+def test_reprime_not_fooled_by_other_prefixes_progress():
+    """On a multi-prefix tier, a healthy prefix's live events advance
+    the global last_revision past a broken prefix's relist pin as a
+    matter of course — the rollback guard must judge against the
+    PREFIX-LOCAL high-water mark, not the global one (review catch)."""
+    other = b"/registry/configmaps/ns/"
+    resumes = REGISTRY.get("watchcache_resumes_total")
+    invals = REGISTRY.get("watchcache_invalidations_total")
+    r0, i0 = resumes.value(), invals.value()
+    cache = WatchCache()
+    cache.prime(
+        [_kv(PFX + b"a", b"1", 5), _kv(other + b"cm", b"1", 6)],
+        revision=6,
+    )
+    w = cache.register(PFX + b"a", None)
+    # The healthy prefix streams on while PFX's stream is down.
+    for i in range(5):
+        cache.apply(0, other + b"cm", b"v%d" % i, 6, 20 + i, 2 + i)
+    assert cache.last_revision == 24
+    # PFX's relist pins revision 10 — behind the GLOBAL mark, ahead of
+    # everything PFX ever held.  Must resume, not invalidate.
+    assert cache.reprime(
+        [_kv(PFX + b"a", b"2", 9)], revision=10,
+        key=PFX, end=prefix_end(PFX),
+    )
+    assert not w.overflowed
+    assert [e.value for e in w.pop_batch(10)] == [b"2"]
+    assert resumes.value() - r0 == 1 and invals.value() - i0 == 0
+    # A genuine PREFIX-LOCAL rollback still fails closed.
+    assert not cache.reprime(
+        [_kv(PFX + b"a", b"0", 3)], revision=30,
+        key=PFX, end=prefix_end(PFX),
+    )
+    assert invals.value() - i0 == 1
+
+
+def test_lag_budget_past_queue_cap_raises_hard_cap_with_it():
+    """An operator budget past _QUEUE_CAP must lift the subscriber's
+    hard cap (and the deque backstop) with it, or push() would stop
+    engaging coalescing and maxlen would silently evict the oldest
+    event (review catch)."""
+    from k8s1m_tpu.store.watch_cache import _QUEUE_CAP
+
+    cache = WatchCache(lag_budget=_QUEUE_CAP * 2)
+    w = cache.register(PFX + b"a", None)
+    assert w.hard_cap == _QUEUE_CAP * 2
+    assert w.queue.maxlen == _QUEUE_CAP * 2
+
+
+def test_invalidate_scoped_keeps_other_prefixes_objects():
+    """The hammer cancels every watcher, but only the BROKEN prefix's
+    objects drop — a healthy prefix's cache-served Range must not turn
+    confidently empty because another prefix's stream died."""
+    other = b"/registry/configmaps/ns/"
+    for index in ("hash", "btree"):
+        cache = WatchCache(index=index)
+        cache.prime(
+            [_kv(PFX + b"a", b"1", 5), _kv(other + b"cm", b"1", 6)],
+            revision=6,
+        )
+        cache.invalidate(PFX, prefix_end(PFX))
+        assert PFX + b"a" not in cache.objects
+        assert other + b"cm" in cache.objects
+        kvs, _more, count = cache.range(other, prefix_end(other))
+        assert count == 1 and kvs[0][0] == other + b"cm"
+
+
+# ---- ISSUE 15 watchplane: compaction-window edges --------------------
+
+
+def test_resume_exactly_at_window_start_and_one_before():
+    """The replay boundary is exact: a start revision equal to the
+    evicting window's oldest held revision resumes; one revision below
+    it must relist (compact cancel) — no off-by-one gaps."""
+    cache = WatchCache(window=4)
+    cache.prime([], revision=10)
+    for i in range(6):                      # revs 11..16; window holds 13..16
+        cache.apply(0, b"k", b"v", 11, 11 + i, i + 1)
+    start = cache.replayable_from
+    assert start == 13
+    w = cache.register(b"k", None)
+    assert cache.replay(w, start) is None               # exactly at start
+    assert [e.mod_revision for e in w.pop_batch(10)] == [13, 14, 15, 16]
+    w2 = cache.register(b"k", None)
+    assert cache.replay(w2, start - 1) == start         # one before: relist
+    assert w2.backlog == 0
+
+
+def test_invalidation_during_replay_cancels_cleanly():
+    """A watcher whose replay is still queued when the hammer falls is
+    canceled like everyone else — the queued history must not be
+    delivered as if the cache were still authoritative."""
+    cache = WatchCache()
+    cache.prime([], revision=1)
+    for i in range(8):
+        cache.apply(0, b"k", b"v%d" % i, 2, 2 + i, i + 1)
+    w = cache.register(b"k", None)
+    assert cache.replay(w, 2) is None
+    assert w.backlog == 8                   # replay queued, not drained
+    cache.invalidate()
+    assert w.overflowed                     # the pump cancels on this
+    assert cache._backlog >= 0
+
+
+# ---- ISSUE 15 watchplane: bounded-lag coalescing ---------------------
+
+
+def test_coalescing_latest_only_then_recovery():
+    """Past the lag budget a subscriber degrades to latest-only-per-key
+    (sticky until drained, revision-ordered emission); a full drain
+    recovers it to FIFO delivery; only a coalesce map past the hard cap
+    cancels."""
+    gauge = REGISTRY.get("watchcache_degraded_watchers")
+    g0 = gauge.value()
+    cache = WatchCache(lag_budget=4)
+    cache.prime([], revision=1)
+    w = cache.register(PFX, b"\x00")
+    for i in range(20):
+        cache.apply(0, PFX + b"hot", b"%d" % i, 2, 2 + i, i + 1)
+    assert len(w.queue) == 4 and w.coalescing
+    assert list(w.coalesced) == [PFX + b"hot"]
+    assert gauge.value() - g0 == 1
+    evs = w.pop_batch(100)
+    # FIFO head then the coalesced latest — intermediates elided.
+    assert [e.value for e in evs] == [b"0", b"1", b"2", b"3", b"19"]
+    assert not w.coalescing and gauge.value() - g0 == 0
+    # Hard cap: more DISTINCT lagging keys than hard_cap cancels.
+    w2 = cache.register(PFX, b"\x00")
+    w2.hard_cap = 8
+    for i in range(20):
+        cache.apply(0, PFX + b"k%d" % i, b"x", 30, 30 + i, 1)
+    assert w2.overflowed
+
+
+def test_loadshed_controller_shrinks_lag_budget():
+    """Total fan-out backlog drives the tier's HealthController, which
+    shrinks the effective per-subscriber budget (HEALTHY full,
+    DEGRADED quarter, SHEDDING zero) — the floodiest watchers coalesce
+    first because enforcement is depth-triggered."""
+    from k8s1m_tpu.loadshed import SHEDDING
+
+    cache = WatchCache(lag_budget=4)
+    cache.prime([], revision=1)
+    for i in range(300):
+        cache.register(PFX + b"k%d" % i, None)
+    for i in range(300):
+        cache.apply(0, PFX + b"k%d" % i, b"x", 2, 2 + i, 1)
+    cache.loadshed_tick()
+    assert cache._shed.current_state() == SHEDDING
+    assert cache._lag_now == 0
+    assert cache.stats()["lag_budget_now"] == 0
+
+
+# ---- ISSUE 15 watchplane: the byte-identity differential -------------
+
+
+def test_resume_and_coalesce_stream_equals_full_relist_at_quiesce():
+    """The acceptance gate: the scheduler-visible stream of a coalesced
+    slow consumer ACROSS an upstream break+reprime reconstructs, at
+    quiesce, exactly the state a fresh full relist reports — and so
+    does an uncoalesced fast consumer's.  Level-triggered equivalence,
+    byte for byte."""
+    cache = WatchCache(lag_budget=3)
+    seed = [_kv(PFX + b"k%02d" % i, b"s", 2 + i) for i in range(8)]
+    cache.prime(seed, revision=9)
+    fast = cache.register(PFX, b"\x00")
+    slow = cache.register(PFX, b"\x00")
+    fast_state = {kv.key: kv.value for kv in seed}
+    slow_state = dict(fast_state)
+
+    rev = 10
+    def put(k, v):
+        nonlocal rev
+        cache.apply(0, PFX + k, v, 2, rev, 2)
+        rev += 1
+    def delete(k):
+        nonlocal rev
+        cache.apply(1, PFX + k, b"", 0, rev, 0)
+        rev += 1
+
+    # Storm phase 1: churn; fast drains continuously, slow never does.
+    for r in range(6):
+        for i in range(8):
+            put(b"k%02d" % i, b"r%d-%d" % (r, i))
+        delete(b"k%02d" % (r % 4))
+        put(b"k%02d" % (r % 4), b"back-%d" % r)
+        _drain_state(fast, fast_state)
+    # Upstream break: the relist says three keys moved on, one died,
+    # one appeared — replayed as a net diff to BOTH watchers.
+    assert cache.reprime(
+        [_kv(k, o.value, o.mod_revision, o.version)
+         for k, o in cache.objects.items() if k != PFX + b"k07"]
+        + [_kv(PFX + b"k07", b"post-outage", rev + 3)]
+        + [_kv(PFX + b"new", b"born", rev + 4)],
+        revision=rev + 5,
+    )
+    rev += 6
+    for r in range(3):
+        put(b"k00", b"tail-%d" % r)
+    # Quiesce: drain both and compare against the authoritative view.
+    _drain_state(fast, fast_state)
+    _drain_state(slow, slow_state)
+    relist = {k: o.value for k, o in cache.objects.items()}
+    assert fast_state == relist
+    assert slow_state == relist
+    assert slow.last_pushed == cache.last_revision
+
+
+# ---- ISSUE 15 watchplane: client-side coalescing (store/remote.py) ---
+
+
+def test_remote_watcher_coalesce_latest_only_no_drops():
+    """The wire client's opt-in bounded-lag mirror: a flood past the
+    FIFO cap folds latest-only-per-key instead of dropping-and-
+    resyncing — zero ``dropped``, net state intact, revision-ordered."""
+    import time as _time
+
+    from k8s1m_tpu.store.native import WireFront
+    from k8s1m_tpu.store.remote import RemoteStore
+
+    pfx = b"/registry/coal/"
+    store = MemStore()
+    wf = WireFront(store)
+    rs = RemoteStore(f"127.0.0.1:{wf.port}")
+    w = None
+    try:
+        store.put(pfx + b"a", b"seed")
+        for i in range(100):
+            store.put(pfx + b"hot", b"%03d" % i)
+        store.put(pfx + b"b", b"last")
+        # Replay from revision 1: the 103-event history must squeeze
+        # through an 8-slot FIFO without a single drop.
+        w = rs.watch(pfx, prefix_end(pfx), start_revision=1,
+                     queue_cap=8, coalesce=True)
+        state: dict[bytes, bytes] = {}
+        last_rev: dict[bytes, int] = {}
+        deadline = _time.monotonic() + 20
+        while _time.monotonic() < deadline:
+            for ev in w.poll(max_events=16):
+                assert ev.kv.mod_revision >= last_rev.get(ev.kv.key, 0)
+                last_rev[ev.kv.key] = ev.kv.mod_revision
+                state[ev.kv.key] = ev.kv.value
+            if state.get(pfx + b"hot") == b"099" and pfx + b"b" in state:
+                break
+            _time.sleep(0.02)
+        assert state == {
+            pfx + b"a": b"seed", pfx + b"hot": b"099", pfx + b"b": b"last",
+        }
+        assert w.dropped == 0
+    finally:
+        if w is not None:
+            w.cancel()
+        rs.close()
+        wf.close()
+        store.close()
+
+
+# ---- ISSUE 15 watchplane: resume over the wire -----------------------
+
+
+def test_upstream_break_resumes_clients_over_wire(env):
+    """Wire-level resume: an injected upstream disconnect mid-traffic
+    must NOT cancel the client watch — deliveries continue through the
+    relist, net state intact, resumes+1, invalidations+0."""
+    from k8s1m_tpu.faultline import FaultPlan, FaultSpec, install_plan
+
+    loop, store, sclient, cache, cclient = env
+    resumes = REGISTRY.get("watchcache_resumes_total")
+    invals = REGISTRY.get("watchcache_invalidations_total")
+    r0, i0 = resumes.value(), invals.value()
+
+    async def go():
+        s = cclient.watch(PFX + b"x")
+        await s.__aenter__()
+        install_plan(FaultPlan(
+            [FaultSpec("watch.tier", "upstream.recv", kind="disconnect",
+                       after=1, every_n=1, max_fires=1)],
+            seed=3,
+        ))
+        try:
+            seen = b""
+            for i in range(30):
+                await sclient.put(PFX + b"x", b"v%02d" % i)
+                await asyncio.sleep(0.01)
+            deadline = 200
+            while seen != b"v29" and deadline:
+                deadline -= 1
+                try:
+                    batch = await s.next(timeout=0.1)
+                except asyncio.TimeoutError:
+                    continue
+                assert not batch.canceled
+                if batch.events:
+                    seen = batch.events[-1].kv.value
+            assert seen == b"v29"
+        finally:
+            install_plan(None)
+            await s.cancel()
+
+    loop.run_until_complete(go())
+    assert resumes.value() - r0 >= 1
+    assert invals.value() - i0 == 0
 
 
 def test_prime_paginates_large_prefixes(loop):

@@ -114,7 +114,12 @@ from k8s1m_tpu.engine.cycle import (
     schedule_batch_delta,
     schedule_batch_packed,
 )
-from k8s1m_tpu.engine.deltacache import DeltaPlaneCache, resolve_deltasched
+from k8s1m_tpu.engine.deltacache import (
+    DeltaPlaneCache,
+    note_index_oversized,
+    note_index_wave,
+    resolve_deltasched,
+)
 from k8s1m_tpu.loadshed import CircuitBreaker, HealthController, Signals
 from k8s1m_tpu.loadshed import CLOSED as BREAKER_CLOSED
 from k8s1m_tpu.loadshed.breaker import FALLBACK_BINDS
@@ -640,6 +645,19 @@ class Coordinator:
         # degraded); everything else takes the ordinary full pass.
         deltacache: str | bool | None = None,
         delta_slots: int = 64,
+        # Score-stratified candidate index (engine/deltacache.py): keep
+        # a per-resident-slot top-K row index in HBM so an all-hit wave
+        # with a small dirty set derives candidates from index + dirty
+        # rows and skips the full-plane scan — O(dirty + K·batch)
+        # instead of O(batch × N).  0 (default) = planes only.  The
+        # index keys on class_key(score, column, stratum_bits): with
+        # stratum_bits=0 it fails closed whenever scores tie at the
+        # floor (homogeneous clusters), so saturated drills set
+        # stratum_bits to split score levels into hash strata whose
+        # order is wave-invariant.  Byte-identical either way.
+        delta_index_k: int = 0,
+        stratum_bits: int = 0,
+        delta_index_dirty_cap: int | None = None,
     ):
         self.store = store
         self.table_spec = table_spec
@@ -668,6 +686,14 @@ class Coordinator:
         self._trace_gaveup: set[str] = set()
         self._profile_dumps = 0
         self.backend = backend
+        from k8s1m_tpu.ops.priority import JITTER_BITS
+
+        if not 0 <= stratum_bits <= JITTER_BITS:
+            raise ValueError(
+                f"stratum_bits must be in [0, {JITTER_BITS}], "
+                f"got {stratum_bits}"
+            )
+        self.stratum_bits = stratum_bits
         self.pipeline = pipeline
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
@@ -903,16 +929,6 @@ class Coordinator:
         self._delta: DeltaPlaneCache | None = None
         self._delta_fill_enc: HotPodBatchHost | None = None
         if resolve_deltasched(deltacache) == "on":
-            if self.backend != "xla":
-                # Same fail-loud rationale as resolve_deltasched: on the
-                # pallas backend every wave would fail the delta
-                # eligibility gate and silently measure full recompute
-                # plus cache overhead under a "deltacache on" label.
-                raise ValueError(
-                    "deltacache requires backend='xla' (the pallas fused "
-                    "kernel has no delta variant); set backend='xla' or "
-                    "deltacache='off'"
-                )
             plane_sharding = None
             if mesh is not None:
                 from jax.sharding import NamedSharding
@@ -922,12 +938,22 @@ class Coordinator:
             self._delta = DeltaPlaneCache(
                 table_spec.max_nodes, slots=delta_slots,
                 sharding=plane_sharding,
+                index_k=delta_index_k, stratum_bits=stratum_bits,
+                index_dirty_cap=delta_index_dirty_cap,
             )
             self._delta_fill_enc = HotPodBatchHost(
                 dataclasses.replace(
                     pod_spec, batch=self._delta.fill_batch
                 ),
                 table_spec, self.host.vocab, cache=self.encode_cache,
+            )
+        elif delta_index_k:
+            # Same fail-loud rationale as resolve_deltasched: an index
+            # with no delta cache would silently never engage while the
+            # run is labeled "index on".
+            raise ValueError(
+                "delta_index_k requires deltacache='on' (the candidate "
+                "index rides the delta-plane cache)"
             )
         self.key = jax.random.key(seed)
 
@@ -2993,11 +3019,41 @@ class Coordinator:
         scatter-merged into the cached planes, hashed top-k over the
         merged planes, shared greedy/commit epilogue.  Constraint state
         is untouched: delta waves carry only constraint-termless pods,
-        whose commit increments are identically zero."""
+        whose commit increments are identically zero.
+
+        Returns (table, asg, rows_dev, index_flag_dev, attempted,
+        touched): the last three feed the wave's retire-time
+        ``deltasched_index_*`` metric stamping (flag is a device scalar
+        — fetched only at _complete, never here)."""
         cache = self._delta
-        planes = cache.planes(self.host.vocab.generation())
+        gen = self.host.vocab.generation()
+        planes = cache.planes(gen)
+        index = flag = None
+        attempted = False
+        touched = (0, 0)
+        if cache.index_k:
+            index = cache.index_state(gen)
+            # Whether the in-step index update will run is a trace-time
+            # SHAPE decision inside the executable (pow2-padded dirty
+            # width vs the cap); mirror it host-side for the metric —
+            # an oversized wave runs the plane tail + rebuild, never
+            # the index tail, so it is not an "attempt".
+            dirty_w = len(plan.dirty) + sum(
+                int(w.rows_dev.shape[0]) for w in self._inflights
+            )
+            attempted = dirty_w <= cache.index_dirty_cap
+            if not attempted:
+                note_index_oversized()
+            # Touched-rows accounting for the sublinear claim (sched_bench
+            # --delta-profile): index tail visits the dirty slice plus K
+            # index entries per pod; the plane tail scans all N rows plus
+            # the dirty slice.
+            touched = (
+                dirty_w + cache.index_k * batch.batch,
+                cache.num_rows + dirty_w,
+            )
         try:
-            table, asg, rows_dev, planes = schedule_batch_delta(
+            out = schedule_batch_delta(
                 self.table, batch, subkey,
                 profile=self.profile,
                 slot_ids=jnp.asarray(plan.slot_ids),
@@ -3006,14 +3062,30 @@ class Coordinator:
                 inflight_rows=tuple(w.rows_dev for w in self._inflights),
                 chunk=self.chunk, k=self.k,
                 mesh=self.mesh, donate=self._donate,
+                backend=self.backend,
+                stratum_bits=self.stratum_bits,
+                index=index,
+                rep_idx=(
+                    jnp.asarray(plan.rep_idx) if index is not None else None
+                ),
+                rebuild_slots=(
+                    jnp.asarray(plan.rebuild_slots)
+                    if index is not None else None
+                ),
+                index_dirty_cap=cache.index_dirty_cap,
             )
         except Exception:
             # Donated buffers are in an unknown state after a failed
             # dispatch; reset fail-closed and re-raise for the breaker.
             cache.reset("dispatch-error")
             raise
-        cache.commit(planes[0], planes[1], plan)
-        return table, asg, rows_dev
+        if index is not None:
+            table, asg, rows_dev, planes, index, flag = out
+            cache.commit(planes[0], planes[1], plan, index=index)
+        else:
+            table, asg, rows_dev, planes = out
+            cache.commit(planes[0], planes[1], plan)
+        return table, asg, rows_dev, flag, attempted, touched
 
     def _launch(self, batch_pods, batch):
         """Enqueue the device step for an encoded batch (async — no
@@ -3037,16 +3109,17 @@ class Coordinator:
         delta_plan = None
         if (
             self._delta is not None
-            and self.backend == "xla"
             and sample_rows is None
             and self._row_mask_dev is None
             and profile is self.profile
             and self.table is not None
         ):
             # Delta eligibility is wave-local and conservative: only the
-            # full-scan XLA production shape reuses planes (sampled
-            # windows, degraded profiles and masked candidate views all
-            # compute DIFFERENT planes than the cache holds).
+            # full-scan production shape reuses planes (sampled windows,
+            # degraded profiles and masked candidate views all compute
+            # DIFFERENT planes than the cache holds).  Both backends
+            # qualify — the pallas delta tail (delta_plane_topk) landed
+            # with the candidate index.
             delta_plan = self._plan_delta(batch_pods, batch)
         probe_ptr = None
         if self._donate and self._donation_inplace is None:
@@ -3057,11 +3130,15 @@ class Coordinator:
                 probe_ptr = donation_probe(self.table)
             except Exception:  # graftlint: disable=broad-except (probe is evidence-only; any exotic array type just reports inplace=no)
                 self._donation_inplace = False
+        idx_flag = None
+        idx_attempted = False
+        idx_touched = (0, 0)
         with _CYCLE_TIME.time(stage="device"):
             if delta_plan is not None:
-                self.table, asg, rows_dev = self._launch_delta(
-                    batch, subkey, delta_plan
-                )
+                (
+                    self.table, asg, rows_dev,
+                    idx_flag, idx_attempted, idx_touched,
+                ) = self._launch_delta(batch, subkey, delta_plan)
             else:
                 self.table, self.constraints, asg, rows_dev = schedule_batch_packed(
                     self.table, batch, subkey,
@@ -3074,6 +3151,7 @@ class Coordinator:
                     row_mask=self._row_mask_dev,
                     mesh=self.mesh,
                     donate=self._donate,
+                    stratum_bits=self.stratum_bits,
                 )
         if probe_ptr is not None:
             try:
@@ -3103,6 +3181,9 @@ class Coordinator:
             epoch=self.host.begin_wave(),
             depth=len(self._inflights) + 1,
             path="delta" if delta_plan is not None else "full",
+            index_flag_dev=idx_flag,
+            index_attempted=idx_attempted,
+            index_touched=idx_touched,
         )
         tracer = self._tracer
         if tracer.enabled:
@@ -3360,6 +3441,14 @@ class Coordinator:
             # comes back as a single packed i32[B] (-1 = unbound).
             node_row = jax.device_get(rows_dev)
         t_sync = time.perf_counter()
+        if inflight.index_flag_dev is not None:
+            # The which-tail-ran flag is fetched at retire (the wave's
+            # sync point) so the launch path never blocks on it.
+            note_index_wave(
+                int(jax.device_get(inflight.index_flag_dev)),
+                inflight.index_attempted,
+                *inflight.index_touched,
+            )
 
         nbound = 0
         failed = np.zeros(batch.batch, bool)

@@ -79,6 +79,16 @@ class Wave:
     # ("full" vs the deltacache "delta" path).
     depth: int = 1
     path: str = "full"
+    # Candidate-index outcome (deltasched index waves only): the device
+    # i32 flag the delta step returns (1 = candidates derived from the
+    # index, 0 = the index failed closed to the plane tail), fetched at
+    # retire alongside rows_dev; ``index_attempted`` is the host-side
+    # trace decision (False = the dirty slice exceeded the in-step
+    # cap); ``index_touched`` is the (index-path, plane-path) touched-
+    # row pair for deltasched_index_touched_rows_total.
+    index_flag_dev: object | None = None
+    index_attempted: bool = False
+    index_touched: tuple = (0, 0)
 
 
 @struct.dataclass
@@ -294,6 +304,7 @@ def filter_score_topk(
     stats=None,
     row_offset=0,
     pod_offset=0,
+    stratum_bits: int = 0,
 ) -> Candidates:
     """Stream the node table in chunks, keeping each pod's top-k candidates.
 
@@ -339,7 +350,7 @@ def filter_score_topk(
             lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
             + start + row_offset
         )
-        prio = pack_hashed(score, seed, mask, pod_rows, node_cols)
+        prio = pack_hashed(score, seed, mask, pod_rows, node_cols, stratum_bits)
         top_prio, idx = chunk_topk(prio, k)                     # [B, k]
         free_cpu, free_mem, free_pods = tchunk.free()
         local = Candidates(
@@ -469,6 +480,7 @@ def _schedule_batch_impl(
     backend: str = "xla",
     with_affinity: bool = True,
     src: NodeTable | None = None,
+    stratum_bits: int = 0,
 ):
     # ``src`` (default: the table itself) is the candidate-selection view;
     # binds always commit into ``table`` — the split that makes ownership
@@ -490,11 +502,13 @@ def _schedule_batch_impl(
             src, batch, key, profile, chunk=chunk, k=k,
             with_affinity=with_affinity,
             constraints=constraints, stats=stats,
+            stratum_bits=stratum_bits,
         )
     else:
         cand = filter_score_topk(
             src, batch, key, profile,
             chunk=chunk, k=k, constraints=constraints, stats=stats,
+            stratum_bits=stratum_bits,
         )
     return finalize_batch(table, constraints, cand, commit_fields_of(batch))
 
@@ -503,6 +517,7 @@ def _schedule_batch_impl(
 def _jitted_schedule(
     profile: Profile, chunk: int, k: int, with_constraints: bool,
     backend: str = "xla", with_affinity: bool = True,
+    stratum_bits: int = 0,
 ):
     # One jax.jit function object per static configuration.  Routing every
     # configuration through a single jitted function trips a pjit fast-path
@@ -512,12 +527,12 @@ def _jitted_schedule(
     if with_constraints:
         fn = lambda table, batch, key, constraints: _schedule_batch_impl(
             table, batch, key, constraints, profile, chunk, k, backend,
-            with_affinity=with_affinity,
+            with_affinity=with_affinity, stratum_bits=stratum_bits,
         )
     else:
         fn = lambda table, batch, key: _schedule_batch_impl(
             table, batch, key, None, profile, chunk, k, backend,
-            with_affinity=with_affinity,
+            with_affinity=with_affinity, stratum_bits=stratum_bits,
         )
     # schedule_batch is the unpacked replay/test surface (differential
     # suites re-run one table); the production path is schedule_batch_
@@ -536,6 +551,7 @@ def schedule_batch(
     k: int = 4,
     backend: str = "xla",
     with_affinity: bool = True,
+    stratum_bits: int = 0,
 ):
     """Schedule one pod batch end-to-end on a single device.
 
@@ -560,7 +576,8 @@ def schedule_batch(
                 "state was passed (see ops/pallas_topk.py)"
             )
     step = _jitted_schedule(
-        profile, chunk, k, constraints is not None, backend, with_affinity
+        profile, chunk, k, constraints is not None, backend, with_affinity,
+        stratum_bits,
     )
     if constraints is None:
         table, cons, asg = step(table, batch, key)
@@ -606,7 +623,7 @@ def _jitted_schedule_packed(
     profile: Profile, chunk: int, k: int, with_constraints: bool,
     backend: str, pod_spec, table_spec, groups: frozenset,
     sample_rows: int | None, with_mask: bool = False,
-    donate: bool = False,
+    donate: bool = False, stratum_bits: int = 0,
 ):
     from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
 
@@ -622,6 +639,7 @@ def _jitted_schedule_packed(
                 table, batch, key, constraints, profile, chunk, k, backend,
                 with_affinity=aff,
                 src=None if row_mask is None else src,
+                stratum_bits=stratum_bits,
             )
         else:
             # percentageOfNodesToScore: filter+score only a rotating
@@ -655,6 +673,7 @@ def _jitted_schedule_packed(
                     view, batch, key, profile, chunk=chunk, k=k,
                     with_affinity=aff,
                     constraints=view_cons, stats=p_stats,
+                    stratum_bits=stratum_bits,
                 )
             else:
                 stats = None
@@ -675,6 +694,7 @@ def _jitted_schedule_packed(
                 cand = filter_score_topk(
                     view, batch, key, profile, chunk=chunk, k=k,
                     constraints=view_cons, stats=stats,
+                    stratum_bits=stratum_bits,
                 )
             cand = cand.replace(
                 idx=jnp.where(cand.idx >= 0, cand.idx + offset, -1)
@@ -733,6 +753,7 @@ def schedule_batch_packed(
     row_mask=None,
     mesh=None,
     donate: bool = False,
+    stratum_bits: int = 0,
 ):
     """schedule_batch over a PackedPodBatch: the pod features cross the
     host->device boundary as two buffers and the bind decision comes back
@@ -796,7 +817,7 @@ def schedule_batch_packed(
             mesh, profile, chunk=chunk, k=k,
             pod_spec=packed.spec, table_spec=packed.table_spec,
             groups=packed.groups, sample_rows=sample_rows, backend=backend,
-            donate=donate,
+            donate=donate, stratum_bits=stratum_bits,
         )
         offset = np.int32(sample_offset)
         if constraints is not None:
@@ -807,7 +828,7 @@ def schedule_batch_packed(
     step = _jitted_schedule_packed(
         profile, chunk, k, constraints is not None, backend,
         packed.spec, packed.table_spec, packed.groups, sample_rows,
-        row_mask is not None, donate,
+        row_mask is not None, donate, stratum_bits,
     )
     offset = np.int32(sample_offset)
     args = (table, packed.ints, packed.bools, key, offset)
@@ -825,7 +846,8 @@ def schedule_batch_packed(
 def _jitted_schedule_delta(
     profile: Profile, chunk: int, k: int,
     pod_spec, table_spec, groups: frozenset, n_inflight: int,
-    donate: bool = False,
+    donate: bool = False, backend: str = "xla", stratum_bits: int = 0,
+    index_k: int = 0, index_dirty_cap: int = 0,
 ):
     """The delta-wave executable: merge the dirty slice into the cached
     planes, hashed top-k over the merged planes, payload gather, shared
@@ -834,37 +856,124 @@ def _jitted_schedule_delta(
     un-dirty rows (the deltacache invalidation contract; gated by
     tests/test_deltasched.py).  Constraint state is deliberately not
     threaded: delta waves carry only constraint-termless pods, whose
-    commit increments are identically zero."""
+    commit increments are identically zero.
+
+    ``backend="pallas"`` runs the merged-plane top-k tail through the
+    fused pallas kernel (ops/pallas_topk.delta_plane_topk) — the dirty
+    gather/scatter-merge prolog is O(dirty) and stays XLA either way.
+
+    ``index_k > 0`` threads the score-stratified candidate index
+    through the step: the dirty slice updates the per-slot index
+    in-step, a device-side ``lax.cond`` on index_usable picks between
+    the O(K·batch) index tail and the O(N·batch) plane tail (which
+    rebuilds the used slots' indexes from the merged planes), and the
+    step reports which path ran as an extra i32 flag.  A dirty vector
+    wider than ``index_dirty_cap`` skips the in-step update entirely —
+    the cutoff is a trace-time SHAPE decision, so oversized waves
+    compile the plane-only variant with no dead index code."""
     from k8s1m_tpu.engine.deltacache import (
         attach_payload,
         combine_dirty,
+        dedup_rows,
+        index_topk,
+        index_usable,
         merge_dirty_planes,
         plane_topk,
+        rebuild_index,
+        update_index,
     )
     from k8s1m_tpu.snapshot.pod_encoding import unpack_pod_batch
 
     def impl(table, ints, bools, key, slot_ids, pmask, pscore, dirty,
-             *inflight):
+             *rest):
+        if index_k:
+            rep_idx, rebuild_slots, idx_row, idx_class, idx_floor = rest[:5]
+            inflight = rest[5:]
+        else:
+            inflight = rest
         batch = unpack_pod_batch(ints, bools, pod_spec, table_spec, groups)
         n = pmask.shape[1]
         rows = combine_dirty(dirty, inflight, n)
-        pmask, pscore = merge_dirty_planes(
+        pmask, pscore, mask_d, score_d = merge_dirty_planes(
             table, batch, profile, slot_ids, pmask, pscore, rows
         )
-        cand = plane_topk(
-            pmask, pscore, slot_ids, seed_of(key), chunk=chunk, k=k
-        )
+        seed = seed_of(key)
+
+        def plane_tail():
+            if backend == "pallas":
+                from k8s1m_tpu.ops.pallas_topk import delta_plane_topk
+
+                return delta_plane_topk(
+                    pmask, pscore, slot_ids, seed, chunk=chunk, k=k,
+                    stratum_bits=stratum_bits,
+                )
+            return plane_topk(
+                pmask, pscore, slot_ids, seed, chunk=chunk, k=k,
+                stratum_bits=stratum_bits,
+            )
+
+        flag = jnp.int32(0)
+        if index_k and rows.shape[0] <= index_dirty_cap:
+            rows_dd = dedup_rows(rows, n)
+            idx_row, idx_class, idx_floor = update_index(
+                idx_row, idx_class, idx_floor, rep_idx, rows_dd,
+                mask_d, score_d, n, stratum_bits=stratum_bits,
+            )
+            usable = index_usable(idx_class, idx_floor, slot_ids, k)
+
+            def from_index(state):
+                ir, ic, fl = state
+                return (
+                    index_topk(
+                        ir, ic, slot_ids, seed, k=k,
+                        stratum_bits=stratum_bits,
+                    ),
+                    ir, ic, fl,
+                )
+
+            def from_planes(state):
+                ir, ic, fl = state
+                ir, ic, fl = rebuild_index(
+                    pmask, pscore, rebuild_slots, rep_idx, ir, ic, fl,
+                    chunk=chunk, stratum_bits=stratum_bits,
+                    batch_b=slot_ids.shape[0],
+                )
+                return plane_tail(), ir, ic, fl
+
+            cand, idx_row, idx_class, idx_floor = lax.cond(
+                usable, from_index, from_planes,
+                (idx_row, idx_class, idx_floor),
+            )
+            flag = usable.astype(jnp.int32)
+        elif index_k:
+            # Oversized dirty slice: plane tail, and the used slots'
+            # indexes rebuild from the merged planes (or fail closed).
+            cand = plane_tail()
+            idx_row, idx_class, idx_floor = rebuild_index(
+                pmask, pscore, rebuild_slots, rep_idx,
+                idx_row, idx_class, idx_floor,
+                chunk=chunk, stratum_bits=stratum_bits,
+                batch_b=slot_ids.shape[0],
+            )
+        else:
+            cand = plane_tail()
         cand = attach_payload(table, cand)
         table, _cons, asg = finalize_batch(
             table, None, cand, commit_fields_of(batch)
         )
         rows_out = jnp.where(asg.bound, asg.node_row, -1).astype(jnp.int32)
+        if index_k:
+            return (table, asg, rows_out, flag, pmask, pscore,
+                    idx_row, idx_class, idx_floor)
         return table, asg, rows_out, pmask, pscore
 
     if donate:
-        # Production form: the table AND both plane buffers donate —
-        # the scatter-merge updates the cached planes in HBM in place,
-        # exactly like the wave's bind commit updates the table.
+        # Production form: the table, both plane buffers AND the index
+        # buffers donate — the scatter-merge and index update rewrite
+        # HBM in place, exactly like the wave's bind commit updates the
+        # table.
+        if index_k:
+            return jax.jit(impl, donate_argnums=(0, 5, 6, 10, 11, 12))
         return jax.jit(impl, donate_argnums=(0, 5, 6))
     return jax.jit(impl)  # graftlint: disable=undonated-device-update (replay/differential variant; production passes donate=True)
 
@@ -883,6 +992,12 @@ def schedule_batch_delta(
     k: int = 4,
     mesh=None,
     donate: bool = False,
+    backend: str = "xla",
+    stratum_bits: int = 0,
+    index=None,
+    rep_idx=None,
+    rebuild_slots=None,
+    index_dirty_cap: int = 0,
 ):
     """schedule_batch_packed's delta-wave twin (deltasched): every pod's
     feasibility/score plane is already cached, so the device step runs
@@ -896,30 +1011,56 @@ def schedule_batch_delta(
     vector and ``inflight_rows`` the unretired waves' device-resident
     ``rows_dev`` arrays — consumed on-stream, never synced to host.
 
-    Returns (new_table, Assignment, rows, new_planes).  Under ``mesh``
-    the planes must be sharded ``P(None, "sp")`` — row-sharded like
-    every packed plane — and the dirty gather stays shard-local.
+    ``index`` is the (idx_row, idx_class, idx_floor) triple from the
+    epoch-checked ``DeltaPlaneCache.index_state`` accessor (with
+    ``rep_idx``/``rebuild_slots`` from the WavePlan); when passed, the
+    wave derives candidates from the candidate index whenever it is
+    usable and the return grows to (new_table, Assignment, rows,
+    new_planes, new_index, path_flag) — ``path_flag`` an i32 device
+    scalar, 1 = index tail ran.  Without ``index`` the return stays
+    (new_table, Assignment, rows, new_planes).
+
+    Under ``mesh`` the planes must be sharded ``P(None, "sp")`` —
+    row-sharded like every packed plane — the dirty gather stays
+    shard-local, and the candidate index is unsupported (plane tail
+    only).  ``backend="pallas"`` fuses the plane tail on either step.
     """
     pmask, pscore = planes
     if mesh is not None:
+        if index is not None:
+            raise ValueError(
+                "the candidate index does not compose with mesh sharding"
+            )
         from k8s1m_tpu.parallel.sharded_cycle import make_sharded_delta_step
 
         step = make_sharded_delta_step(
             mesh, profile, chunk=chunk, k=k,
             pod_spec=packed.spec, table_spec=packed.table_spec,
             groups=packed.groups, n_inflight=len(inflight_rows),
-            donate=donate,
+            donate=donate, backend=backend, stratum_bits=stratum_bits,
         )
-    else:
-        step = _jitted_schedule_delta(
-            profile, chunk, k, packed.spec, packed.table_spec,
-            packed.groups, len(inflight_rows), donate,
+        table, asg, rows, pmask, pscore = step(
+            table, packed.ints, packed.bools, key, slot_ids, pmask,
+            pscore, dirty, *inflight_rows,
         )
-    table, asg, rows, pmask, pscore = step(
-        table, packed.ints, packed.bools, key, slot_ids, pmask, pscore,
-        dirty, *inflight_rows,
+        return table, asg, rows, (pmask, pscore)
+    index_k = 0 if index is None else index[0].shape[1]
+    step = _jitted_schedule_delta(
+        profile, chunk, k, packed.spec, packed.table_spec,
+        packed.groups, len(inflight_rows), donate, backend, stratum_bits,
+        index_k, index_dirty_cap,
     )
-    return table, asg, rows, (pmask, pscore)
+    if index is None:
+        table, asg, rows, pmask, pscore = step(
+            table, packed.ints, packed.bools, key, slot_ids, pmask,
+            pscore, dirty, *inflight_rows,
+        )
+        return table, asg, rows, (pmask, pscore)
+    table, asg, rows, flag, pmask, pscore, ir, ic, fl = step(
+        table, packed.ints, packed.bools, key, slot_ids, pmask, pscore,
+        dirty, rep_idx, rebuild_slots, *index, *inflight_rows,
+    )
+    return table, asg, rows, (pmask, pscore), (ir, ic, fl), flag
 
 
 @functools.lru_cache(maxsize=64)

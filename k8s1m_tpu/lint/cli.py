@@ -44,7 +44,10 @@ from k8s1m_tpu.lint.lockgraph import (
     write_artifact,
 )
 from k8s1m_tpu.lint.rules_clock import NoWallClock
-from k8s1m_tpu.lint.rules_deltacache import DeltaCacheEpochKeyed
+from k8s1m_tpu.lint.rules_deltacache import (
+    DeltaCacheEpochKeyed,
+    DeltaCacheIndexKeyed,
+)
 from k8s1m_tpu.lint.rules_donate import UndonatedDeviceUpdate
 from k8s1m_tpu.lint.rules_except import BroadExcept
 from k8s1m_tpu.lint.rules_fence import FencedStoreWrite
@@ -71,6 +74,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     FencedStoreWrite,
     UndonatedDeviceUpdate,
     DeltaCacheEpochKeyed,
+    DeltaCacheIndexKeyed,
     TraceLazyEmit,
     BoundedWatchBuffer,
 )

@@ -1,19 +1,26 @@
-"""deltacache-epoch-keyed: cached plane reads flow through the accessor.
+"""deltacache-epoch-keyed / deltacache-index-keyed: cached buffer reads
+flow through their accessors.
 
 The delta-plane cache (engine/deltacache.py) hands a wave HBM buffers
 that are only meaningful against the vocab generation they were filled
 at — a stale-generation plane silently encodes RETIRED interned ids
 (taint sets, selector values), and a wave that consumes one produces
 plausible-looking, wrong binds with no crash to point at the cause.
-The module therefore exposes exactly one read path,
-``DeltaPlaneCache.planes(gen)``, which raises on a generation mismatch.
+The module therefore exposes exactly one read path per buffer family:
+``DeltaPlaneCache.planes(gen)`` for the feasibility/score planes and
+``DeltaPlaneCache.index_state(gen)`` for the candidate-index triplet
+(rows / class keys / eviction floors) — both raise on a generation
+mismatch, and the index accessor is additionally the seam where the
+fail-closed floor contract lives (a raw floor read can't tell
+INDEX_FLOOR_UNBUILT from a real class key).
 
-This pass pins that contract statically: in device-step code —
+These passes pin that contract statically: in device-step code —
 ``k8s1m_tpu/engine/`` and ``k8s1m_tpu/parallel/`` — any raw read of the
-cache's plane attributes (``._mask`` / ``._score``, including their
-``__dict__[...]`` / ``getattr`` spellings) is a finding.  Only
+cache's plane attributes (``._mask`` / ``._score``) or index attributes
+(``._idx_row`` / ``._idx_class`` / ``._idx_floor``), including their
+``__dict__[...]`` / ``getattr`` spellings, is a finding.  Only
 ``engine/deltacache.py`` itself, where the buffers live and the
-accessor is defined, may touch them directly.
+accessors are defined, may touch them directly.
 
 Escape hatches (base.py): a ``# graftlint: disable=`` pragma carrying
 the reason the raw read is generation-safe, or a baseline entry.
@@ -26,63 +33,75 @@ import ast
 from k8s1m_tpu.lint.base import Finding, Rule, SourceFile
 
 _PLANE_ATTRS = {"_mask", "_score"}
+_INDEX_ATTRS = {"_idx_row", "_idx_class", "_idx_floor"}
 _SCOPED_DIRS = ("k8s1m_tpu/engine/", "k8s1m_tpu/parallel/")
 _OWNER_PATH = "k8s1m_tpu/engine/deltacache.py"
 
-_MSG = (
+_PLANE_MSG = (
     "raw read of cached plane attribute {attr!r} — delta planes must be "
     "obtained through the epoch-checked DeltaPlaneCache.planes(gen) "
     "accessor (engine/deltacache.py), never raw attribute access"
 )
+_INDEX_MSG = (
+    "raw read of candidate-index attribute {attr!r} — the index triplet "
+    "must be obtained through the epoch-checked "
+    "DeltaPlaneCache.index_state(gen) accessor (engine/deltacache.py), "
+    "never raw attribute access (a raw floor read also bypasses the "
+    "fail-closed INDEX_FLOOR_UNBUILT contract)"
+)
 
 
-def _const_plane_name(node: ast.AST) -> str | None:
-    """The plane-attribute name when ``node`` is a literal naming one."""
-    if isinstance(node, ast.Constant) and node.value in _PLANE_ATTRS:
+def _const_name(node: ast.AST, attrs: set[str]) -> str | None:
+    """The attribute name when ``node`` is a literal naming one."""
+    if isinstance(node, ast.Constant) and node.value in attrs:
         return node.value
     return None
+
+
+def _raw_attr_findings(
+    rule: Rule, f: SourceFile, attrs: set[str], msg: str
+) -> list[Finding]:
+    if f.path == _OWNER_PATH or not f.path.startswith(_SCOPED_DIRS):
+        return []
+    out: list[Finding] = []
+    for node in ast.walk(f.tree):
+        # cache._mask / cache._idx_row — reads only: an Attribute in
+        # Store context is the cache module's own state management,
+        # which cannot exist outside deltacache.py anyway, but a
+        # write through a leaked alias is equally a contract break,
+        # so flag every context.
+        if isinstance(node, ast.Attribute) and node.attr in attrs:
+            out.append(rule.finding(f, node, msg.format(attr=node.attr)))
+        # getattr(cache, "_mask") / cache.__dict__["_idx_floor"]: the
+        # dynamic spellings of the same raw read.
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Name)
+                and fn.id == "getattr"
+                and len(node.args) >= 2
+            ):
+                attr = _const_name(node.args[1], attrs)
+                if attr is not None:
+                    out.append(rule.finding(f, node, msg.format(attr=attr)))
+        elif isinstance(node, ast.Subscript):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "__dict__":
+                attr = _const_name(node.slice, attrs)
+                if attr is not None:
+                    out.append(rule.finding(f, node, msg.format(attr=attr)))
+    return out
 
 
 class DeltaCacheEpochKeyed(Rule):
     id = "deltacache-epoch-keyed"
 
     def check_file(self, f: SourceFile) -> list[Finding]:
-        if f.path == _OWNER_PATH or not f.path.startswith(_SCOPED_DIRS):
-            return []
-        out: list[Finding] = []
-        for node in ast.walk(f.tree):
-            # cache._mask / cache._score — reads only: an Attribute in
-            # Store context is the cache module's own state management,
-            # which cannot exist outside deltacache.py anyway, but a
-            # write through a leaked alias is equally a contract break,
-            # so flag every context.
-            if isinstance(node, ast.Attribute) and node.attr in _PLANE_ATTRS:
-                out.append(
-                    self.finding(f, node, _MSG.format(attr=node.attr))
-                )
-            # getattr(cache, "_mask") / cache.__dict__["_score"]: the
-            # dynamic spellings of the same raw read.
-            elif isinstance(node, ast.Call):
-                fn = node.func
-                if (
-                    isinstance(fn, ast.Name)
-                    and fn.id == "getattr"
-                    and len(node.args) >= 2
-                ):
-                    attr = _const_plane_name(node.args[1])
-                    if attr is not None:
-                        out.append(
-                            self.finding(f, node, _MSG.format(attr=attr))
-                        )
-            elif isinstance(node, ast.Subscript):
-                v = node.value
-                if (
-                    isinstance(v, ast.Attribute)
-                    and v.attr == "__dict__"
-                ):
-                    attr = _const_plane_name(node.slice)
-                    if attr is not None:
-                        out.append(
-                            self.finding(f, node, _MSG.format(attr=attr))
-                        )
-        return out
+        return _raw_attr_findings(self, f, _PLANE_ATTRS, _PLANE_MSG)
+
+
+class DeltaCacheIndexKeyed(Rule):
+    id = "deltacache-index-keyed"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        return _raw_attr_findings(self, f, _INDEX_ATTRS, _INDEX_MSG)

@@ -234,6 +234,7 @@ def make_sharded_packed_step(
     sample_rows: int | None = None,
     backend: str = "xla",
     donate: bool = False,
+    stratum_bits: int = 0,
 ):
     """The mesh analogue of engine.cycle._jitted_schedule_packed: the
     coordinator's production step — packed two-buffer pod upload,
@@ -349,12 +350,14 @@ def make_sharded_packed_step(
                 view, batch, key, profile, chunk=chunk, k=k,
                 row_offset=view_off, pod_offset=pod_offset,
                 with_affinity=aff, constraints=view_cons, stats=stats,
+                stratum_bits=stratum_bits,
             )
         else:
             cand = filter_score_topk(
                 view, batch, key, profile, chunk=chunk, k=k,
                 constraints=view_cons, stats=stats,
                 row_offset=view_off, pod_offset=pod_offset,
+                stratum_bits=stratum_bits,
             )
 
         table, cons, asg = gather_and_finalize(
@@ -427,6 +430,8 @@ def make_sharded_delta_step(
     groups: frozenset,
     n_inflight: int,
     donate: bool = False,
+    backend: str = "xla",
+    stratum_bits: int = 0,
 ):
     """The mesh twin of engine.cycle._jitted_schedule_delta: per-shard
     hashed top-k over the shard-local plane slices, shard-local dirty
@@ -487,17 +492,27 @@ def make_sharded_delta_step(
         rows = combine_dirty(dirty, inflight, n_global)
         local = rows - row_offset
         local = jnp.where((local >= 0) & (local < n_local), local, n_local)
-        pmask, pscore = merge_dirty_planes(
+        pmask, pscore, _, _ = merge_dirty_planes(
             table, full, profile, slot_ids, pmask, pscore, local
         )
 
         slot_local = lax.dynamic_slice_in_dim(
             slot_ids, dp * b_local, b_local, 0
         )
-        cand = plane_topk(
-            pmask, pscore, slot_local, seed_of(key), chunk=chunk, k=k,
-            row_offset=row_offset, pod_offset=pod_offset,
-        )
+        if backend == "pallas":
+            from k8s1m_tpu.ops.pallas_topk import delta_plane_topk
+
+            cand = delta_plane_topk(
+                pmask, pscore, slot_local, seed_of(key), chunk=chunk, k=k,
+                row_offset=row_offset, pod_offset=pod_offset,
+                stratum_bits=stratum_bits,
+            )
+        else:
+            cand = plane_topk(
+                pmask, pscore, slot_local, seed_of(key), chunk=chunk, k=k,
+                row_offset=row_offset, pod_offset=pod_offset,
+                stratum_bits=stratum_bits,
+            )
         cand = attach_payload(table, cand, row_offset=row_offset)
         table, _cons, asg = gather_and_finalize(
             table, batch, cand, None, k=k

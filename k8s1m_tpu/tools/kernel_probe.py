@@ -50,6 +50,7 @@ def profile_stages(
     chunk: int,
     k: int = 4,
     steps: int = 3,
+    repeats: int = 3,
     backend: str = "xla",
     only: set[str] | None = None,
 ) -> dict:
@@ -63,9 +64,17 @@ def profile_stages(
     slice, so the probe measures the production decode cost too); ``enc``
     is a PodBatchHost-compatible encoder sharing the table's vocab.
 
-    Returns {"backend", "ms_per_batch": {variant: ms}, "stages":
-    {plugin: ms-delta}} — deltas can be slightly negative at small
-    shapes (timing noise); they are reported raw, not clamped.
+    Each variant is timed as the MIN over ``repeats`` independent
+    ``steps``-iteration blocks: the minimum is the right estimator for
+    a deterministic program under one-sided scheduler noise, and a
+    single-block mean let a noisy ``full`` sample push knockout deltas
+    negative (the committed taint_toleration -3.524 ms/batch artifact).
+    Deltas can still dip slightly negative at tiny shapes; they are
+    reported raw, not clamped — but ``repeats`` is recorded in the
+    return so the report says how hard the noise was squeezed.
+
+    Returns {"backend", "repeats", "ms_per_batch": {variant: ms},
+    "stages": {plugin: ms-delta}}.
     """
     import functools as _ft
 
@@ -107,14 +116,20 @@ def profile_stages(
         def run(prof, i):
             return _fn(prof)(table, packed.ints, packed.bools, keys[i])
 
+    if repeats < 1:
+        raise ValueError(f"repeats must be >= 1, got {repeats}")
     for name, prof in picked.items():
         idx = run(prof, 0)
         jax.device_get(idx)      # compile + settle
-        t0 = time.perf_counter()
-        for i in range(steps):
-            idx = run(prof, i + 1)
-        jax.device_get(idx)      # the relay needs a fetch (module doc)
-        ms[name] = round((time.perf_counter() - t0) / steps * 1e3, 3)
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for i in range(steps):
+                idx = run(prof, i + 1)
+            jax.device_get(idx)  # the relay needs a fetch (module doc)
+            dt = (time.perf_counter() - t0) / steps * 1e3
+            best = dt if best is None else min(best, dt)
+        ms[name] = round(best, 3)
 
     stages: dict[str, float] = {}
     if "full" in ms:
@@ -128,7 +143,10 @@ def profile_stages(
                 stages[label] = round(ms["full"] - ms[knock], 3)
         if "filter-only" in ms:
             stages["filter_topk_floor"] = ms["filter-only"]
-    return {"backend": backend, "ms_per_batch": ms, "stages": stages}
+    return {
+        "backend": backend, "repeats": repeats,
+        "ms_per_batch": ms, "stages": stages,
+    }
 
 
 def main(argv=None):
@@ -139,6 +157,12 @@ def main(argv=None):
     ap.add_argument("--chunk", type=int, default=1 << 12)
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument(
+        "--repeats", type=int, default=3,
+        help="timing blocks per variant; min-of-repeats is reported "
+        "(one-sided noise estimator — keeps knockout deltas from going "
+        "negative when a single block catches a scheduler hiccup)",
+    )
     ap.add_argument("--only", default=None,
                     help="comma-separated variant names (default: all)")
     ap.add_argument(
@@ -184,12 +208,13 @@ def main(argv=None):
         # per variant, ~15-30s each).
         res = profile_stages(
             table, enc, chunk=args.chunk, k=args.k, steps=args.steps,
-            backend=args.backend, only={name},
+            repeats=args.repeats, backend=args.backend, only={name},
         )
         dt_ms = res["ms_per_batch"][name]
         print(json.dumps({
             "variant": name,
             "backend": args.backend,
+            "repeats": args.repeats,
             # The mode actually in effect: pack_table_auto falls back
             # to unpacked when taint_slots outgrow the meta word.
             "packing": "packed" if is_packed(table) else "off",

@@ -105,8 +105,30 @@ def parse_args(argv=None):
         "--delta-profile", action="store_true",
         help="add delta-plane-cache evidence to the report detail: "
         "delta vs full wave split, shape hit rate, mean dirty "
-        "fraction, planes resident, fills and LRU evictions "
-        "(engine/deltacache.py)",
+        "fraction, planes resident, fills and LRU evictions, and (with "
+        "--delta-index-k) the candidate-index wave/touched-rows/drop "
+        "accounting (engine/deltacache.py)",
+    )
+    ap.add_argument(
+        "--delta-index-k", type=int, default=0, metavar="K",
+        help="per-resident-plane top-K candidate index (requires "
+        "--deltacache on): all-hit waves derive candidates from the "
+        "index + dirty set and skip the O(N) plane scan entirely — "
+        "byte-identical binds, fail-closed on floor underflow.  0 "
+        "disables the index",
+    )
+    ap.add_argument(
+        "--stratum-bits", type=int, default=0, metavar="B",
+        help="high jitter bits drawn from a wave-invariant per-(node, "
+        "column) hash stratum instead of the seeded draw: splits tied "
+        "score levels so the candidate-index floor can cut inside them "
+        "(homogeneous clusters tie ~all rows at one score, which "
+        "otherwise fails the index closed every wave).  Scale it with "
+        "the cluster — per-pod spread only exists WITHIN a class, so "
+        "target ~32 tied rows per class (log2(nodes) - 5, the "
+        "megarow_drill.stratum_bits_for rule); 2^B >= nodes collapses "
+        "every wave onto the same few rows.  0 keeps the historical "
+        "jitter bit-for-bit",
     )
     ap.add_argument(
         "--shape-pool", type=int, default=0, metavar="N",
@@ -320,7 +342,7 @@ def _delta_profile_detail(args, coord) -> dict:
     misses = REGISTRY.get("deltasched_shape_misses_total").value()
     dirty = REGISTRY.get("deltasched_dirty_rows_total").value()
     rows = coord.table_spec.max_nodes
-    return {"delta_profile": {
+    detail = {"delta_profile": {
         "enabled": coord.delta_enabled,
         "delta_waves": int(delta_waves),
         "full_waves": int(full_waves),
@@ -340,6 +362,53 @@ def _delta_profile_detail(args, coord) -> dict:
             REGISTRY.get("deltasched_evictions_total").value()
         ),
     }}
+    cache = getattr(coord, "_delta", None)
+    index_k = getattr(cache, "index_k", 0) if cache is not None else 0
+    if index_k:
+        iw = REGISTRY.get("deltasched_index_waves_total")
+        touched = REGISTRY.get("deltasched_index_touched_rows_total")
+        drops = REGISTRY.get("deltasched_index_drops_total")
+        idx_waves = iw.value(path="index")
+        plane_waves = iw.value(path="plane")
+        t_idx = touched.value(path="index")
+        t_plane = touched.value(path="plane")
+        detail["delta_profile"]["index"] = {
+            "index_k": int(index_k),
+            "stratum_bits": int(cache.stratum_bits),
+            "index_waves": int(idx_waves),
+            "plane_waves": int(plane_waves),
+            # Mean rows visited per wave on each tail — the index path
+            # touches dirty + k*batch candidate rows; the plane path is
+            # the N-row chunk scan plus the dirty slice.  The fraction
+            # is the index tail's visit cost against the N rows each
+            # such wave would otherwise have scanned.
+            "mean_touched_rows": {
+                "index": (
+                    round(t_idx / idx_waves, 1) if idx_waves else None
+                ),
+                "plane": (
+                    round(t_plane / plane_waves, 1)
+                    if plane_waves else None
+                ),
+            },
+            "index_touched_fraction_of_n": (
+                round(t_idx / (idx_waves * rows), 6)
+                if idx_waves else None
+            ),
+            # Why index-eligible waves fell back to the plane scan —
+            # floor underflows vs oversized dirty sets vs wholesale
+            # invalidations (fill / generation / resync / packing).
+            "drops": {
+                r: int(drops.value(reason=r))
+                for r in (
+                    "underflow", "oversized-dirty", "fill",
+                    "generation", "resync", "packing",
+                    "fill-error", "dispatch-error",
+                )
+                if drops.value(reason=r)
+            },
+        }
+    return detail
 
 
 def _trace_detail(args, tracer) -> dict:
@@ -801,6 +870,8 @@ def main(argv=None):
         mesh=mesh if mesh is not None else "none",
         packing=args.packing,
         deltacache=args.deltacache,
+        delta_index_k=args.delta_index_k,
+        stratum_bits=args.stratum_bits,
         tracer=tracer,
     )
     t0 = time.perf_counter()

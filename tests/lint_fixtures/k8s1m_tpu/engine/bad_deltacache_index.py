@@ -1,0 +1,9 @@
+"""deltacache-index-keyed true positive: a device step reading the
+candidate-index floor straight off the cache object — a raw floor read
+can't tell INDEX_FLOOR_UNBUILT from a real class key, so a fail-closed
+slot would be consumed as if it were exhaustive."""
+
+
+def index_wave(cache, step, table, batch, key):
+    floors = cache._idx_floor
+    return step(table, batch, key, floors)

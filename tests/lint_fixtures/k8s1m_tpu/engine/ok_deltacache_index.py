@@ -1,0 +1,7 @@
+"""deltacache-index-keyed pragma twin: the same raw index read,
+suppressed with a stated reason (a teardown path that only drops the
+buffer, never hands it to a wave)."""
+
+
+def drop_index(cache):
+    cache._idx_floor = None  # graftlint: disable=deltacache-index-keyed (teardown: buffer dropped, never consumed)

@@ -36,12 +36,28 @@ import json
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from k8s1m_tpu.config import PodSpec, TableSpec
 from k8s1m_tpu.control.coordinator import Coordinator
 from k8s1m_tpu.control.objects import encode_node, encode_pod, node_key, pod_key
 from k8s1m_tpu.engine.deltacache import (
+    INDEX_FLOOR_UNBUILT,
     DeltaPlaneCache,
+    dedup_rows,
+    index_topk,
+    index_usable,
+    plane_topk,
+    rebuild_index,
     resolve_deltasched,
+    update_index,
+)
+from k8s1m_tpu.ops.priority import (
+    JITTER_BITS,
+    class_key,
+    hash_jitter,
+    pack_hashed,
+    stratum_hash,
 )
 from k8s1m_tpu.obs.metrics import REGISTRY
 from k8s1m_tpu.parallel import make_mesh
@@ -300,23 +316,28 @@ def _delta_waves():
 
 
 def _coord(store, *, delta, mesh=None, packing=None, tenancy=None,
-           spec=SPEC, pods=PODS, chunk=64, depth=3, seed=7):
+           spec=SPEC, pods=PODS, chunk=64, depth=3, seed=7,
+           backend="xla", index_k=0, stratum=0, index_dirty_cap=None):
     c = Coordinator(
         store, spec, pods, PROFILE, chunk=chunk, k=4,
         with_constraints=False, pipeline=True, depth=depth, seed=seed,
         max_attempts=8, mesh=mesh, packing=packing, tenancy=tenancy,
-        deltacache=delta,
+        deltacache=delta, backend=backend,
+        delta_index_k=index_k, stratum_bits=stratum,
+        delta_index_dirty_cap=index_dirty_cap,
     )
     c.bootstrap()
     return c
 
 
-def _drive_steady(delta):
+def _drive_steady(delta, *, backend="xla", index_k=0, stratum=0,
+                  index_dirty_cap=None):
     """Template waves at low churn: the cache's home regime."""
     with MemStore() as store:
         for i in range(250):
             put_node(store, f"n{i}", zone=f"z{i % 4}")
-        c = _coord(store, delta=delta)
+        c = _coord(store, delta=delta, backend=backend, index_k=index_k,
+                   stratum=stratum, index_dirty_cap=index_dirty_cap)
         for wave in range(6):
             for i in range(24):
                 put_pod(store, f"w{wave}-{i}")
@@ -340,7 +361,7 @@ def test_delta_coordinator_byte_identical_steady_state():
     _assert_identical(snap_d, snap_f)
 
 
-def _drive_remove_readd(delta):
+def _drive_remove_readd(delta, *, index_k=0, stratum=0):
     """Epoch edge 1: remove + re-add the SAME node name while the shape
     is plane-cached — the tombstoned row and the fresh row both ride
     the journaled dirty slice; a delta wave must neither bind the dead
@@ -349,7 +370,7 @@ def _drive_remove_readd(delta):
         for i in range(64):
             put_node(store, f"n{i}")
         put_node(store, "target", labels={"disk": "ssd"})
-        c = _coord(store, delta=delta)
+        c = _coord(store, delta=delta, index_k=index_k, stratum=stratum)
         for wave in range(2):             # promote + fill the shape
             for i in range(4):
                 put_pod(store, f"sel{wave}-{i}",
@@ -590,6 +611,371 @@ def test_delta_composed_4096_single_device_differential():
     snap_d = _drive_composed_4k(True, None, None)
     snap_f = _drive_composed_4k(False, None, None)
     _assert_identical(snap_d, snap_f)
+
+
+# ---- 6. the score-stratified candidate index (ISSUE 18) ----------------
+#
+# Same differential discipline as the plane cache above: the index is an
+# invisible replay of plane_topk, never a semantic — so the gates are
+# (a) the class_key algebra the fail-closed floor rests on, (b) unit
+# byte-identity of index_topk vs plane_topk at every width edge the
+# floor can sit on, (c) coordinator differentials with the index ON, and
+# (d) every fail-closed path counted in deltasched_index_*.
+
+
+def test_stratum_hash_bounds_and_jitter_identity():
+    cols = jnp.arange(32, dtype=jnp.int32)
+    for bad in (0, JITTER_BITS + 1, -3):
+        with pytest.raises(ValueError):
+            stratum_hash(cols, bad)
+    h = np.asarray(stratum_hash(cols, 12))
+    assert ((0 <= h) & (h < (1 << 12))).all()
+    # stratum_bits=0 is bit-identical to the historical draw.
+    seed = jnp.int32(77)
+    rows = jnp.arange(8, dtype=jnp.int32)[:, None]
+    base = hash_jitter(seed, rows, cols[None, :])
+    np.testing.assert_array_equal(
+        np.asarray(base), np.asarray(hash_jitter(seed, rows, cols[None, :], 0))
+    )
+    # Stratified draw: top bits from the column hash, low bits shared
+    # with the base draw.
+    hb = 6
+    strat = np.asarray(hash_jitter(seed, rows, cols[None, :], hb))
+    low = JITTER_BITS - hb
+    np.testing.assert_array_equal(
+        strat >> low,
+        np.broadcast_to(np.asarray(stratum_hash(cols, hb)), strat.shape),
+    )
+    np.testing.assert_array_equal(
+        strat & ((1 << low) - 1), np.asarray(base) & ((1 << low) - 1)
+    )
+
+
+def test_class_key_decomposes_packed_priority():
+    """The whole floor invariant: prio == (class << low) | low jitter
+    bits, for every (seed, pod row) — so strictly-greater class
+    dominates regardless of the wave."""
+    rng = np.random.default_rng(3)
+    scores = jnp.asarray(rng.integers(0, 2048, (4, 64)), jnp.int32)
+    mask = jnp.asarray(rng.random((4, 64)) < 0.8)
+    rows = jnp.arange(4, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(64, dtype=jnp.int32)[None, :]
+    for hb in (0, 1, 8, JITTER_BITS):
+        low = JITTER_BITS - hb
+        for seed in (0, 9, -123456):
+            s = jnp.int32(seed)
+            prio = np.asarray(pack_hashed(scores, s, mask, rows, cols, hb))
+            cls = np.asarray(class_key(scores, cols, hb))
+            j = np.asarray(hash_jitter(s, rows, cols))
+            expect = (cls.astype(np.int64) << low) | (j & ((1 << low) - 1))
+            np.testing.assert_array_equal(
+                prio, np.where(np.asarray(mask), expect, -1)
+            )
+
+
+def _build_index(scores, mask, k_idx, hb, chunk=None):
+    """Planes from per-slot score/feasibility rows, index rebuilt from
+    the planes for every slot (the plane-tail rebuild path)."""
+    pscore = jnp.asarray(scores, jnp.int32)
+    pmask = jnp.asarray(mask, jnp.bool_)
+    s, n = pscore.shape
+    ir, ic, fl = rebuild_index(
+        pmask, pscore, jnp.arange(s, dtype=jnp.int32),
+        jnp.zeros((s,), jnp.int32),
+        jnp.full((s, k_idx), n, jnp.int32),
+        jnp.full((s, k_idx), -1, jnp.int32),
+        jnp.full((s,), INDEX_FLOOR_UNBUILT, jnp.int32),
+        chunk=chunk or n, stratum_bits=hb, batch_b=1,
+    )
+    return pmask, pscore, ir, ic, fl
+
+
+def _assert_floor_invariant(pmask, pscore, ir, ic, fl, hb):
+    """Every feasible row NOT in a slot's index has class <= floor."""
+    pm, ps = np.asarray(pmask), np.asarray(pscore)
+    rows, floor = np.asarray(ir), np.asarray(fl)
+    s, n = pm.shape
+    cls = np.asarray(class_key(
+        jnp.asarray(ps), jnp.arange(n, dtype=jnp.int32)[None, :], hb
+    ))
+    for si in range(s):
+        held = {int(r) for r in rows[si] if r < n}
+        out = [c for c in range(n) if pm[si, c] and c not in held]
+        assert all(cls[si, c] <= floor[si] for c in out), si
+        # Storage order is ascending-row (the earlier-row-wins tie rule).
+        live = [int(r) for r in rows[si] if r < n]
+        assert live == sorted(live), si
+
+
+def _assert_index_matches_plane(pmask, pscore, ir, ic, slot_ids, hb, k=4):
+    """Bit-identity for REAL slots.  Padding pods (slot sentinel) are
+    excluded: plane_topk's jnp.take fills out-of-range slots while the
+    index clips — both are don't-cares (padding pods are valid-masked
+    out of finalize), so bind byte-identity never sees them."""
+    n = pmask.shape[1]
+    sl = jnp.asarray(slot_ids, jnp.int32)
+    assert (np.asarray(sl) < pmask.shape[0]).all()
+    for seed in (0, 1, 12345, -7):
+        s = jnp.int32(seed)
+        cand_i = index_topk(ir, ic, sl, s, k=k, stratum_bits=hb)
+        cand_p = plane_topk(pmask, pscore, sl, s, chunk=n, k=k,
+                            stratum_bits=hb)
+        np.testing.assert_array_equal(
+            np.asarray(cand_i.idx), np.asarray(cand_p.idx)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cand_i.prio), np.asarray(cand_p.prio)
+        )
+
+
+def test_index_equal_scores_straddling_floor_fail_closed():
+    """A homogeneous score tier wider than K: unstratified, the floor
+    equals the kept entries' class — zero strictly above, unusable
+    (this is exactly why a strict score index dies on a uniform
+    cluster).  Stratified, the same plane splits into distinct classes
+    and the index engages, byte-identical to the full scan."""
+    n, k_idx = 64, 8
+    scores = np.full((1, n), 7, np.int32)
+    mask = np.ones((1, n), bool)
+    pm, ps, ir, ic, fl = _build_index(scores, mask, k_idx, 0)
+    assert int(np.asarray(fl)[0]) == 7          # floor AT the kept class
+    assert not bool(index_usable(ic, fl, jnp.zeros(2, jnp.int32), 4))
+    _assert_floor_invariant(pm, ps, ir, ic, fl, 0)
+    hb = 12
+    pm, ps, ir, ic, fl = _build_index(scores, mask, k_idx, hb)
+    assert bool(index_usable(ic, fl, jnp.zeros(2, jnp.int32), 4))
+    _assert_floor_invariant(pm, ps, ir, ic, fl, hb)
+    _assert_index_matches_plane(pm, ps, ir, ic, [0, 0, 0], hb)
+
+
+def test_index_k_exactly_full_is_exhaustive():
+    """Exactly K feasible rows (and fewer): the spill entry is
+    infeasible, the floor stays -1, and the index IS the feasible set —
+    usable even with tied scores, padding (-1) included."""
+    n, k_idx = 32, 4
+    scores = np.zeros((2, n), np.int32)
+    mask = np.zeros((2, n), bool)
+    mask[0, [3, 9, 17, 30]] = True              # K exactly full, all tied
+    mask[1, [5, 6]] = True                      # fewer than k feasible
+    scores[0], scores[1] = 7, 9
+    pm, ps, ir, ic, fl = _build_index(scores, mask, k_idx, 0)
+    np.testing.assert_array_equal(np.asarray(fl), [-1, -1])
+    assert bool(index_usable(ic, fl, jnp.asarray([0, 1, 2], jnp.int32), 4))
+    _assert_floor_invariant(pm, ps, ir, ic, fl, 0)
+    _assert_index_matches_plane(pm, ps, ir, ic, [0, 1, 1, 0], 0)
+
+
+def test_index_dirty_row_evicts_floor_candidate():
+    """A dirty row re-scores above everything: it inserts, the old K-th
+    entry evicts, the floor rises to the evicted class — and the index
+    stays byte-identical to a plane scan of the merged planes."""
+    n, k_idx = 16, 4
+    scores = np.arange(n, dtype=np.int32)[None, :].copy()
+    mask = np.ones((1, n), bool)
+    pm, ps, ir, ic, fl = _build_index(scores, mask, k_idx, 0)
+    assert int(np.asarray(fl)[0]) == n - k_idx - 1   # best discarded
+    # Row 0 jumps to score 100; rows 2,3 go infeasible (both below the
+    # floor — invalidation only, no index change beyond their absence).
+    rows = dedup_rows(jnp.asarray([0, 2, 3, n], jnp.int32), n)
+    mask_d = jnp.asarray([[True, False, False, False]])
+    score_d = jnp.asarray([[100, 0, 0, 0]], jnp.int32)
+    ir2, ic2, fl2 = update_index(
+        ir, ic, fl, jnp.zeros(1, jnp.int32), rows, mask_d, score_d, n,
+        stratum_bits=0,
+    )
+    held = sorted(int(r) for r in np.asarray(ir2)[0] if r < n)
+    assert 0 in held                        # inserted
+    assert n - k_idx not in held            # the old floor candidate evicted
+    assert int(np.asarray(fl2)[0]) == n - k_idx      # floor rose to it
+    assert bool(index_usable(ic2, fl2, jnp.zeros(1, jnp.int32), 4))
+    # Merge the same dirty columns into the planes and cross-check.
+    ps2 = ps.at[0, jnp.asarray([0, 2, 3])].set(jnp.asarray([100, 0, 0]))
+    pm2 = pm.at[0, jnp.asarray([2, 3])].set(False)
+    _assert_floor_invariant(pm2, ps2, ir2, ic2, fl2, 0)
+    _assert_index_matches_plane(pm2, ps2, ir2, ic2, [0, 0], 0)
+
+
+def test_index_shrinks_below_k_fails_closed():
+    """Dirty rows going infeasible INSIDE the index shrink the
+    strictly-above count below k: the wave must fail closed (the floor
+    cannot lower without a rebuild)."""
+    n, k_idx = 16, 4
+    scores = np.arange(n, dtype=np.int32)[None, :].copy()
+    mask = np.ones((1, n), bool)
+    pm, ps, ir, ic, fl = _build_index(scores, mask, k_idx, 0)
+    rows = dedup_rows(jnp.asarray([n - 1, n - 2], jnp.int32), n)
+    ir2, ic2, fl2 = update_index(
+        ir, ic, fl, jnp.zeros(1, jnp.int32), rows,
+        jnp.asarray([[False, False]]), jnp.zeros((1, 2), jnp.int32), n,
+        stratum_bits=0,
+    )
+    assert not bool(index_usable(ic2, fl2, jnp.zeros(1, jnp.int32), 4))
+    # The padding slot alone never blocks.
+    assert bool(index_usable(ic2, fl2, jnp.full(3, 1, jnp.int32), 4))
+
+
+def test_index_dedup_rows_first_occurrence():
+    rows = jnp.asarray([5, 3, 5, 7, 3, 16], jnp.int32)
+    out = np.asarray(dedup_rows(rows, 16))
+    np.testing.assert_array_equal(out, [5, 3, 16, 7, 16, 16])
+
+
+def test_index_update_untouched_slots_stay():
+    """Slots without a representative this wave keep rows, classes and
+    floor byte-identical — their planes weren't merged either."""
+    n, k_idx = 16, 4
+    scores = np.stack([np.arange(n), np.arange(n)[::-1]]).astype(np.int32)
+    pm, ps, ir, ic, fl = _build_index(scores, np.ones((2, n), bool), k_idx, 0)
+    rows = dedup_rows(jnp.asarray([0, n], jnp.int32), n)
+    # Batch of one: slot 0's representative is position 0, slot 1 gets
+    # the out-of-bounds sentinel (= batch size) — unused this wave.
+    rep = jnp.asarray([0, 1], jnp.int32)
+    ir2, ic2, fl2 = update_index(
+        ir, ic, fl, rep, rows, jnp.asarray([[True, False]]),
+        jnp.asarray([[50, 0]], jnp.int32), n, stratum_bits=0,
+    )
+    np.testing.assert_array_equal(np.asarray(ir2)[1], np.asarray(ir)[1])
+    np.testing.assert_array_equal(np.asarray(ic2)[1], np.asarray(ic)[1])
+    assert int(np.asarray(fl2)[1]) == int(np.asarray(fl)[1])
+    assert 0 in set(int(r) for r in np.asarray(ir2)[0])  # slot 0 updated
+
+
+def test_index_randomized_update_differential():
+    """Property form of the edges above: random planes, random dirty
+    batches folded through update_index — whenever the index says
+    usable, its candidates are bit-identical to the plane scan; the
+    floor invariant holds throughout."""
+    rng = np.random.default_rng(18)
+    n, k_idx, s = 64, 8, 3
+    for hb in (0, 10):
+        scores = rng.integers(0, 6, (s, n)).astype(np.int32)
+        mask = rng.random((s, n)) < 0.7
+        pm, ps, ir, ic, fl = _build_index(scores, mask, k_idx, hb, chunk=16)
+        _assert_floor_invariant(pm, ps, ir, ic, fl, hb)
+        for step in range(6):
+            d = 8
+            drows = rng.choice(n, size=d, replace=False).astype(np.int32)
+            dm = rng.random((s, d)) < 0.7
+            dsc = rng.integers(0, 6, (s, d)).astype(np.int32)
+            rows = dedup_rows(jnp.asarray(drows), n)
+            ir, ic, fl = update_index(
+                ir, ic, fl, jnp.arange(s, dtype=jnp.int32), rows,
+                jnp.asarray(dm), jnp.asarray(dsc), n, stratum_bits=hb,
+            )
+            pm = pm.at[:, drows].set(jnp.asarray(dm))
+            ps = ps.at[:, drows].set(jnp.asarray(dsc))
+            _assert_floor_invariant(pm, ps, ir, ic, fl, hb)
+            slot_ids = rng.integers(0, s, 8).astype(np.int32)
+            if bool(index_usable(ic, fl, jnp.asarray(slot_ids), 4)):
+                _assert_index_matches_plane(pm, ps, ir, ic, slot_ids, hb)
+
+
+# -- coordinator differentials with the index on ------------------------
+
+
+def _index_waves(path):
+    return REGISTRY.get("deltasched_index_waves_total").value(path=path)
+
+
+def _index_drops(reason):
+    return REGISTRY.get("deltasched_index_drops_total").value(reason=reason)
+
+
+def test_index_coordinator_byte_identical_and_engages():
+    """The composed gate: index-enabled delta coordinator == full
+    recompute at the same stratum_bits, byte for byte, with the index
+    path actually taken (not silently failing closed every wave)."""
+    base = _index_waves("index")
+    snap_i = _drive_steady(True, index_k=32, stratum=12)
+    assert _index_waves("index") > base
+    snap_f = _drive_steady(False, stratum=12)
+    _assert_identical(snap_i, snap_f)
+
+
+def test_index_remove_readd_same_name_differential():
+    _assert_identical(
+        _drive_remove_readd(True, index_k=32, stratum=12),
+        _drive_remove_readd(False, stratum=12),
+    )
+
+
+def test_index_unstratified_underflow_counted():
+    """stratum_bits=0 on a homogeneous cluster: every attempted index
+    wave underflows the floor and falls to the plane tail — counted,
+    and still byte-identical (the fail-closed differential)."""
+    under = _index_drops("underflow")
+    waves = _index_waves("index")
+    snap_i = _drive_steady(True, index_k=32, stratum=0)
+    assert _index_drops("underflow") > under
+    assert _index_waves("index") == waves       # never engaged
+    _assert_identical(snap_i, _drive_steady(False))
+
+
+def test_index_oversized_dirty_counted():
+    """A dirty cap below the pipeline's in-flight row width: every
+    delta wave compiles the plane-only variant — counted as
+    oversized-dirty, byte-identity untouched."""
+    over = _index_drops("oversized-dirty")
+    snap_i = _drive_steady(True, index_k=32, stratum=12, index_dirty_cap=1)
+    assert _index_drops("oversized-dirty") > over
+    _assert_identical(snap_i, _drive_steady(False, stratum=12))
+
+
+def test_index_fill_and_drop_reasons_counted():
+    """The host-side fail-closed stamps: a fresh fill floors the slot
+    unbuilt (reason=fill); vocab-generation movement and wholesale
+    drops count under their reason labels."""
+    cache = DeltaPlaneCache(64, slots=4, index_k=8)
+    k = ("shape-a", 20, 1024)
+    cache.plan([k], 8)
+    fills = _index_drops("fill")
+    p = cache.plan([k], 8)
+    cache.note_fill(p)
+    assert _index_drops("fill") == fills + 1
+    assert int(np.asarray(cache._idx_floor)[p.fill_slots[0]]) \
+        == INDEX_FLOOR_UNBUILT
+    # plan() of an index cache carries the rep/rebuild plumbing.
+    p2 = cache.plan([k], 8)
+    assert p2.rep_idx is not None and p2.rebuild_slots is not None
+    assert p2.rep_idx[p2.slot_ids[0]] == 0
+    gen = _index_drops("generation")
+    cache.check_generation(99)
+    assert _index_drops("generation") == gen + 1
+
+
+def test_index_construction_guards():
+    with pytest.raises(ValueError, match="mesh"):
+        DeltaPlaneCache(64, slots=2, index_k=8, sharding=object())
+    with pytest.raises(ValueError, match="index_k"):
+        DeltaPlaneCache(64, slots=2, index_k=-1)
+    with MemStore() as store:
+        put_node(store, "n0")
+        with pytest.raises(ValueError, match="deltacache"):
+            Coordinator(
+                store, SPEC, PODS, PROFILE, chunk=64, k=4,
+                with_constraints=False, delta_index_k=8,
+            )
+        with pytest.raises(ValueError, match="stratum_bits"):
+            Coordinator(
+                store, SPEC, PODS, PROFILE, chunk=64, k=4,
+                with_constraints=False, stratum_bits=21,
+            )
+        with pytest.raises(ValueError, match="mesh"):
+            Coordinator(
+                store, SPEC, PODS, PROFILE, chunk=64, k=4,
+                with_constraints=False, deltacache=True,
+                delta_index_k=8, mesh=make_mesh(dp=2, sp=4),
+            )
+
+
+def test_deltacache_pallas_byte_identical():
+    """PR 12's loud failure is gone: deltacache + pallas constructs,
+    runs the fused delta tail (delta_plane_topk) on delta waves, and
+    stays byte-identical to the XLA full-recompute coordinator."""
+    base = _delta_waves()
+    snap_p = _drive_steady(True, backend="pallas")
+    assert _delta_waves() > base            # delta waves on pallas
+    _assert_identical(snap_p, _drive_steady(False))
 
 
 # ---- satellite: the bounded _empty_incs_cache -------------------------

@@ -47,6 +47,7 @@ EXPECTED = {
     "fenced-store-write": "k8s1m_tpu/control/bad_fenced_write.py",
     "undonated-device-update": "k8s1m_tpu/engine/bad_donate.py",
     "deltacache-epoch-keyed": "k8s1m_tpu/engine/bad_deltacache.py",
+    "deltacache-index-keyed": "k8s1m_tpu/engine/bad_deltacache_index.py",
     "trace-lazy-emit": "k8s1m_tpu/control/bad_trace_emit.py",
     "bounded-watch-buffer": "k8s1m_tpu/store/bad_watchbuf.py",
 }
@@ -282,7 +283,7 @@ def test_cli_entry_point_agrees():
 
 def test_cli_json_output_and_bounded_time():
     """``--json`` is the machine-readable CI shape (rule -> count ->
-    files), and the FULL run (all 14 passes, interprocedural lockgraph
+    files), and the FULL run (all 16 passes, interprocedural lockgraph
     included) stays under the 60s budget on this env — the bound that
     keeps the gate usable as a pre-commit check while the rule count
     grows."""

@@ -5,6 +5,9 @@
     python -m k8s1m_tpu.lint path/to/file.py   # lint specific files
     python -m k8s1m_tpu.lint --write-baseline  # regenerate (keeps comments out)
     python -m k8s1m_tpu.lint --json            # machine-readable report
+    python -m k8s1m_tpu.lint --jobs 4          # per-file rules across 4
+                                               # processes (byte-identical
+                                               # to --jobs 1)
     python -m k8s1m_tpu.lint --write-lockgraph # refresh artifacts/lockgraph.json
 
 Exit codes: 0 clean (every finding baselined/pragma'd), 1 new findings
@@ -22,10 +25,12 @@ rule count grows.
 from __future__ import annotations
 
 import argparse
+import concurrent.futures
 import dataclasses
 import json
 import os
 import sys
+import time
 
 from k8s1m_tpu.lint import baseline as baseline_mod
 from k8s1m_tpu.lint.base import (
@@ -43,6 +48,7 @@ from k8s1m_tpu.lint.lockgraph import (
     sanctioned,
     write_artifact,
 )
+from k8s1m_tpu.lint.rules_blocking import BlockingUnderLock
 from k8s1m_tpu.lint.rules_clock import NoWallClock
 from k8s1m_tpu.lint.rules_deltacache import (
     DeltaCacheEpochKeyed,
@@ -50,12 +56,14 @@ from k8s1m_tpu.lint.rules_deltacache import (
 )
 from k8s1m_tpu.lint.rules_donate import UndonatedDeviceUpdate
 from k8s1m_tpu.lint.rules_except import BroadExcept
+from k8s1m_tpu.lint.rules_fallback import FallbackAccounting
 from k8s1m_tpu.lint.rules_fence import FencedStoreWrite
 from k8s1m_tpu.lint.rules_guards import StaticGuardedBy
 from k8s1m_tpu.lint.rules_hotfeed import HotfeedNoPerPodPython
 from k8s1m_tpu.lint.rules_jax import HotPathHostSync, TraceTimeBranch
 from k8s1m_tpu.lint.rules_mesh import MeshPurity
 from k8s1m_tpu.lint.rules_metrics import MetricsRegistry
+from k8s1m_tpu.lint.rules_nondet import NondetToPlacement
 from k8s1m_tpu.lint.rules_retry import RetryThroughPolicy
 from k8s1m_tpu.lint.rules_trace import TraceLazyEmit
 from k8s1m_tpu.lint.rules_watchbuf import BoundedWatchBuffer
@@ -77,7 +85,13 @@ ALL_RULES: tuple[type[Rule], ...] = (
     DeltaCacheIndexKeyed,
     TraceLazyEmit,
     BoundedWatchBuffer,
+    NondetToPlacement,
+    BlockingUnderLock,
+    FallbackAccounting,
 )
+
+# --json reports carry this so consumers can gate on shape changes.
+SCHEMA_VERSION = 1
 
 # The linted slice of the repo (everything else is docs/artifacts).
 DEFAULT_SUBDIRS = ("k8s1m_tpu", "tests")
@@ -106,6 +120,40 @@ class LintResult:
     )
     # rule -> number of findings a pragma suppressed.
     pragma_counts: dict[str, int] = dataclasses.field(default_factory=dict)
+    # rule -> wall seconds spent in its hooks (summed across workers
+    # under --jobs, so it reads as cost, not as a latency breakdown).
+    rule_times: dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+def default_jobs() -> int:
+    return min(4, os.cpu_count() or 1)
+
+
+def _per_file_worker(payload):
+    """Pool worker: run the per-file rules over one chunk of files.
+
+    Findings come back tagged (rule position, file position) so the
+    parent can replay them in exactly the order a sequential run
+    produces them — byte-identical output is the contract ``--jobs``
+    is gated on in tests.
+    """
+    root, chunk, rule_classes = payload
+    instances = [cls() for cls in rule_classes]
+    out: list[tuple[int, int, Finding]] = []
+    times: dict[str, float] = {}
+    for fidx, rel in chunk:
+        f = load_file(root, rel)
+        if f is None:
+            continue
+        for ridx, rule in enumerate(instances):
+            t0 = time.perf_counter()
+            fds = rule.check_file(f)
+            times[rule.id] = (
+                times.get(rule.id, 0.0) + time.perf_counter() - t0
+            )
+            for fd in fds:
+                out.append((ridx, fidx, fd))
+    return out, times
 
 
 def run_lint(
@@ -113,11 +161,15 @@ def run_lint(
     paths: list[str] | None = None,
     baseline_path: str | None = None,
     rules: tuple[type[Rule], ...] = ALL_RULES,
+    jobs: int = 1,
 ) -> LintResult:
     """Run every pass; returns findings split against the baseline.
 
     ``baseline_path=None`` means "use <root>/lint_baseline.txt if it
     exists"; pass ``baseline_path=""`` to ignore any baseline.
+    ``jobs>1`` fans the per-file rules out over a process pool (the
+    cross-file rules stay a single pass in the parent — they need the
+    whole tree anyway); output is byte-identical to ``jobs=1``.
     """
     root = root or repo_root()
     rels = paths if paths else iter_py_files(root, DEFAULT_SUBDIRS)
@@ -161,11 +213,51 @@ def run_lint(
         if linted_set is None or fd.path in linted_set:
             findings.append(fd)
 
+    rule_times: dict[str, float] = {r.id: 0.0 for r in instances}
+    per_file = [
+        r for r in instances
+        if type(r).check_file is not Rule.check_file
+    ]
+    per_file_results: dict[int, list[tuple[int, Finding]]] = {}
+    if jobs > 1 and per_file and len(files) > 1:
+        nchunks = min(jobs, len(files))
+        chunks: list[list[tuple[int, str]]] = [[] for _ in range(nchunks)]
+        for fidx, f in enumerate(files):
+            chunks[fidx % nchunks].append((fidx, f.path))
+        rule_classes = tuple(type(r) for r in per_file)
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=nchunks
+        ) as pool:
+            for out, times in pool.map(
+                _per_file_worker,
+                [(root, chunk, rule_classes) for chunk in chunks],
+            ):
+                for rid, t in times.items():
+                    rule_times[rid] += t
+                for ridx, fidx, fd in out:
+                    per_file_results.setdefault(ridx, []).append((fidx, fd))
+        for acc in per_file_results.values():
+            acc.sort(key=lambda t: t[0])        # stable: file order, then
+    else:                                       # the rule's own order
+        for ridx, rule in enumerate(per_file):
+            acc = per_file_results.setdefault(ridx, [])
+            for fidx, f in enumerate(files):
+                t0 = time.perf_counter()
+                fds = rule.check_file(f)
+                rule_times[rule.id] += time.perf_counter() - t0
+                for fd in fds:
+                    acc.append((fidx, fd))
+
+    per_file_pos = {id(r): i for i, r in enumerate(per_file)}
     for rule in instances:
-        for f in files:
-            for fd in rule.check_file(f):
-                consider(f, fd)
-        for fd in rule.check_tree(tree_files):
+        ridx = per_file_pos.get(id(rule))
+        if ridx is not None:
+            for _fidx, fd in per_file_results.get(ridx, ()):
+                consider(by_path.get(fd.path), fd)
+        t0 = time.perf_counter()
+        tree_fds = rule.check_tree(tree_files)
+        rule_times[rule.id] += time.perf_counter() - t0
+        for fd in tree_fds:
             consider(by_path.get(fd.path), fd)
     findings.sort(key=lambda fd: (fd.path, fd.line, fd.rule))
 
@@ -202,7 +294,8 @@ def run_lint(
             entries = [e for e in entries if e[0] in linted]
     new, stale = baseline_mod.split_findings(findings, entries)
     return LintResult(
-        findings, new, stale, len(files), stale_pragmas, pragma_counts
+        findings, new, stale, len(files), stale_pragmas, pragma_counts,
+        rule_times,
     )
 
 
@@ -215,6 +308,7 @@ def _json_report(result: LintResult, check_baseline: bool) -> dict:
         if fd.path not in r["files"]:
             r["files"].append(fd.path)
     return {
+        "schema_version": SCHEMA_VERSION,
         "files": result.files,
         "new": [
             {"path": fd.path, "line": fd.line, "rule": fd.rule,
@@ -232,6 +326,10 @@ def _json_report(result: LintResult, check_baseline: bool) -> dict:
         ],
         "pragma_counts": {
             k: result.pragma_counts[k] for k in sorted(result.pragma_counts)
+        },
+        "rule_times": {
+            k: round(result.rule_times[k], 4)
+            for k in sorted(result.rule_times)
         },
     }
 
@@ -257,6 +355,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="fail on pragmas whose rule no longer fires there")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report (rule -> count -> files)")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="run per-file rules across N processes "
+                         "(default min(4, cpus); output is byte-identical "
+                         "to --jobs 1)")
     ap.add_argument("--write-lockgraph", nargs="?", const=LOCKGRAPH_ARTIFACT,
                     default=None, metavar="PATH",
                     help="write the lock acquisition-order graph artifact "
@@ -287,6 +389,7 @@ def main(argv: list[str] | None = None) -> int:
         root=args.root,
         paths=args.paths or None,
         baseline_path="" if args.no_baseline else args.baseline,
+        jobs=args.jobs if args.jobs is not None else default_jobs(),
     )
     if args.write_baseline:
         print("# graftlint baseline — one 'path|rule|fingerprint' per "

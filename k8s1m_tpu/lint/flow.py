@@ -1,0 +1,848 @@
+"""flow: the shared dataflow chassis graftlint rules are written on.
+
+Sixteen rules grew sixteen private fragments of the same machinery —
+binding collection, taint fixpoints, with-block lock context, call-graph
+walking with receiver-type inference.  This module is that machinery
+built once, so a rule states WHAT it checks (sources, sinks, guards)
+and not HOW to walk the tree.  Four layers, independent and composable:
+
+1. **Bindings + flow-insensitive taint** — ``collect_bindings`` gathers
+   every binding form (plain/aug assignment, walrus, for-targets) in
+   source order; ``taint_fixpoint`` closes a tainted-name set over them
+   against a caller-supplied source predicate and laundering predicate.
+   This is the exact engine rules_mesh.py grew for axis-index purity,
+   extracted verbatim so the migration is byte-identical.
+
+2. **CFG + dominators** — ``CFG.from_body`` builds an intraprocedural
+   control-flow graph over a statement list (branches, loops,
+   try/except/finally, with-blocks; break/continue resolved against the
+   loop stack, return/raise edges to EXIT).  ``dominators()`` answers
+   "every path to B passes A"; ``exit_reachable_avoiding`` answers
+   "can control leave this region without passing one of these
+   statements" — the two queries fail-closed accounting needs.
+
+3. **Lexical lock context** — ``walk_held`` yields every node of a
+   method with the ``with self.<lock>:`` set lexically held at it and
+   the scope it runs in (nested defs/lambdas run later, on possibly
+   another thread, so they inherit no lock context).  Extracted from
+   rules_guards.py's summarizer; rules_guards consumes it now and
+   blocking-under-lock shares it.
+
+4. **Interprocedural call graph** — ``CallGraph`` resolves intra-repo
+   calls (same-module names, ``from`` imports, ``self.`` methods with
+   base-class lookup, receiver types inferred from constructor
+   assignments, parameter annotations and one-level factory returns —
+   the lockgraph.py discipline, generalized) and answers bounded-depth
+   reachability queries: ``find_reachable`` (first node matching a
+   predicate, with the call-chain witness) and ``returns_matching``
+   (does a callee's return value derive from a source — the
+   helper-propagation half of taint).
+
+Known limits (deliberate, same family as lockgraph's): calls through
+function values don't resolve, ``super()`` chains are skipped,
+exceptions are modeled as edges from every statement of a ``try`` body
+to each handler (not per-expression), and the taint fixpoint is
+flow-insensitive — a name once tainted stays tainted for the whole
+function, which over-approximates (safe for a linter with pragmas).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from k8s1m_tpu.lint.base import (
+    SourceFile,
+    call_name,
+    walk_no_nested_functions,
+)
+
+# ---------------------------------------------------------------------------
+# layer 0: tiny shared lexical helpers
+# ---------------------------------------------------------------------------
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """'x' for a ``self.x`` attribute node, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def own_body(fn: ast.AST):
+    """Nodes of ``fn``'s own body — nested def/class bodies excluded,
+    lambdas included (value-purity properties hold across the lambda
+    boundary even though the body runs later)."""
+    return walk_no_nested_functions(fn, descend_lambdas=True)
+
+
+def mentions(node: ast.AST, names: set[str]) -> bool:
+    """Does any Name in ``node`` belong to ``names``?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# layer 1: bindings + flow-insensitive taint fixpoint
+# ---------------------------------------------------------------------------
+
+
+def collect_bindings(fn: ast.AST) -> list[tuple[ast.AST, ast.AST]]:
+    """(target, value) pairs for every binding form in ``fn``'s own
+    body, in SOURCE order (the tree walk is unordered) — plain/aug
+    assignment, walrus, and for-targets.  An ``x += tainted`` must not
+    launder, so AugAssign contributes both (target, value) and
+    (target, target)."""
+    bindings: list[tuple[ast.AST, ast.AST]] = []
+    for node in own_body(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                bindings.append((tgt, node.value))
+        elif isinstance(node, ast.AugAssign):
+            bindings.append((node.target, node.value))
+            bindings.append((node.target, node.target))
+        elif isinstance(node, ast.NamedExpr):
+            bindings.append((node.target, node.value))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bindings.append((node.target, node.iter))
+    bindings.sort(key=lambda tv: (tv[1].lineno, tv[1].col_offset))
+    return bindings
+
+
+def taint_fixpoint(
+    bindings: list[tuple[ast.AST, ast.AST]],
+    *,
+    contains_source,
+    launders=None,
+    seeds: set[str] | None = None,
+) -> set[str]:
+    """Close the tainted-name set over ``bindings`` to a fixpoint.
+
+    ``contains_source(expr)`` says an expression introduces taint on
+    its own; ``launders(expr)`` marks a value expression as a sanctioned
+    laundering point (its targets stay clean regardless of inputs);
+    ``seeds`` pre-taints names (e.g. a for-target over a set).  Chains
+    like ``idx = source(); off = idx * 128`` taint through any number
+    of intermediates, including through loops (hence the fixpoint)."""
+    tainted: set[str] = set(seeds or ())
+    changed = True
+    while changed:
+        changed = False
+        for tgt, value in bindings:
+            if launders is not None and launders(value):
+                continue
+            if contains_source(value) or mentions(value, tainted):
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name) and sub.id not in tainted:
+                        tainted.add(sub.id)
+                        changed = True
+    return tainted
+
+
+def expr_tainted(expr: ast.AST, tainted: set[str], contains_source) -> bool:
+    """Is ``expr`` tainted — directly (contains a source) or through a
+    tainted name?"""
+    return contains_source(expr) or mentions(expr, tainted)
+
+
+# ---------------------------------------------------------------------------
+# layer 1b: set-valuedness (iteration-order nondeterminism)
+# ---------------------------------------------------------------------------
+
+
+def set_locals_of(fn: ast.AST) -> set[str]:
+    """Names provably bound to set values in ``fn``'s own body."""
+    out: set[str] = set()
+    for sub in own_body(fn):
+        tgts: list[ast.AST] = []
+        value: ast.AST | None = None
+        if isinstance(sub, ast.Assign):
+            tgts, value = sub.targets, sub.value
+        elif isinstance(sub, (ast.AugAssign, ast.NamedExpr)):
+            tgts, value = [sub.target], sub.value
+        if tgts and value is not None and is_set_expr(value, out):
+            for tgt in tgts:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def is_set_expr(node: ast.AST, set_locals: set[str]) -> bool:
+    """A provably-set-valued expression (not wrapped in sorted)."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in set_locals
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in ("set", "frozenset"):
+            return True
+        # set-returning methods on a set-valued receiver
+        if name in ("union", "intersection", "difference") and isinstance(
+            node.func, ast.Attribute
+        ):
+            return is_set_expr(node.func.value, set_locals)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub)
+    ):
+        return is_set_expr(node.left, set_locals) or (
+            is_set_expr(node.right, set_locals)
+        )
+    return False
+
+
+def iterations_over_sets(fn: ast.AST) -> list[tuple[ast.AST, ast.AST]]:
+    """(iterating node, target) for every for-loop/comprehension in
+    ``fn``'s own body whose iterable is provably a set — the
+    hash-seed-ordering injection points."""
+    set_locals = set_locals_of(fn)
+    out: list[tuple[ast.AST, ast.AST]] = []
+    for sub in own_body(fn):
+        if isinstance(sub, (ast.For, ast.AsyncFor)):
+            if is_set_expr(sub.iter, set_locals):
+                out.append((sub, sub.target))
+        elif isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                              ast.GeneratorExp)):
+            for g in sub.generators:
+                if is_set_expr(g.iter, set_locals):
+                    out.append((sub, g.target))
+                    break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# layer 2: intraprocedural CFG + dominators
+# ---------------------------------------------------------------------------
+
+ENTRY = -1
+EXIT = -2
+
+
+@dataclasses.dataclass
+class _Loop:
+    header: int
+    breaks: list[int]
+
+
+class CFG:
+    """Statement-granular control-flow graph over one body.
+
+    Nodes are statements (compound statements appear as their own
+    header node; their bodies are nested statements with edges wired
+    through).  ``ENTRY``/``EXIT`` are virtual.  Return/Raise edge to
+    EXIT; break/continue resolve against the enclosing loop, or EXIT
+    when the region itself is being analyzed in isolation (a handler
+    body inside a loop the region doesn't contain).  A ``try`` body may
+    raise anywhere, modeled as edges from every body statement (and the
+    frontier entering the try) to each handler's entry."""
+
+    def __init__(self) -> None:
+        self.nodes: list[ast.stmt] = []
+        self.succ: dict[int, set[int]] = {ENTRY: set(), EXIT: set()}
+        self.pred: dict[int, set[int]] = {ENTRY: set(), EXIT: set()}
+        self._ids: dict[int, int] = {}          # id(stmt) -> node index
+
+    # -- construction ---------------------------------------------------
+
+    @classmethod
+    def from_body(cls, stmts: list[ast.stmt]) -> "CFG":
+        cfg = cls()
+        frontier = cfg._seq(stmts, {ENTRY}, [])
+        for n in frontier:
+            cfg._edge(n, EXIT)
+        return cfg
+
+    @classmethod
+    def from_function(cls, fn: ast.AST) -> "CFG":
+        return cls.from_body(list(fn.body))
+
+    def _new(self, stmt: ast.stmt) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(stmt)
+        self.succ[idx] = set()
+        self.pred[idx] = set()
+        self._ids[id(stmt)] = idx
+        return idx
+
+    def _edge(self, a: int, b: int) -> None:
+        self.succ[a].add(b)
+        self.pred[b].add(a)
+
+    def _enter(self, stmt: ast.stmt, frontier: set[int]) -> int:
+        idx = self._new(stmt)
+        for n in frontier:
+            self._edge(n, idx)
+        return idx
+
+    def _seq(
+        self, stmts: list[ast.stmt], frontier: set[int], loops: list[_Loop]
+    ) -> set[int]:
+        for stmt in stmts:
+            if not frontier:
+                # Unreachable code after return/raise/break: still give
+                # it nodes (dominator queries over it are vacuous).
+                pass
+            frontier = self._stmt(stmt, frontier, loops)
+        return frontier
+
+    def _stmt(
+        self, stmt: ast.stmt, frontier: set[int], loops: list[_Loop]
+    ) -> set[int]:
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            idx = self._enter(stmt, frontier)
+            self._edge(idx, EXIT)
+            return set()
+        if isinstance(stmt, ast.Break):
+            idx = self._enter(stmt, frontier)
+            if loops:
+                loops[-1].breaks.append(idx)
+            else:
+                self._edge(idx, EXIT)
+            return set()
+        if isinstance(stmt, ast.Continue):
+            idx = self._enter(stmt, frontier)
+            self._edge(idx, loops[-1].header) if loops else (
+                self._edge(idx, EXIT)
+            )
+            return set()
+        if isinstance(stmt, ast.If):
+            hdr = self._enter(stmt, frontier)
+            body_f = self._seq(stmt.body, {hdr}, loops)
+            if stmt.orelse:
+                else_f = self._seq(stmt.orelse, {hdr}, loops)
+                return body_f | else_f
+            return body_f | {hdr}
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            hdr = self._enter(stmt, frontier)
+            loop = _Loop(hdr, [])
+            loops.append(loop)
+            body_f = self._seq(stmt.body, {hdr}, loops)
+            loops.pop()
+            for n in body_f:
+                self._edge(n, hdr)
+            out = {hdr}
+            if stmt.orelse:
+                out = self._seq(stmt.orelse, {hdr}, loops)
+            return out | set(loop.breaks)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            hdr = self._enter(stmt, frontier)
+            return self._seq(stmt.body, {hdr}, loops)
+        if isinstance(stmt, ast.Try):
+            before = len(self.nodes)
+            body_f = self._seq(stmt.body, frontier, loops)
+            body_ids = set(range(before, len(self.nodes)))
+            out: set[int] = set()
+            for h in stmt.handlers:
+                h_hdr = self._new(h)          # the `except ...:` header
+                for n in frontier | body_ids:
+                    self._edge(n, h_hdr)
+                out |= self._seq(h.body, {h_hdr}, loops)
+            if stmt.orelse:
+                out |= self._seq(stmt.orelse, body_f, loops)
+            else:
+                out |= body_f
+            if stmt.finalbody:
+                out = self._seq(stmt.finalbody, out, loops)
+            return out
+        # Simple statement (expr, assign, nested def/class header, ...).
+        idx = self._enter(stmt, frontier)
+        return {idx}
+
+    # -- queries ---------------------------------------------------------
+
+    def node_of(self, stmt: ast.stmt) -> int | None:
+        return self._ids.get(id(stmt))
+
+    def statements(self):
+        """(index, statement) pairs — ExceptHandler headers included."""
+        return enumerate(self.nodes)
+
+    def dominators(self) -> dict[int, frozenset[int]]:
+        """node -> the set of nodes on EVERY entry path to it (itself
+        included).  Standard iterative dataflow; unreachable nodes get
+        the empty set (nothing dominates what never runs)."""
+        # Reachable set first.
+        reach: set[int] = set()
+        stack = [ENTRY]
+        while stack:
+            n = stack.pop()
+            if n in reach:
+                continue
+            reach.add(n)
+            stack.extend(self.succ.get(n, ()))
+        every = frozenset(reach)
+        dom: dict[int, frozenset[int]] = {
+            n: (frozenset({ENTRY}) if n == ENTRY else every) for n in reach
+        }
+        changed = True
+        while changed:
+            changed = False
+            for n in reach:
+                if n == ENTRY:
+                    continue
+                preds = [p for p in self.pred.get(n, ()) if p in reach]
+                new = frozenset({n}) | (
+                    frozenset.intersection(*(dom[p] for p in preds))
+                    if preds else frozenset()
+                )
+                if new != dom[n]:
+                    dom[n] = new
+                    changed = True
+        for n in set(self.succ) - reach:
+            dom[n] = frozenset()
+        return dom
+
+    def dominates(
+        self, a: int, b: int, dom: dict[int, frozenset[int]] | None = None
+    ) -> bool:
+        dom = dom if dom is not None else self.dominators()
+        return a in dom.get(b, frozenset())
+
+    def exit_reachable_avoiding(self, avoid: set[int]) -> bool:
+        """Can control flow from ENTRY to EXIT without executing any
+        node in ``avoid``?  The fail-closed query: avoid = accounting
+        statements; True means a path escapes unaccounted."""
+        seen: set[int] = set()
+        stack = [ENTRY]
+        while stack:
+            n = stack.pop()
+            if n in seen or n in avoid:
+                continue
+            if n == EXIT:
+                return True
+            seen.add(n)
+            stack.extend(self.succ.get(n, ()))
+        return False
+
+
+# ---------------------------------------------------------------------------
+# layer 3: lexical lock context (extracted from rules_guards)
+# ---------------------------------------------------------------------------
+
+
+def walk_held(fn: ast.AST, resolve=None):
+    """Yield (node, held, scope) for every node under method ``fn``.
+
+    ``held`` is the frozenset of lock attribute names lexically held
+    via ``with self.<attr>:`` at the node; ``resolve(attr)`` maps
+    aliases (``Condition(self._lock)``) onto their lock.  ``scope`` is
+    the method name, or "method.nested" inside nested defs — which
+    (with lambdas) inherit NO lock context because they run later,
+    possibly on another thread.  With-items acquire left to right: a
+    later item's context expression already runs under the earlier
+    items' locks (``with self._lock, self._reader():`` calls _reader
+    WITH _lock held).  Nested classes are a different ``self`` and are
+    skipped entirely."""
+    resolve = resolve or (lambda attr: attr)
+    name = getattr(fn, "name", "<body>")
+
+    def walk(node: ast.AST, held: frozenset, scope: str):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            yield node, held, scope
+            acquired: set[str] = set()
+            for item in node.items:
+                yield from walk(
+                    item.context_expr, held | frozenset(acquired), scope
+                )
+                attr = self_attr(item.context_expr)
+                if attr is not None:
+                    acquired.add(resolve(attr))
+            inner = held | frozenset(acquired)
+            for child in node.body:
+                yield from walk(child, inner, scope)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, held, scope
+            nested = f"{name}.{node.name}"
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, frozenset(), nested)
+            return
+        if isinstance(node, ast.Lambda):
+            yield node, held, scope
+            yield from walk(node.body, frozenset(), f"{name}.<lambda>")
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        yield node, held, scope
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, held, scope)
+
+    for child in fn.body:
+        yield from walk(child, frozenset(), name)
+
+
+def lock_attrs_of(cls: ast.ClassDef) -> tuple[dict[str, str], dict[str, str]]:
+    """(lock attrs, aliases) declared by ``self.x = threading.Lock()``
+    style assignments anywhere in the class: attr -> "Lock"/"RLock"/
+    "Condition", and alias attr -> aliased lock attr for
+    ``Condition(self._lock)``."""
+    kinds = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+    locks: dict[str, str] = {}
+    alias: dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = self_attr(node.targets[0])
+        if tgt is None or not isinstance(node.value, ast.Call):
+            continue
+        ctor = call_name(node.value)
+        if ctor not in kinds:
+            continue
+        if ctor == "Condition" and node.value.args:
+            src = self_attr(node.value.args[0])
+            if src is not None:
+                alias[tgt] = src
+                continue
+        locks[tgt] = kinds[ctor]
+    return locks, alias
+
+
+# ---------------------------------------------------------------------------
+# layer 4: interprocedural call graph
+# ---------------------------------------------------------------------------
+
+_MAX_DEPTH = 8
+
+
+@dataclasses.dataclass
+class FlowFunc:
+    key: str                        # "path::Class.meth" / "path::fn"
+    qual: str
+    path: str
+    node: ast.AST
+    cls_name: str | None
+    # resolved intra-repo calls: (callee key, line), body order
+    calls: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _FlowClass:
+    name: str
+    path: str
+    bases: list[str]
+    node: ast.ClassDef
+    attr_types: dict[str, str] = dataclasses.field(default_factory=dict)
+    methods: dict[str, FlowFunc] = dataclasses.field(default_factory=dict)
+
+
+class CallGraph:
+    """Intra-repo call resolution + bounded reachability.
+
+    The resolution discipline is lockgraph.py's, generalized: exact
+    module for imported names (a simple-name suffix match would bind
+    ``flush`` to whichever module sorts first), receiver types from
+    constructor assignments / parameter annotations / ``self.x = param``
+    through ``__init__`` annotations / one-level factory returns, and
+    method lookup over all bases (BFS ≈ MRO, exact C3 only matters when
+    two bases define the same method differently)."""
+
+    def __init__(self, files: list[SourceFile], scope: str = "k8s1m_tpu/"):
+        self.files = [f for f in files if f.path.startswith(scope)]
+        self.classes: dict[str, _FlowClass] = {}
+        self.funcs: dict[str, FlowFunc] = {}
+        self.module_types: dict[tuple[str, str], str] = {}
+        self.factories: dict[tuple[str, str], str] = {}
+        # id(ast.Call) -> resolved callee key, for rules that walk the
+        # same trees and need per-call-site resolution.
+        self.call_targets: dict[int, str] = {}
+        self._collect()
+        self._summarize()
+
+    # -- collection ------------------------------------------------------
+
+    def _collect(self) -> None:
+        for f in self.files:
+            if not isinstance(f.tree, ast.Module):
+                continue
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    c = _FlowClass(
+                        node.name, f.path,
+                        [b.id if isinstance(b, ast.Name)
+                         else getattr(b, "attr", "") for b in node.bases],
+                        node,
+                    )
+                    self._scan_attrs(c)
+                    self.classes.setdefault(node.name, c)
+                elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if isinstance(tgt, ast.Name) and isinstance(
+                        node.value, ast.Call
+                    ):
+                        ctor = call_name(node.value)
+                        if ctor is not None:
+                            self.module_types[(f.path, tgt.id)] = ctor
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Return) and isinstance(
+                            sub.value, ast.Call
+                        ):
+                            ctor = call_name(sub.value)
+                            if ctor is not None:
+                                self.factories[(f.path, node.name)] = ctor
+                                break
+
+    def _scan_attrs(self, c: _FlowClass) -> None:
+        for node in ast.walk(c.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = self_attr(node.targets[0])
+            if tgt is None:
+                continue
+            if isinstance(node.value, ast.Call):
+                ctor = call_name(node.value)
+                if ctor is not None:
+                    c.attr_types.setdefault(tgt, ctor)
+            elif isinstance(node.value, ast.Name):
+                c.attr_types.setdefault(tgt, f"<param>{node.value.id}")
+
+    @staticmethod
+    def _ann_name(ann: ast.AST | None) -> str | None:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Name):
+            return ann.id
+        if isinstance(ann, ast.Attribute):
+            return ann.attr
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return ann.value.strip().split("[")[0].split(".")[-1] or None
+        if isinstance(ann, ast.Subscript):
+            return CallGraph._ann_name(ann.value)
+        return None
+
+    def _imports_of(self, f: SourceFile) -> dict[str, tuple[str | None, str]]:
+        out: dict[str, tuple[str | None, str]] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    out[alias.asname or alias.name] = (
+                        node.module if not node.level else None,
+                        alias.name,
+                    )
+        return out
+
+    # -- resolution ------------------------------------------------------
+
+    def _method_of(self, cls: _FlowClass | None, name: str) -> FlowFunc | None:
+        queue = [cls] if cls is not None else []
+        seen: set[str] = set()
+        while queue:
+            c = queue.pop(0)
+            if c is None or c.name in seen:
+                continue
+            seen.add(c.name)
+            fn = c.methods.get(name)
+            if fn is not None:
+                return fn
+            queue.extend(
+                self.classes.get(b) for b in c.bases
+                if self.classes.get(b) is not None
+            )
+        return None
+
+    def _resolve_param_attr(
+        self, cls: _FlowClass, tname: str | None
+    ) -> str | None:
+        if tname is None or not tname.startswith("<param>"):
+            return tname
+        pname = tname[len("<param>"):]
+        for sub in cls.node.body:
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and sub.name == "__init__":
+                for a in list(sub.args.args) + list(sub.args.kwonlyargs):
+                    if a.arg == pname:
+                        return self._ann_name(a.annotation)
+        return None
+
+    def _summarize(self) -> None:
+        work: list[tuple[SourceFile, ast.AST, _FlowClass | None]] = []
+        for f in self.files:
+            if not isinstance(f.tree, ast.Module):
+                continue
+            for node in f.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    c = self.classes.get(node.name)
+                    if c is None or c.path != f.path:
+                        continue
+                    for sub in node.body:
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            fn = FlowFunc(
+                                f"{f.path}::{c.name}.{sub.name}",
+                                f"{c.name}.{sub.name}", f.path, sub, c.name,
+                            )
+                            c.methods[sub.name] = fn
+                            self.funcs[fn.key] = fn
+                            work.append((f, sub, c))
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = FlowFunc(
+                        f"{f.path}::{node.name}", node.name, f.path, node,
+                        None,
+                    )
+                    self.funcs[fn.key] = fn
+                    work.append((f, node, None))
+        imports_cache: dict[str, dict] = {}
+        for f, node, c in work:
+            if f.path not in imports_cache:
+                imports_cache[f.path] = self._imports_of(f)
+            self._summarize_func(f, node, c, imports_cache[f.path])
+
+    def _summarize_func(
+        self, f: SourceFile, fn, cls: _FlowClass | None, imports: dict
+    ) -> None:
+        out = self.funcs[
+            f"{f.path}::{cls.name}.{fn.name}" if cls else f"{f.path}::{fn.name}"
+        ]
+        local_types: dict[str, str] = {}
+        for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+            t = self._ann_name(a.annotation)
+            if t is not None:
+                local_types[a.arg] = t
+
+        def type_of(expr: ast.AST) -> str | None:
+            if isinstance(expr, ast.Name):
+                t = local_types.get(expr.id)
+                if t is not None:
+                    return t
+                return self.module_types.get((f.path, expr.id))
+            attr = self_attr(expr)
+            if attr is not None and cls is not None:
+                return self._resolve_param_attr(cls, cls.attr_types.get(attr))
+            if isinstance(expr, ast.Call):
+                ctor = call_name(expr)
+                if ctor in self.classes:
+                    return ctor
+                if ctor is not None:
+                    return self.factories.get((f.path, ctor))
+            return None
+
+        def callee_key(call: ast.Call) -> str | None:
+            fnexpr = call.func
+            if isinstance(fnexpr, ast.Name):
+                key = f"{f.path}::{fnexpr.id}"
+                if key in self.funcs:
+                    return key
+                imported = imports.get(fnexpr.id)
+                if imported is not None and imported[0] is not None:
+                    mkey = (
+                        f"{imported[0].replace('.', '/')}.py::{imported[1]}"
+                    )
+                    if mkey in self.funcs:
+                        return mkey
+                return None
+            if isinstance(fnexpr, ast.Attribute):
+                if (
+                    isinstance(fnexpr.value, ast.Name)
+                    and fnexpr.value.id == "self"
+                    and cls is not None
+                ):
+                    m = self._method_of(cls, fnexpr.attr)
+                    return m.key if m is not None else None
+                t = self.classes.get(type_of(fnexpr.value) or "")
+                if t is not None:
+                    m = self._method_of(t, fnexpr.attr)
+                    return m.key if m is not None else None
+            return None
+
+        for node in own_body(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    t = type_of(node.value)
+                    if t is not None:
+                        local_types[tgt.id] = t
+            if isinstance(node, ast.Call):
+                key = callee_key(node)
+                if key is not None:
+                    out.calls.append((key, node.lineno))
+                    self.call_targets[id(node)] = key
+
+    # -- queries ---------------------------------------------------------
+
+    def target_of(self, call: ast.Call) -> str | None:
+        """The resolved callee key of a call site, if any."""
+        return self.call_targets.get(id(call))
+
+    def find_reachable(
+        self,
+        key: str,
+        pred,
+        max_depth: int = _MAX_DEPTH,
+        _stack: frozenset = frozenset(),
+    ) -> tuple[tuple[str, ...], ast.AST] | None:
+        """First node matching ``pred(node)`` in the own-body of ``key``
+        or anything it transitively calls (bounded depth, cycle-safe).
+        Returns (call-chain witness, matching node); the chain is empty
+        for a direct hit."""
+        if max_depth < 0 or key in _stack:
+            return None
+        fn = self.funcs.get(key)
+        if fn is None:
+            return None
+        for node in own_body(fn.node):
+            if pred(node):
+                return (), node
+        stack = _stack | {key}
+        for callee, line in fn.calls:
+            got = self.find_reachable(callee, pred, max_depth - 1, stack)
+            if got is not None:
+                chain, node = got
+                step = f"{callee.split('::')[-1]} ({fn.path}:{line})"
+                return (step,) + chain, node
+        return None
+
+    def returns_matching(
+        self,
+        key: str,
+        expr_pred,
+        max_depth: int = 4,
+        _stack: frozenset = frozenset(),
+        _memo: dict | None = None,
+    ) -> bool:
+        """Does ``key`` return a value derived from an expression
+        matching ``expr_pred`` — directly, through local bindings
+        (flow-insensitive fixpoint), or through a callee that itself
+        returns-matching (bounded depth)?  The helper-propagation half
+        of source→sink taint: ``x = helper()`` taints ``x`` when
+        ``helper`` returns a tainted value."""
+        memo = _memo if _memo is not None else {}
+        if key in memo:
+            return memo[key]
+        if max_depth < 0 or key in _stack:
+            return False
+        fn = self.funcs.get(key)
+        if fn is None:
+            return False
+        stack = _stack | {key}
+
+        def contains(expr: ast.AST) -> bool:
+            for sub in ast.walk(expr):
+                if expr_pred(sub):
+                    return True
+                if isinstance(sub, ast.Call):
+                    callee = self.call_targets.get(id(sub))
+                    if callee is not None and self.returns_matching(
+                        callee, expr_pred, max_depth - 1, stack, memo
+                    ):
+                        return True
+            return False
+
+        tainted = taint_fixpoint(
+            collect_bindings(fn.node), contains_source=contains
+        )
+        result = False
+        for node in own_body(fn.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if expr_tainted(node.value, tainted, contains):
+                    result = True
+                    break
+        memo[key] = result
+        return result

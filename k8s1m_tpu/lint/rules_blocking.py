@@ -1,0 +1,168 @@
+"""blocking-under-lock: no stall-the-world call while holding a lock.
+
+A ``with self._lock:`` region in a ``@guarded_by`` class is a
+contention point by declaration: every thread the guard protects
+against will queue on it.  A device sync (``.item()``,
+``jax.device_get``, ``block_until_ready``), a sleep, socket/file I/O
+or a subprocess call inside that region turns a microsecond critical
+section into a milliseconds-to-seconds one — and every queued thread
+inherits the stall.  The runtime guard audit can't see this (it checks
+WHO holds the lock, not how long); this pass proves it at lint time,
+**interprocedurally**: a blocking call reached through the intra-repo
+call graph from inside the locked region counts, with the call chain
+printed as the witness.
+
+Severity composes with lockgraph.py: when the held lock sits on a
+committed acquisition-order edge (some path nests another lock inside
+or around it), the finding is ranked **stall-the-world** — the stall
+propagates across the lock graph, not just across one lock's waiters.
+
+What counts as blocking (deliberately conservative — named device
+syncs, ``time.sleep``, subprocess, socket verbs, ``open``): see
+``blocking_reason``.  ``Condition.wait`` does NOT count — it releases
+the lock while waiting, which is the one sanctioned way to block under
+one.  Escapes: ``# graftlint: disable=blocking-under-lock`` with the
+reason the blocking call is bounded, or a baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint import flow
+from k8s1m_tpu.lint.base import (
+    Finding,
+    Rule,
+    SourceFile,
+    call_name,
+    dotted_name,
+)
+from k8s1m_tpu.lint.lockgraph import LockModel
+from k8s1m_tpu.lint.rules_guards import _guard_map
+
+_SOCKET_VERBS = {"recv", "recv_into", "recvfrom", "sendall", "accept",
+                 "connect", "makefile"}
+_SUBPROCESS_LEAVES = {"check_output", "check_call", "communicate"}
+
+
+def blocking_reason(node: ast.AST) -> str | None:
+    """Why ``node`` blocks, else None.  Keyed on call shape only — the
+    receiver's type is not consulted, so a non-socket ``recv`` needs a
+    pragma (cheap, rare, and the pragma documents the claim)."""
+    if not isinstance(node, ast.Call):
+        return None
+    d = dotted_name(node.func)
+    leaf = call_name(node)
+    if leaf == "item" and not node.args and not node.keywords and (
+        isinstance(node.func, ast.Attribute)
+    ):
+        return "device sync .item()"
+    if leaf == "block_until_ready":
+        return "device sync block_until_ready()"
+    if leaf == "device_get":
+        return "device sync device_get()"
+    if d == "time.sleep":
+        return "time.sleep()"
+    if d is not None and (
+        d.startswith("subprocess.") or d.startswith("select.")
+    ):
+        return f"{d}()"
+    if leaf in _SUBPROCESS_LEAVES:
+        return f".{leaf}() (subprocess)"
+    if leaf in _SOCKET_VERBS:
+        return f".{leaf}() (socket I/O)"
+    if isinstance(node.func, ast.Name) and node.func.id == "open":
+        return "open() (file I/O)"
+    return None
+
+
+class BlockingUnderLock(Rule):
+    id = "blocking-under-lock"
+
+    def check_tree(self, files: list[SourceFile]) -> list[Finding]:
+        prod = [f for f in files if f.path.startswith("k8s1m_tpu/")]
+        cg = flow.CallGraph(files)
+        model = LockModel(files)
+        # Locks appearing on committed acquisition-order edges: a stall
+        # while holding one of these backs up the wider lock graph.
+        edge_locks = {e.src for e in model.edges} | {
+            e.dst for e in model.edges
+        }
+
+        out: list[Finding] = []
+        for f in prod:
+            if not isinstance(f.tree, ast.Module):
+                continue
+            for node in f.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                if _guard_map(node) is None:
+                    continue          # only @guarded_by classes declare
+                out.extend(self._check_class(f, node, cg, edge_locks))
+        out.sort(key=lambda fd: (fd.path, fd.line))
+        return out
+
+    def _check_class(
+        self, f: SourceFile, cls: ast.ClassDef, cg, edge_locks
+    ) -> list[Finding]:
+        locks, alias = flow.lock_attrs_of(cls)
+        if not locks:
+            return []
+        out: list[Finding] = []
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            seen_calls: set[int] = set()
+            for node, held, _scope in flow.walk_held(
+                meth, resolve=lambda a: alias.get(a, a)
+            ):
+                held_locks = sorted(h for h in held if h in locks)
+                if not held_locks or not isinstance(node, ast.Call):
+                    continue
+                if id(node) in seen_calls:
+                    continue
+                seen_calls.add(id(node))
+                rank = self._rank(f, cls, held_locks, edge_locks)
+                reason = blocking_reason(node)
+                if reason is not None:
+                    out.append(self.finding(
+                        f, node,
+                        f"{reason} while holding "
+                        f"self.{'/'.join(held_locks)} in "
+                        f"{cls.name}.{meth.name}{rank}; move the "
+                        f"blocking call outside the critical section "
+                        f"or pragma with the bound",
+                    ))
+                    continue
+                key = cg.target_of(node)
+                if key is None:
+                    continue
+                got = cg.find_reachable(key, blocking_reason, max_depth=6)
+                if got is not None:
+                    chain, hit = got
+                    via = " -> ".join(
+                        (key.split("::")[-1],) + chain
+                        + (f"line {hit.lineno}",)
+                    )
+                    out.append(self.finding(
+                        f, node,
+                        f"{blocking_reason(hit)} reachable via "
+                        f"[{via}] while holding "
+                        f"self.{'/'.join(held_locks)} in "
+                        f"{cls.name}.{meth.name}{rank}; hoist the "
+                        f"blocking step out of the locked region or "
+                        f"pragma with the bound",
+                    ))
+        return out
+
+    def _rank(self, f, cls, held_locks, edge_locks) -> str:
+        on_edge = [
+            a for a in held_locks
+            if f"{f.path}::{cls.name}.{a}" in edge_locks
+        ]
+        if on_edge:
+            return (
+                " [STALL-THE-WORLD: lock on committed lockgraph "
+                "acquisition edges]"
+            )
+        return ""

@@ -1,0 +1,231 @@
+"""fallback-counts-or-raises: fail-closed accounting as lint.
+
+The control plane degrades gracefully BY DESIGN — resyncs, requeues,
+cache drops, executable swaps.  The discipline that keeps graceful
+degradation diagnosable is fail-closed accounting: every fallback
+branch that diverts the production path must leave evidence — a
+registered-metric increment — or re-raise.  broad-except enforces the
+weakest form (don't swallow silently); this pass enforces the
+accounting form, on the flow.py CFG, in the dirs where a silent
+fallback corrupts the performance story rather than just the logs:
+``engine/ snapshot/ parallel/ store/``.
+
+A handler **diverts** when it exits the production path early
+(``return`` / ``continue`` / ``break``) or invokes a degradation
+helper (a call whose name contains ``fallback`` or is ``resync`` /
+``drop_all`` / ``invalidate``).  Each divert must be **dominated** by
+accounting — every path from the handler's entry to the divert passes
+a ``<METRIC>.inc(...)`` / ``.observe(...)`` on a metric variable the
+tree actually declares (the metrics-registry cross-check: an increment
+on an unknown name is not accounting, it is a typo that counts into
+the void), or a ``raise``.  For degradation-helper diverts the query
+is the dual: control must not be able to LEAVE the handler without
+passing accounting (``CFG.exit_reachable_avoiding``).
+
+Escapes: ``# graftlint: disable=fallback-counts-or-raises`` with the
+reason the divert is self-evident (e.g. the caller counts), or a
+baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint import flow
+from k8s1m_tpu.lint.base import (
+    Finding,
+    Rule,
+    SourceFile,
+    call_name,
+    dotted_name,
+    walk_no_nested_functions,
+)
+
+SCOPE_DIRS = (
+    "k8s1m_tpu/engine/", "k8s1m_tpu/snapshot/", "k8s1m_tpu/parallel/",
+    "k8s1m_tpu/store/",
+)
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "AlertingHistogram",
+                 "CallbackMetric"}
+_ACCOUNT_METHODS = {"inc", "observe", "observe_many"}
+_DEGRADE_LEAVES = {"resync", "drop_all", "invalidate"}
+
+
+def _is_metric_ctor(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name is not None and name.rsplit(".", 1)[-1] in _METRIC_CTORS
+
+
+def _divert_call(node: ast.AST) -> str | None:
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name is None:
+        return None
+    if "fallback" in name or name in _DEGRADE_LEAVES:
+        return name
+    return None
+
+
+class FallbackAccounting(Rule):
+    id = "fallback-counts-or-raises"
+
+    def check_tree(self, files: list[SourceFile]) -> list[Finding]:
+        metric_env = self._metric_vars(files)
+        out: list[Finding] = []
+        for f in files:
+            if not f.path.startswith(SCOPE_DIRS):
+                continue
+            env = metric_env.get(f.path, set())
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Try):
+                    for handler in node.handlers:
+                        out.extend(self._check_handler(f, handler, env))
+        out.sort(key=lambda fd: (fd.path, fd.line))
+        return out
+
+    # -- registered-metric environment ------------------------------------
+
+    def _metric_vars(self, files: list[SourceFile]) -> dict[str, set[str]]:
+        """path -> variable names bound (locally or by import) to a
+        metric the tree declares — the names whose ``.inc()`` counts."""
+        declared: dict[str, set[str]] = {}      # module -> vars
+        for f in files:
+            if not f.path.startswith("k8s1m_tpu/"):
+                continue
+            mod = f.path[:-3].replace("/", ".")
+            for stmt in f.tree.body if isinstance(f.tree, ast.Module) else []:
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call
+                ) and _is_metric_ctor(stmt.value):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            declared.setdefault(mod, set()).add(tgt.id)
+        out: dict[str, set[str]] = {}
+        for f in files:
+            if not f.path.startswith(SCOPE_DIRS):
+                continue
+            env = set(declared.get(f.path[:-3].replace("/", "."), ()))
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.ImportFrom) and node.module:
+                    src = declared.get(node.module)
+                    if not src:
+                        continue
+                    for alias in node.names:
+                        if alias.name in src:
+                            env.add(alias.asname or alias.name)
+            out[f.path] = env
+        return out
+
+    # -- per-handler CFG analysis -----------------------------------------
+
+    def _accounts(self, stmt: ast.stmt, env: set[str]) -> bool:
+        """Does executing ``stmt`` itself leave fail-closed evidence —
+        a raise, or an inc/observe on a declared metric variable?
+        Compound statements contribute only their HEADER expressions
+        (test/iter/items): their bodies are separate CFG nodes, and a
+        raise buried in one branch must not mark the whole header."""
+        if isinstance(stmt, ast.Raise):
+            return True
+        if isinstance(stmt, (ast.If, ast.While)):
+            roots: list[ast.AST] = [stmt.test]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            roots = [stmt.iter]
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            roots = [i.context_expr for i in stmt.items]
+        elif isinstance(stmt, (ast.Try, ast.ExceptHandler)):
+            return False
+        else:
+            roots = [stmt]
+        for root in roots:
+            for n in (root, *walk_no_nested_functions(root)):
+                if isinstance(n, ast.Raise):
+                    return True
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _ACCOUNT_METHODS
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in env
+                ):
+                    return True
+        return False
+
+    def _unregistered_incs(self, handler: ast.ExceptHandler, env) -> list[str]:
+        out = []
+        for n in walk_no_nested_functions(handler):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _ACCOUNT_METHODS
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id not in env
+            ):
+                out.append(n.func.value.id)
+        return out
+
+    def _check_handler(
+        self, f: SourceFile, handler: ast.ExceptHandler, env: set[str]
+    ) -> list[Finding]:
+        # Early exits and degradation calls in the handler's OWN body
+        # (a nested def's return is not this handler diverting).
+        exits: list[ast.stmt] = []
+        degrades: list[tuple[ast.AST, str]] = []
+        for n in walk_no_nested_functions(handler):
+            if isinstance(n, (ast.Return, ast.Continue, ast.Break)):
+                exits.append(n)
+            else:
+                name = _divert_call(n)
+                if name is not None:
+                    degrades.append((n, name))
+        if not exits and not degrades:
+            return []
+
+        cfg = flow.CFG.from_body(handler.body)
+        # A break/continue that targets a loop INSIDE the handler stays
+        # on the handler's own paths (no EXIT edge) — not a divert.
+        exits = [
+            s for s in exits
+            if isinstance(s, ast.Return)
+            or flow.EXIT in cfg.succ.get(cfg.node_of(s), ())
+        ]
+        if not exits and not degrades:
+            return []
+        accounting = {
+            idx for idx, stmt in cfg.statements()
+            if self._accounts(stmt, env)
+        }
+        dom = cfg.dominators()
+        unknown = self._unregistered_incs(handler, env)
+        hint = (
+            f" (.inc() on {sorted(set(unknown))} is not a registered "
+            f"metric — counts into the void)" if unknown else ""
+        )
+
+        out: list[Finding] = []
+        for stmt in exits:
+            idx = cfg.node_of(stmt)
+            if idx is None:
+                continue
+            if any(cfg.dominates(a, idx, dom) for a in accounting):
+                continue
+            kind = type(stmt).__name__.lower()
+            out.append(self.finding(
+                f, stmt,
+                f"fallback {kind} diverts the production path without "
+                f"fail-closed accounting{hint}; increment a registered "
+                f"metric or re-raise before diverting",
+            ))
+        if degrades and not out:
+            # Fall-through divert: the handler swaps/drops and resumes.
+            # Control must not LEAVE the handler unaccounted.
+            if cfg.exit_reachable_avoiding(accounting):
+                node, name = degrades[0]
+                out.append(self.finding(
+                    f, node,
+                    f"fallback path calls {name}() but the handler can "
+                    f"complete without fail-closed accounting{hint}; "
+                    f"increment a registered metric or re-raise",
+                ))
+        return out

@@ -42,6 +42,7 @@ import ast
 import dataclasses
 
 from k8s1m_tpu.lint.base import Finding, Rule, SourceFile, call_name
+from k8s1m_tpu.lint.flow import walk_held
 
 THREAD_OWNER_SENTINEL = "<thread-owner>"
 
@@ -159,38 +160,13 @@ class _ClassModel:
         return self.lock_alias.get(attr, attr)
 
     def _summarize_method(self, fn: ast.FunctionDef) -> None:
+        # The lexical walk (with-items acquiring left to right, nested
+        # defs/lambdas inheriting NO lock context, nested classes
+        # skipped) is flow.walk_held — extracted from the visitor this
+        # method used to carry; only the summarizing consumer remains.
         summary = _MethodSummary(fn.name, [], [], [])
         in_init = fn.name == "__init__"
-
-        def visit(node: ast.AST, held: frozenset, scope: str) -> None:
-            if isinstance(node, (ast.With, ast.AsyncWith)):
-                # Items acquire left to right: a later item's context
-                # expression (and any call in it) already runs under
-                # the earlier items' locks — `with self._lock,
-                # self._reader():` calls _reader WITH _lock held.
-                acquired: set[str] = set()
-                for item in node.items:
-                    visit(item.context_expr, held | frozenset(acquired),
-                          scope)
-                    attr = _is_self_attr(item.context_expr)
-                    if attr is not None:
-                        acquired.add(self._resolve(attr))
-                inner = held | frozenset(acquired)
-                for child in node.body:
-                    visit(child, inner, scope)
-                return
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # A nested def runs later, possibly on another thread:
-                # it inherits NO lexical lock context.
-                nested = f"{fn.name}.{node.name}"
-                for child in ast.iter_child_nodes(node):
-                    visit(child, frozenset(), nested)
-                return
-            if isinstance(node, ast.Lambda):
-                visit(node.body, frozenset(), f"{fn.name}.<lambda>")
-                return
-            if isinstance(node, ast.ClassDef):
-                return          # nested class: a different ``self``
+        for node, held, scope in walk_held(fn, resolve=self._resolve):
             if isinstance(node, ast.Call):
                 tgt = _thread_target_of(node)
                 if tgt is not None:
@@ -224,11 +200,6 @@ class _ClassModel:
                 # thread, so its writes count.
                 if write and (not in_init or scope != fn.name):
                     summary.writes.append((attr, scope, node.lineno))
-            for child in ast.iter_child_nodes(node):
-                visit(child, held, scope)
-
-        for child in fn.body:
-            visit(child, frozenset(), fn.name)
         self.methods[fn.name] = summary
 
 

@@ -42,12 +42,12 @@ from __future__ import annotations
 
 import ast
 
+from k8s1m_tpu.lint import flow
 from k8s1m_tpu.lint.base import (
     Finding,
     Rule,
     SourceFile,
     call_name as _call_name,
-    walk_no_nested_functions,
 )
 
 MESH_DIRS = ("k8s1m_tpu/parallel/", "k8s1m_tpu/ops/", "k8s1m_tpu/plugins/")
@@ -67,18 +67,15 @@ def _contains_taint_source(node: ast.AST) -> bool:
     return False
 
 
-def _mentions(node: ast.AST, names: set[str]) -> bool:
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Name) and sub.id in names:
-            return True
-    return False
+def _launders(value: ast.AST) -> bool:
+    """``mesh_offsets(...)`` is the sanctioned laundering point."""
+    return isinstance(value, ast.Call) and _call_name(value) == _BLESSED
 
 
-def _own_body(fn: ast.AST):
-    """Nodes of ``fn``'s own body: nested def/class bodies are visited
-    as functions in their own right; lambdas stay in scope (purity
-    holds across the boundary)."""
-    return walk_no_nested_functions(fn, descend_lambdas=True)
+# The binding/taint/set walking lives on the flow.py chassis now; the
+# aliases keep this module reading the way the docstring describes it.
+_own_body = flow.own_body
+_mentions = flow.mentions
 
 
 def _is_merge_path(path: str) -> bool:
@@ -113,44 +110,15 @@ class MeshPurity(Rule):
 
     def _check_mesh_func(self, f: SourceFile, fn) -> list[Finding]:
         out: list[Finding] = []
-        tainted: set[str] = set()
-
-        # Bindings in SOURCE order (the tree walk is unordered), to a
-        # fixpoint so chains like `idx = axis_index(...); off = idx *
-        # 128` taint through any number of intermediates (and loops).
-        # Every binding form counts: plain/aug assignment, walrus, and
-        # for-targets — an `off += axis_index(...)` must not launder.
-        bindings: list[tuple[ast.AST, ast.AST]] = []   # (targets, value)
-        for node in _own_body(fn):
-            if isinstance(node, ast.Assign):
-                for tgt in node.targets:
-                    bindings.append((tgt, node.value))
-            elif isinstance(node, ast.AugAssign):
-                bindings.append((node.target, node.value))
-                bindings.append((node.target, node.target))
-            elif isinstance(node, ast.NamedExpr):
-                bindings.append((node.target, node.value))
-            elif isinstance(node, (ast.For, ast.AsyncFor)):
-                bindings.append((node.target, node.iter))
-        bindings.sort(key=lambda tv: (tv[1].lineno, tv[1].col_offset))
-        changed = True
-        while changed:
-            changed = False
-            for tgt, value in bindings:
-                launders = (
-                    isinstance(value, ast.Call)
-                    and _call_name(value) == _BLESSED
-                )
-                if not launders and (
-                    _contains_taint_source(value)
-                    or _mentions(value, tainted)
-                ):
-                    for sub in ast.walk(tgt):
-                        if isinstance(sub, ast.Name) and (
-                            sub.id not in tainted
-                        ):
-                            tainted.add(sub.id)
-                            changed = True
+        # Bindings in source order, closed to a fixpoint so chains like
+        # `idx = axis_index(...); off = idx * 128` taint through any
+        # number of intermediates (and loops) — flow.py layer 1, which
+        # this rule's private engine became.
+        tainted = flow.taint_fixpoint(
+            flow.collect_bindings(fn),
+            contains_source=_contains_taint_source,
+            launders=_launders,
+        )
         for node in _own_body(fn):
             if not isinstance(node, ast.Call):
                 continue
@@ -209,55 +177,12 @@ class MeshPurity(Rule):
         for node in ast.walk(f.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            set_locals: set[str] = set()
-            for sub in _own_body(node):
-                tgts: list[ast.AST] = []
-                if isinstance(sub, ast.Assign):
-                    tgts, value = sub.targets, sub.value
-                elif isinstance(sub, (ast.AugAssign, ast.NamedExpr)):
-                    tgts, value = [sub.target], sub.value
-                if tgts and self._is_set_expr(value, set_locals):
-                    for tgt in tgts:
-                        if isinstance(tgt, ast.Name):
-                            set_locals.add(tgt.id)
-            for sub in _own_body(node):
-                iters: list[ast.AST] = []
-                if isinstance(sub, (ast.For, ast.AsyncFor)):
-                    iters.append(sub.iter)
-                elif isinstance(sub, (ast.ListComp, ast.SetComp,
-                                      ast.DictComp, ast.GeneratorExp)):
-                    iters.extend(g.iter for g in sub.generators)
-                for it in iters:
-                    if self._is_set_expr(it, set_locals):
-                        out.append(self.finding(
-                            f, sub,
-                            "iteration over a set in an encode/merge path "
-                            "feeding merge_packed byte-identity — set "
-                            "order is hash-seed-dependent; iterate "
-                            "sorted(...) or a list/dict instead",
-                        ))
-                        break
+            for sub, _target in flow.iterations_over_sets(node):
+                out.append(self.finding(
+                    f, sub,
+                    "iteration over a set in an encode/merge path "
+                    "feeding merge_packed byte-identity — set "
+                    "order is hash-seed-dependent; iterate "
+                    "sorted(...) or a list/dict instead",
+                ))
         return out
-
-    def _is_set_expr(self, node: ast.AST, set_locals: set[str]) -> bool:
-        """A provably-set-valued expression (not wrapped in sorted)."""
-        if isinstance(node, (ast.Set, ast.SetComp)):
-            return True
-        if isinstance(node, ast.Name):
-            return node.id in set_locals
-        if isinstance(node, ast.Call):
-            name = _call_name(node)
-            if name in ("set", "frozenset"):
-                return True
-            # set-returning methods on a set-valued receiver
-            if name in ("union", "intersection", "difference") and isinstance(
-                node.func, ast.Attribute
-            ):
-                return self._is_set_expr(node.func.value, set_locals)
-        if isinstance(node, ast.BinOp) and isinstance(
-            node.op, (ast.BitOr, ast.BitAnd, ast.Sub)
-        ):
-            return self._is_set_expr(node.left, set_locals) or (
-                self._is_set_expr(node.right, set_locals)
-            )
-        return False

@@ -85,6 +85,7 @@ def numeric_of(value: str) -> int:
     try:
         return int(value, 10)
     except (ValueError, TypeError):
+        # graftlint: disable=fallback-counts-or-raises (NO_NUMERIC is the defined value for non-integer labels — upstream ParseInt semantics, not a degradation; a per-label metric would tax the cold-build hot path)
         return NO_NUMERIC
 
 

@@ -64,6 +64,11 @@ _REQ_LATENCY = Histogram(
     "memstore_request_seconds", "gRPC request latency by method", ("method",)
 )
 _STORE_GAUGE = Gauge("memstore_store", "Store-level gauges by stat", ("stat",))
+_WATCH_COMPACT_CANCELS = Counter(
+    "memstore_watch_compact_cancels_total",
+    "watch creations canceled because start_revision predates the "
+    "compaction window (client must relist, reflector-on-410)", ()
+)
 # Stores served with metrics enabled; the gauge aggregates over the live
 # ones so a closed store neither pins memory nor clobbers stats.
 _SERVED_STORES: weakref.WeakSet = weakref.WeakSet()
@@ -510,6 +515,7 @@ class EtcdService:
                             prev_kv=cr.prev_kv,
                         )
                     except CompactedError as e:
+                        _WATCH_COMPACT_CANCELS.inc()
                         await out.put(
                             rpc_pb2.WatchResponse(
                                 header=self._header(),

@@ -13,7 +13,14 @@ import ctypes
 import dataclasses
 import struct
 
+from k8s1m_tpu.obs.metrics import Counter
 from k8s1m_tpu.store.build import ensure_built
+
+_RELIST_COMPACTED = Counter(
+    "memstore_relist_compacted_retries_total",
+    "pinned relist restarts after the snapshot revision fell out of the "
+    "compaction window mid-scan (the reflector-on-410 rule)", ()
+)
 
 WAL_NONE = 0
 WAL_BUFFERED = 1
@@ -470,6 +477,7 @@ def list_prefix(
         except CompactedError:
             if revision:
                 raise
+            _RELIST_COMPACTED.inc()
             continue
     raise CompactedError()
 
@@ -503,6 +511,7 @@ def list_prefix_values(store, prefix: bytes, *, page: int = 5000):
                     return out, rev
                 start = last + b"\x00"
         except CompactedError:
+            _RELIST_COMPACTED.inc()
             continue
     raise CompactedError()
 
@@ -552,6 +561,7 @@ def list_prefix_sharded(
             # The pin fell out of the store's window mid-fetch (heavy
             # write load + aggressive compaction): re-pin and restart,
             # the same reflector-on-410 rule as list_prefix.
+            _RELIST_COMPACTED.inc()
             continue
         return [kv for part in parts for kv in part], rev
     raise CompactedError()

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 
@@ -725,7 +726,7 @@ def _bench_window(args, coord, store):
             stress.terminate()
             try:
                 stress.wait(timeout=10)
-            except Exception:
+            except subprocess.TimeoutExpired:
                 stress.kill()
         coord.close()
         if hasattr(store, "close"):
@@ -768,7 +769,6 @@ def _start_watch_stress(target: str, watchers: int, write_concurrency: int):
     """Spawn the apiserver-stress equivalent against ``target`` for the
     duration of the bench window (terminated by the caller)."""
     import atexit
-    import subprocess
     import sys
 
     proc = subprocess.Popen(

@@ -313,7 +313,7 @@ def main(argv=None):
         for w in workers:
             try:
                 w.wait(timeout=15)
-            except Exception:
+            except subprocess.TimeoutExpired:
                 w.kill()
         store.close()
         if store_proc is not None:

@@ -701,7 +701,7 @@ async def amain(args) -> dict:
         for p in procs:
             try:
                 p.wait(timeout=10)
-            except Exception:
+            except subprocess.TimeoutExpired:
                 p.kill()
         import shutil
 

@@ -382,7 +382,7 @@ async def amain(args) -> dict:
         for p in tier_procs:
             try:
                 p.wait(timeout=10)
-            except Exception:
+            except subprocess.TimeoutExpired:
                 p.kill()
         await seed.close()
         wf.close()

@@ -1,0 +1,18 @@
+"""Fixture: a blocking call inside a ``with self._lock:`` region of a
+``@guarded_by`` class — the blocking-under-lock true positive."""
+import threading
+import time
+
+from k8s1m_tpu.lint import guarded_by
+
+
+@guarded_by(_items="_lock")
+class SlowStage:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def drain(self):
+        with self._lock:
+            time.sleep(0.05)
+            self._items.clear()

@@ -1,0 +1,17 @@
+"""Pragma twin: the same blocking call, suppressed with the bound."""
+import threading
+import time
+
+from k8s1m_tpu.lint import guarded_by
+
+
+@guarded_by(_items="_lock")
+class BoundedStage:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def drain(self):
+        with self._lock:
+            time.sleep(0.05)  # graftlint: disable=blocking-under-lock (fixture twin: bounded 50ms settle, callers tolerate it)
+            self._items.clear()

@@ -1,0 +1,12 @@
+"""Fixture: a nondeterministic value flowing through a local binding
+chain into a placement sink — the nondet-to-placement true positive."""
+
+
+def filter_score_topk(scores, jitter):
+    return scores[: jitter % 8]
+
+
+def pick_candidates(scores):
+    salt = id(scores) & 0xFFFF     # object identity varies per process
+    jitter = salt * 3
+    return filter_score_topk(scores, jitter)

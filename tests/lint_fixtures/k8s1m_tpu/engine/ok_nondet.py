@@ -1,0 +1,12 @@
+"""Pragma twin: the same taint chain, suppressed with a reason."""
+
+
+def filter_score_topk(scores, jitter):
+    return scores[: jitter % 8]
+
+
+def pick_candidates(scores):
+    salt = id(scores) & 0xFFFF
+    jitter = salt * 3
+    # graftlint: disable=nondet-to-placement (fixture twin: documented escape hatch)
+    return filter_score_topk(scores, jitter)

@@ -1,0 +1,9 @@
+"""Fixture: a fallback return with no accounting on its path — the
+fallback-counts-or-raises true positive."""
+
+
+def load_snapshot(decode, raw):
+    try:
+        return decode(raw)
+    except ValueError:
+        return None

@@ -50,6 +50,9 @@ EXPECTED = {
     "deltacache-index-keyed": "k8s1m_tpu/engine/bad_deltacache_index.py",
     "trace-lazy-emit": "k8s1m_tpu/control/bad_trace_emit.py",
     "bounded-watch-buffer": "k8s1m_tpu/store/bad_watchbuf.py",
+    "nondet-to-placement": "k8s1m_tpu/engine/bad_nondet.py",
+    "blocking-under-lock": "k8s1m_tpu/control/bad_blocking_lock.py",
+    "fallback-counts-or-raises": "k8s1m_tpu/store/bad_fallback.py",
 }
 
 
@@ -260,12 +263,12 @@ def test_single_file_run_ignores_unrelated_baseline_entries():
     """`tools/lint.sh path/to/file.py` must not report the whole
     baseline as stale: entries for files outside the linted subset were
     never given a chance to match."""
-    result = run_lint(paths=["k8s1m_tpu/control/coordinator.py"])
-    assert result.new == [] and result.stale == []
-    # A subset that CONTAINS a baselined file still matches its entry.
     result = run_lint(paths=["k8s1m_tpu/tools/soak.py"])
     assert result.new == [] and result.stale == []
-    assert len(result.findings) == 1          # the grandfathered swallow
+    # A subset that CONTAINS a baselined file still matches its entries.
+    result = run_lint(paths=["k8s1m_tpu/control/shardset.py"])
+    assert result.new == [] and result.stale == []
+    assert len(result.findings) == 3     # the grandfathered lease writes
 
 
 def test_cli_entry_point_agrees():
@@ -283,10 +286,11 @@ def test_cli_entry_point_agrees():
 
 def test_cli_json_output_and_bounded_time():
     """``--json`` is the machine-readable CI shape (rule -> count ->
-    files), and the FULL run (all 16 passes, interprocedural lockgraph
-    included) stays under the 60s budget on this env — the bound that
-    keeps the gate usable as a pre-commit check while the rule count
-    grows."""
+    files) with a stable ``schema_version`` and per-rule wall-time, and
+    the FULL run (all 19 passes, interprocedural lockgraph and flow
+    call graph included) stays under the 60s budget on this env — the
+    bound that keeps the gate usable as a pre-commit check while the
+    rule count grows."""
     import json
     import time
 
@@ -307,6 +311,18 @@ def test_cli_json_output_and_bounded_time():
     assert doc["stale_pragmas"] == [] and doc["stale_baseline"] == []
     assert doc["files"] > 100
     assert set(doc["pragma_counts"]) >= {"broad-except"}
+    # Schema round-trip: version pinned, every registered rule timed,
+    # and the document re-serializes to the same bytes (no NaN/inf or
+    # unstable key ordering hiding in the report).
+    from k8s1m_tpu.lint.cli import SCHEMA_VERSION
+
+    assert doc["schema_version"] == SCHEMA_VERSION
+    assert set(doc["rule_times"]) == {r.id for r in ALL_RULES}
+    assert all(
+        isinstance(v, (int, float)) and v >= 0
+        for v in doc["rule_times"].values()
+    )
+    assert json.loads(json.dumps(doc)) == doc
     # The <60s budget assumes a working core or two; an effectively-
     # 1-core host (affinity/cgroup quota — same condition the soak
     # smoke keys on) gets a proportionally relaxed bound rather than a
@@ -315,6 +331,28 @@ def test_cli_json_output_and_bounded_time():
 
     budget = 60.0 if effective_cpus() >= 2 else 240.0
     assert elapsed < budget, f"full lint took {elapsed:.1f}s (budget {budget}s)"
+
+
+def test_jobs_output_byte_identical():
+    """``--jobs N`` must be a pure speedup: the parallel run's stdout is
+    byte-for-byte the sequential run's stdout.  Exercised over the
+    fixture corpus (cheap, and every rule fires there) plus --json so
+    ordering, counts, and rule timing keys all participate."""
+
+    def run(jobs: int) -> str:
+        proc = subprocess.run(
+            [sys.executable, "-m", "k8s1m_tpu.lint", "--root", FIXTURES,
+             "--no-baseline", "--jobs", str(jobs)],
+            capture_output=True,
+            text=True,
+            cwd=repo_root(),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            timeout=180,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        return proc.stdout
+
+    assert run(1) == run(4)
 
 
 def test_changed_only_mode_smoke():

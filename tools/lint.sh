@@ -7,6 +7,14 @@
 #   tools/lint.sh --changed-only  # only files changed vs HEAD (pre-commit
 #                                 # fast path; the full-tree run stays the
 #                                 # tier-1/CI mode)
+#   tools/lint.sh --jobs 4        # per-file rules across 4 processes
+#                                 # (default min(4, cpus); output is
+#                                 # byte-identical to --jobs 1)
+#
+# The pre-commit fast path is `tools/lint.sh --changed-only` — it lints
+# just the touched files and composes with --jobs; cross-file rules
+# still see the whole tree for context, so findings don't flicker with
+# the subset.
 #
 # Exit 0 = clean (every finding fixed, pragma'd, or baselined and the
 # committed lint_baseline.txt matches the tree exactly); nonzero fails

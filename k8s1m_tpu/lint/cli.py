@@ -67,6 +67,7 @@ from k8s1m_tpu.lint.rules_nondet import NondetToPlacement
 from k8s1m_tpu.lint.rules_retry import RetryThroughPolicy
 from k8s1m_tpu.lint.rules_trace import TraceLazyEmit
 from k8s1m_tpu.lint.rules_watchbuf import BoundedWatchBuffer
+from k8s1m_tpu.lint.rules_wiretier import SharedFrameNoPerWatchEncode
 
 ALL_RULES: tuple[type[Rule], ...] = (
     HotPathHostSync,
@@ -88,6 +89,7 @@ ALL_RULES: tuple[type[Rule], ...] = (
     NondetToPlacement,
     BlockingUnderLock,
     FallbackAccounting,
+    SharedFrameNoPerWatchEncode,
 )
 
 # --json reports carry this so consumers can gate on shape changes.

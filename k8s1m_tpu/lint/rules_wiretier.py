@@ -1,0 +1,121 @@
+"""shared-frame-no-per-watch-encode: fan-out loops must not re-encode.
+
+The wiretier contract (ISSUE 20): event bytes are encoded ONCE into a
+shared frame table and fanned out by reference — per-watch work is
+index/mask selection over shared bytes, never a re-serialize.  The
+storm numbers hinge on it: one ``SerializeToString()`` inside a
+per-subscriber loop silently restores encode-bound fan-out, and the
+100K-watch drill degrades back to the ~4K events/s anchor without any
+test failing (the bytes are still correct, just 3x the CPU).
+
+This pass pins it statically: in ``k8s1m_tpu/store/``, any call to
+
+- ``SerializeToString`` / ``SerializePartialToString``, or
+- ``encode_event_batch`` (the tier's legacy per-watch response builder)
+
+lexically inside a loop or comprehension that iterates a watcher-ish
+population (an iteration source or loop target whose identifiers
+mention ``watcher``/``subscriber``/``downstream``, or are exactly
+``watchers``/``watches``/``wids``/``watch_ids``/``subscribers``/
+``peers``) is a finding.
+
+Per-watch CONTROL responses (created/canceled acks) legitimately
+serialize per watch — they are tiny, per-watch by nature, and carry no
+event payload; that is what the pragma escape is for:
+``# graftlint: disable=shared-frame-no-per-watch-encode (reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from k8s1m_tpu.lint.base import Finding, Rule, SourceFile
+
+_SCOPED_DIR = "k8s1m_tpu/store/"
+
+_BANNED = {
+    "SerializeToString",
+    "SerializePartialToString",
+    "encode_event_batch",
+}
+_SUBSTR = ("watcher", "subscriber", "downstream")
+_EXACT = {
+    "watchers", "watches", "wids", "watch_ids", "subscribers", "peers",
+}
+
+_MSG = (
+    "{name}() inside a per-watch loop in store/ — encode once into the "
+    "shared frame table (wiretier) and fan bytes out by reference; "
+    "per-watch work must be index selection, never a re-encode (pragma "
+    "the line if this is a per-watch control ack)"
+)
+
+
+def _idents(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr
+
+
+def _watcherish(node: ast.AST) -> bool:
+    for name in _idents(node):
+        low = name.lower()
+        if name in _EXACT or any(s in low for s in _SUBSTR):
+            return True
+    return False
+
+
+def _call_name(node: ast.Call) -> str | None:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+class SharedFrameNoPerWatchEncode(Rule):
+    id = "shared-frame-no-per-watch-encode"
+
+    def check_file(self, f: SourceFile) -> list[Finding]:
+        if not f.path.startswith(_SCOPED_DIR):
+            return []
+        out: list[Finding] = []
+        seen: set[tuple[int, int]] = set()
+        for node in ast.walk(f.tree):
+            srcs: list[ast.AST] | None = None
+            body: list[ast.AST] | None = None
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                srcs = [node.iter, node.target]
+                body = list(node.body)
+            elif isinstance(node, ast.While):
+                srcs = [node.test]
+                body = list(node.body)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                srcs = []
+                for g in node.generators:
+                    srcs += [g.iter, g.target]
+                if isinstance(node, ast.DictComp):
+                    body = [node.key, node.value]
+                else:
+                    body = [node.elt]
+            if srcs is None or not any(_watcherish(s) for s in srcs):
+                continue
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    name = _call_name(sub)
+                    if name not in _BANNED:
+                        continue
+                    key = (sub.lineno, sub.col_offset)
+                    if key in seen:   # nested watcher loops: report once
+                        continue
+                    seen.add(key)
+                    out.append(self.finding(
+                        f, sub, _MSG.format(name=name)
+                    ))
+        return out

@@ -17,6 +17,10 @@ reference component-for-component:
   max(store progress revision, last delivered) to close the same race the
   reference closes (watch_service.rs:172-176); a compacted start revision
   yields a response with ``compact_revision`` set (watch_service.rs:63-75).
+  Event frames are composed from a per-stream shared chunk table
+  (store/wiretier.py): an event fanning to several watches on one
+  stream is proto-encoded once, and the composed bytes are
+  byte-identical to the constructor path they replaced.
 - **Lease is deliberately fake**: LeaseGrant returns an incrementing id
   and TTLs never expire — Kubernetes only uses etcd leases for Event TTLs
   (reference lease_service.rs:33-137, README.adoc:266-311).
@@ -40,11 +44,13 @@ import grpc
 from grpc import aio
 
 from k8s1m_tpu.obs.metrics import CallbackMetric, Counter, Gauge, Histogram
+from k8s1m_tpu.store import wiretier
 from k8s1m_tpu.store.native import (
     CompactedError,
     FutureRevError,
     KeyValue,
     MemStore,
+    WatchEvent,
     Watcher,
 )
 from k8s1m_tpu.store.proto import batch_pb2, mvcc_pb2, rpc_pb2
@@ -156,6 +162,22 @@ def _kv_to_pb(kv: KeyValue) -> mvcc_pb2.KeyValue:
         version=kv.version,
         lease=kv.lease,
     )
+
+
+def _encode_watch_event(ev: WatchEvent) -> bytes:
+    """One native watch event as WatchResponse.events chunk bytes —
+    byte-identical to the events.add()/CopyFrom path it replaced
+    (protobuf serializes known fields in tag order)."""
+    pb = mvcc_pb2.Event(
+        type=(
+            mvcc_pb2.Event.DELETE if ev.type == "DELETE"
+            else mvcc_pb2.Event.PUT
+        ),
+        kv=_kv_to_pb(ev.kv),
+    )
+    if ev.prev_kv is not None:
+        pb.prev_kv.CopyFrom(_kv_to_pb(ev.prev_kv))
+    return wiretier.event_chunk(pb.SerializeToString())
 
 
 class EtcdService:
@@ -426,6 +448,10 @@ class EtcdService:
         # client has seen everything at or below its revision).
         cleared: dict[int, int] = {}
         barriers: set = set()
+        # Per-stream shared frame table (wiretier): an event fanning to
+        # several watches on this stream is proto-encoded once, keyed
+        # by its identity (prev_kv requests encode differently).
+        ftable = wiretier.FrameTable(cap=4096)
 
         async def pump(wid: int, w: Watcher):
             nonlocal last_delivered
@@ -466,21 +492,22 @@ class EtcdService:
                             cleared[wid] = r0
                         await asyncio.sleep(_WATCH_POLL_S)
                         continue
-                    resp = rpc_pb2.WatchResponse(
-                        header=self._header(), watch_id=wid
-                    )
-                    for ev in events:
-                        pb = resp.events.add()
-                        pb.type = (
-                            mvcc_pb2.Event.DELETE
-                            if ev.type == "DELETE"
-                            else mvcc_pb2.Event.PUT
+                    chunks = [
+                        ftable.bytes_for(
+                            (ev.kv.mod_revision, ev.kv.key, ev.type,
+                             ev.prev_kv is not None),
+                            _encode_watch_event, ev,
                         )
-                        pb.kv.CopyFrom(_kv_to_pb(ev.kv))
-                        if ev.prev_kv is not None:
-                            pb.prev_kv.CopyFrom(_kv_to_pb(ev.prev_kv))
+                        for ev in events
+                    ]
+                    for ev in events:
                         last_delivered = max(last_delivered, ev.kv.mod_revision)
-                    await out.put(resp)
+                    await out.put(
+                        wiretier.compose_frame(
+                            wiretier.header_bytes(self._header()),
+                            [wid], chunks,
+                        )
+                    )
                     if cleared.get(wid, 0) < events[-1].kv.mod_revision:
                         cleared[wid] = events[-1].kv.mod_revision
             except asyncio.CancelledError:
@@ -700,7 +727,13 @@ def add_services(server: aio.Server, svc: EtcdService) -> None:
         "Compact": _unary(svc.Compact, pb.CompactionRequest, pb.CompactionResponse),
     }
     watch = {
-        "Watch": _stream_stream(svc.Watch, pb.WatchRequest, pb.WatchResponse),
+        # Event frames leave the pumps pre-composed (wiretier shared
+        # chunk bytes); control responses stay proto objects.
+        "Watch": grpc.stream_stream_rpc_method_handler(
+            svc.Watch,
+            request_deserializer=pb.WatchRequest.FromString,
+            response_serializer=wiretier.serialize_frame_or_message,
+        ),
     }
     lease = {
         "LeaseGrant": _unary(svc.LeaseGrant, pb.LeaseGrantRequest, pb.LeaseGrantResponse),

@@ -190,6 +190,72 @@ def _parse_kv(buf: memoryview, off: int) -> tuple[KeyValue, int]:
     return KeyValue(key, val, crev, mrev, ver, lease), off
 
 
+def read_varint(buf: bytes, off: int) -> tuple[int, int]:
+    """(value, next_offset) of the protobuf varint at ``off``."""
+    val = 0
+    shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, off
+        shift += 7
+
+
+def decode_shared_tail(data: bytes) -> tuple[list[int], int, int]:
+    """Decode the wiretier shared-frame extension of one serialized
+    WatchResponse (store/wiretier.py): trailing private fields 100
+    (repeated varint — the EXTRA watch ids sharing this frame's bytes)
+    and 101 (varint — a compacted frame's window lower bound).
+
+    Returns ``(extra_wids, from_rev, core_len)`` where ``core_len`` is
+    the byte length of the frame up to the first extension field — i.e.
+    the exact unshared single-watch response the primary id would have
+    received, the quantity the storm drill's bytes accounting compares
+    against.  A frame without the extension returns
+    ``([], 0, len(data))``, so callers can run this unconditionally.
+
+    This is a top-level field scan, not a parse: a WatchResponse is a
+    handful of top-level fields however many events it carries, and
+    protobuf framing lets every non-matching field be skipped by
+    length.  No protobuf dependency — this is the wire client's side of
+    the contract, next to the store's other frame codecs.
+    """
+    wids: list[int] = []
+    from_rev = 0
+    core = len(data)
+    off = 0
+    n = len(data)
+    while off < n:
+        at = off
+        key, off = read_varint(data, off)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, off = read_varint(data, off)
+        elif wt == 2:
+            ln, off = read_varint(data, off)
+            off += ln
+            val = 0
+        elif wt == 5:
+            off += 4
+            val = 0
+        elif wt == 1:
+            off += 8
+            val = 0
+        else:
+            break   # start/end-group or junk: nothing of ours follows
+        if wt == 0 and field == 100:
+            wids.append(val)
+            if at < core:
+                core = at
+        elif wt == 0 and field == 101:
+            from_rev = val
+            if at < core:
+                core = at
+    return wids, from_rev, core
+
+
 def _load_lib():
     lib = ctypes.CDLL(ensure_built())
     c = ctypes

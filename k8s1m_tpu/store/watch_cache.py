@@ -56,6 +56,25 @@ detonating:
   head-of-line-blocking every watcher, and a 100K-watch stream costs N
   tasks, not 100K.
 
+- **Shared-frame wire encoding (wiretier, ISSUE 20).**  Fan-out used
+  to re-encode every batch per watch id; the pump sweeps now group
+  watchers owing identical batches (equal ``CacheEvent.seq`` tuples),
+  fetch each event's chunk bytes once from a tier-level
+  ``wiretier.FrameTable``, and ship ONE composed frame per group with
+  the extra watch ids riding a trailing extension — encode CPU scales
+  with frames, not fan-out degree, and wire bytes drop by the realized
+  sharing degree.  A coalesced drain additionally declares its
+  compacted [from_rev, to_rev] window on the wire.  Per-watch streams
+  stay byte-identical to the unshared encoding (the
+  tests/test_watch_cache.py wiretier differentials).
+
+- **Replica warm restart (``--resume-floor``).**  A relaunched fleet
+  replica primes at the current revision, catch-up loads
+  (floor, prime_rev] into the history window from store history before
+  binding its port, and the dead instance's clients re-attach with
+  their own start_revision and RESUME (``watchcache_resumes_total``)
+  instead of relisting — see ``run_upstream``.
+
 - **Faultline hooks** ``watch.tier/pump.stall`` and
   ``watch.tier/subscriber.send`` (plus the existing ``upstream.recv``)
   make all three failure modes injectable by seed — the watchstorm
@@ -73,6 +92,7 @@ import dataclasses
 import hmac
 import json
 import logging
+import zlib
 
 import grpc
 from grpc import aio
@@ -82,6 +102,7 @@ from k8s1m_tpu.faultline import InjectedFault, policy_for
 from k8s1m_tpu.lint import THREAD_OWNER, guarded_by
 from k8s1m_tpu.loadshed import HealthController, LoadshedConfig, Signals
 from k8s1m_tpu.obs.metrics import Counter, Gauge
+from k8s1m_tpu.store import wiretier
 from k8s1m_tpu.store.etcd_client import EtcdClient
 from k8s1m_tpu.store.native import prefix_end
 from k8s1m_tpu.store.proto import mvcc_pb2, rpc_pb2
@@ -160,6 +181,10 @@ class CacheEvent:
     create_revision: int
     mod_revision: int
     version: int
+    # Tier-monotone apply sequence (0 = never applied, e.g. test-built
+    # events): the shared-frame table's cache key and the pump sweep's
+    # batch-identity fingerprint (equal seq tuples = equal bytes owed).
+    seq: int = 0
 
 
 class Downstream:
@@ -198,6 +223,10 @@ class Downstream:
         # against (tests/test_watch_cache.py); not read on any
         # production path.
         self.last_pushed = 0
+        # True when the newest pop_batch drained from the coalesce map:
+        # that batch is a compacted [from_rev, to_rev] window
+        # (latest-per-key), which the wiretier declares on the wire.
+        self.last_pop_compacted = False
         self.wakeup = asyncio.Event()
         self.overflowed = False
         self.owner: "WatchCache | None" = None   # set by register()
@@ -247,11 +276,17 @@ class Downstream:
         queue's, since coalescing sticks until fully drained)."""
         out: list[CacheEvent] = []
         q = self.queue
+        self.last_pop_compacted = False
         while q and len(out) < n:
             out.append(q.popleft())
         if not q and self.coalesced and len(out) < n:
+            self.last_pop_compacted = True
+            # seq tiebreak: reprime stamps several events at one wire
+            # revision, and peers coalescing the same window must pop
+            # identical batches for the sweep to share their frame.
             rest = sorted(
-                self.coalesced.values(), key=lambda e: e.mod_revision
+                self.coalesced.values(),
+                key=lambda e: (e.mod_revision, e.seq),
             )
             take = rest[: n - len(out)]
             for e in take:
@@ -288,6 +323,7 @@ class Downstream:
     _ranges=THREAD_OWNER,
     _backlog=THREAD_OWNER,
     _lag_now=THREAD_OWNER,
+    _seq=THREAD_OWNER,
 )
 class WatchCache:
     """Cached objects + bounded event history + downstream fan-out."""
@@ -338,6 +374,9 @@ class WatchCache:
         )
         self._backlog = 0
         self._lag_now = lag_budget
+        # Monotone apply counter: stamps CacheEvent.seq, the shared
+        # frame table's encode-once cache key.
+        self._seq = 0
 
     def loadshed_tick(self) -> None:
         """Feed the current fan-out backlog to the tier's health
@@ -509,7 +548,8 @@ class WatchCache:
 
     def apply(self, ev_type: int, key: bytes, value: bytes,
               create_revision: int, mod_revision: int, version: int,
-              wire_revision: int | None = None) -> None:
+              wire_revision: int | None = None,
+              catchup: bool = False) -> None:
         """Apply one upstream store event: update the cached object map
         (hash or btree storage), append to the history window, fan out.
 
@@ -520,8 +560,18 @@ class WatchCache:
         stamped wire revision, so the resumed stream stays monotonic
         for clients whose last-seen revision is the tier's GLOBAL
         header revision (a back-dated event would be filtered by their
-        re-attach ``start_revision`` and lost forever)."""
-        if ev_type == 0:
+        re-attach ``start_revision`` and lost forever).
+
+        ``catchup`` (replica warm restart, run_upstream's resume-floor
+        path) appends to the history window WITHOUT touching the
+        object map: the priming list at the prime revision is already
+        the truth for objects, and replaying an old PUT into the map
+        could resurrect a key the list shows deleted.  Catch-up exists
+        purely so resuming clients can replay (floor, prime_rev] from
+        history instead of relisting."""
+        if catchup:
+            pass
+        elif ev_type == 0:
             existed = key in self.objects
             self.objects[key] = CachedObject(
                 value, create_revision, mod_revision, version
@@ -539,8 +589,10 @@ class WatchCache:
                 if i < len(self.sorted_keys) and self.sorted_keys[i] == key:
                     del self.sorted_keys[i]
         wr = mod_revision if wire_revision is None else wire_revision
+        self._seq += 1
         ev = CacheEvent(
-            ev_type, key, value, create_revision, wr, version
+            ev_type, key, value, create_revision, wr, version,
+            seq=self._seq,
         )
         self.history.append(ev)
         self.last_revision = max(self.last_revision, wr)
@@ -667,6 +719,7 @@ async def run_upstream(
     cache: WatchCache, client: EtcdClient, prefix: bytes,
     *, primed: asyncio.Event | None = None,
     handle: "UpstreamHandle | None" = None,
+    resume_floor: int = 0,
 ) -> None:
     """The tier's single store watch for ``prefix``: list to prime, then
     watch from the list revision, applying every event to the cache.
@@ -695,12 +748,29 @@ async def run_upstream(
     stream is down, so rev=0 reads fall through to the store) while the
     relist runs, and ``reprime`` then replays the net difference to the
     live watches (``invalidate`` only when the diff overflows the
-    window)."""
+    window).
+
+    ``resume_floor`` is the replica warm-restart knob (the fleet's
+    reprime-instead-of-relist story): a relaunched replica primes at
+    the current store revision as usual, then opens its upstream watch
+    from ``resume_floor + 1`` and CATCH-UP applies the history in
+    (floor, prime_rev] — history-window-only, no object-map writes (see
+    ``WatchCache.apply``) — before signalling ``primed`` (and with it
+    the serving port).  ``prime_revision`` is lowered to the floor only
+    once catch-up provably completed (a post-events progress barrier at
+    >= the prime revision, or any event beyond it), so ``replayable_from``
+    never claims history the broken-mid-catch-up case didn't load.
+    Clients of the dead replica then re-attach with their own
+    ``start_revision`` and resume from the replayed window
+    (``watchcache_resumes_total``) instead of relisting; if the store
+    has compacted past the floor, the tier falls back to a cold prime
+    and resuming clients get the honest compact-cancel."""
     end = prefix_end(prefix)
     policy = policy_for("watch.tier")
     resume_policy = policy_for("watch.resume")
     failures = 0
     primed_once = False
+    warm = 0
     while True:
         try:
             # Paginated prime at a pinned revision: one unpaginated list
@@ -732,16 +802,43 @@ async def run_upstream(
                 cache.prime(kvs, rev)
             primed_once = True
             failures = 0
-            if primed is not None:
-                primed.set()
+            # Warm restart: catch up (floor, rev] from store history
+            # before declaring primed; `warm` holds the prime revision
+            # the catch-up must reach (0 = cold / already caught up).
+            warm = rev if 0 < resume_floor < rev else 0
+            if not warm:
+                resume_floor = 0
+                if primed is not None:
+                    primed.set()
             async with client.watch(
-                prefix, end, start_revision=rev + 1
+                prefix, end,
+                start_revision=(resume_floor if warm else rev) + 1,
             ) as session:
                 if session.compact_revision:
+                    if warm:
+                        # Store compacted past the floor: the history
+                        # gap is gone for good.  Fall back to a cold
+                        # prime so resuming clients get the honest
+                        # compact-cancel instead of a silent gap.
+                        log.warning(
+                            "warm restart floor %d for %r already "
+                            "compacted; cold prime", resume_floor, prefix,
+                        )
+                        resume_floor = 0
                     continue    # relist: our revision already compacted
                 if handle is not None:
                     handle.session = session
                     handle.reset_after_reprime()
+                if warm:
+                    # Catch-up completion probe: the store orders the
+                    # progress response AFTER everything it had already
+                    # queued for this watch, so a progress barrier at
+                    # >= rev proves the (floor, rev] history is in.
+                    # Counted as issued so a later confirm() still
+                    # demands a response of its own.
+                    if handle is not None:
+                        handle.requests_sent += 1
+                    await session.request_progress()
                 try:
                     while True:
                         batch = await session.next()
@@ -772,17 +869,52 @@ async def run_upstream(
                                 ev.kv.create_revision,
                                 ev.kv.mod_revision,
                                 ev.kv.version,
+                                catchup=bool(
+                                    warm and ev.kv.mod_revision <= warm
+                                ),
                             )
                         if batch.events:
                             cache.loadshed_tick()
                         elif handle is not None:
                             handle.note_progress()
+                        if warm and (
+                            (not batch.events and batch.revision >= warm)
+                            or (
+                                batch.events
+                                and batch.events[-1].kv.mod_revision > warm
+                            )
+                        ):
+                            # Catch-up complete: history now provably
+                            # covers (floor, prime_rev], so the replay
+                            # window may honestly reach back to the
+                            # floor — and the port may open.
+                            cache.prime_revision = min(
+                                cache.prime_revision, resume_floor
+                            )
+                            _RESUMES.inc()
+                            log.info(
+                                "warm restart for %r caught up: history "
+                                "resumes from revision %d",
+                                prefix, resume_floor + 1,
+                            )
+                            warm = 0
+                            resume_floor = 0
+                            if primed is not None:
+                                primed.set()
                 finally:
                     if handle is not None:
                         handle.session = None
         except asyncio.CancelledError:
             raise
         except Exception as e:
+            if warm:
+                # The stream broke mid-catch-up: partial (floor, rev]
+                # history is already appended, and a second catch-up
+                # pass would duplicate it out of order.  Degrade to a
+                # cold prime — resuming clients relist, which is the
+                # honest fallback, never a silent gap.
+                warm = 0
+                resume_floor = 0
             failures += 1
             delay = (resume_policy if primed_once else policy).delay_for(
                 failures
@@ -932,7 +1064,9 @@ def encode_event_batch(header, watch_id: int, events) -> rpc_pb2.WatchResponse:
 
 class _PumpShard:
     """One fan-out pump lane of a Watch stream: watchers hash onto a
-    lane by id, and each lane services its ready-set sequentially.  The
+    lane by watch key (peers of one object share a lane, and with it a
+    sweep — see ``sweep`` in Watch), and each lane services its
+    ready-set sequentially.  The
     lane count bounds the task cost of a 100K-watch stream (N tasks,
     not 100K), and the bounded output queue means a wedged subscriber
     socket backpressures its own lane instead of head-of-line-blocking
@@ -964,6 +1098,10 @@ class WatchCacheService:
         self.upstream = upstream
         self.handles = handles or []
         self.n_pumps = max(1, n_pumps)
+        # Tier-level shared frame table: one encode per applied event
+        # (keyed by CacheEvent.seq) no matter how many streams, lanes,
+        # or watch ids fan it out.
+        self.frames = wiretier.FrameTable()
 
     async def _confirm_progress(
         self, key: bytes, end: bytes, timeout: float = 5.0
@@ -1077,13 +1215,43 @@ class WatchCacheService:
                 )
             )
 
-        async def drain_one(w: Downstream) -> None:
-            wid = w.service_id
-            r0 = cache.last_revision
-            while w.queue or w.coalesced:
+        async def sweep(shard: _PumpShard) -> None:
+            """One pass over the lane's ready set: pop at most one
+            batch per watcher, group watchers owing IDENTICAL batches
+            (equal event-seq tuples), then compose each group's frame
+            ONCE from the shared frame table and fan the bytes — the
+            wiretier's shared-frame encoding.  A batch drained from a
+            coalesce map is a compacted [from_rev, to_rev] window and
+            says so on the wire (the shared-from extension).  Watchers
+            with remainder re-latch onto the ready set, so per-watcher
+            delivery order is a property of sweep ordering, not of
+            grouping."""
+            # group key -> [wids, events, from_rev, emptied (wid, r0)]
+            groups: dict[tuple, list] = {}
+            cancels: list[Downstream] = []
+            for _ in range(len(shard.ready)):
+                w = shard.ready.popleft()
+                w._ready = False
+                wid = w.service_id
+                if watchers.get(wid) is not w:
+                    continue    # canceled while queued
+                if w.overflowed:
+                    cancels.append(w)
+                    continue
+                r0 = cache.last_revision
+                if not (w.queue or w.coalesced):
+                    # Queue observed empty at r0 (snapshot taken before
+                    # the check, no await between) and nothing popped
+                    # earlier this sweep is pending for it (a pop
+                    # re-latches or empties the queue): delivered
+                    # through r0.
+                    if cleared.get(wid, 0) < r0:
+                        cleared[wid] = r0
+                    continue
                 evs = w.pop_batch(_WATCH_BATCH)
+                compacted = w.last_pop_compacted
                 # Subscriber-wedge fault hook: delay kinds stall this
-                # one socket's delivery; any failure kind means the
+                # lane's delivery; any failure kind means the
                 # subscriber's socket is gone — cancel it (the client
                 # relists, which covers the popped batch) rather than
                 # let one wedged socket hold the lane.
@@ -1093,21 +1261,55 @@ class WatchCacheService:
                         await asyncio.sleep(d.delay_s)
                     else:
                         w.overflowed = True
-                        break
-                await out.put(encode_event_batch(self._header(), wid, evs))
-                last = evs[-1].mod_revision
-                if cleared.get(wid, 0) < last:
-                    cleared[wid] = last
-                r0 = cache.last_revision
-            if w.overflowed:
-                await cancel_watch(
-                    w, "watcher overflowed; events dropped"
-                )
-                return
-            # Queue observed empty at r0 (snapshot taken before the
-            # check, no await between): delivered through r0.
-            if cleared.get(wid, 0) < r0:
-                cleared[wid] = r0
+                        cancels.append(w)
+                        continue
+                gk = tuple(e.seq for e in evs)
+                if 0 in gk:
+                    # Unstamped events (unit-test pushes) have no
+                    # identity; never share their frame.
+                    gk = (-wid,) + gk
+                g = groups.get(gk)
+                if g is None:
+                    groups[gk] = g = [[wid], evs, 0, []]
+                else:
+                    g[0].append(wid)
+                if compacted:
+                    fr = cleared.get(wid, 0) + 1
+                    if fr > 1 and (g[2] == 0 or fr < g[2]):
+                        g[2] = fr
+                if w.queue or w.coalesced:
+                    shard.mark_ready(w)
+                else:
+                    g[3].append((wid, r0))
+            # Flush: one composed frame per group.  cleared[] advances
+            # only AFTER a group's frame is queued, so a progress
+            # barrier can never overtake undelivered events (the
+            # progress-after-events contract the consistent-read gate
+            # rides).
+            if groups:
+                hb = wiretier.header_bytes(self._header())
+                for wids, evs, from_rev, emptied in groups.values():
+                    chunks = [
+                        self.frames.bytes_for(e.seq, wiretier.encode_event, e)
+                        for e in evs
+                    ]
+                    await out.put(
+                        wiretier.compose_frame(
+                            hb, wids, chunks, from_rev=from_rev
+                        )
+                    )
+                    last = evs[-1].mod_revision
+                    for wid in wids:
+                        if cleared.get(wid, 0) < last:
+                            cleared[wid] = last
+                    for wid, r0 in emptied:
+                        if cleared.get(wid, 0) < r0:
+                            cleared[wid] = r0
+            # Cancels flush after frames: a watcher popped-then-
+            # overflowed this sweep must not see its cancel overtake
+            # bytes already owed to its group.
+            for w in cancels:
+                await cancel_watch(w, "watcher overflowed; events dropped")
 
         async def pump_shard(shard: _PumpShard):
             try:
@@ -1122,16 +1324,7 @@ class WatchCacheService:
                     if d is not None:
                         await asyncio.sleep(d.delay_s or _STALL_S)
                     while shard.ready:
-                        w = shard.ready.popleft()
-                        w._ready = False
-                        if watchers.get(w.service_id) is not w:
-                            continue    # canceled while queued
-                        if w.overflowed:
-                            await cancel_watch(
-                                w, "watcher overflowed; events dropped"
-                            )
-                            continue
-                        await drain_one(w)
+                        await sweep(shard)
             except asyncio.CancelledError:
                 raise
 
@@ -1178,7 +1371,13 @@ class WatchCacheService:
                         continue
                     watchers[wid] = w
                     w.service_id = wid
-                    shard = shards[wid % len(shards)]
+                    # Lanes hash on the WATCH KEY, not the id: peers
+                    # watching the same object land in the same sweep,
+                    # which is what lets them share one frame (their
+                    # owed batches are identical whenever their lag
+                    # states are).  Balance is unchanged for the many-
+                    # keys population lanes exist to spread.
+                    shard = shards[zlib.crc32(w.key) % len(shards)]
                     w.on_ready = shard.mark_ready
                     # Owes nothing below the registration point unless a
                     # replay queued history to deliver first.
@@ -1379,6 +1578,7 @@ async def serve_watch_cache(
     auth_token: str | None = None,
     lag_budget: int = _LAG_BUDGET,
     pumps: int = _PUMP_SHARDS,
+    resume_floor: int = 0,
 ) -> WatchCacheTier:
     """Start the tier: one upstream watch per prefix, etcd wire served on
     ``port``.
@@ -1450,7 +1650,9 @@ async def serve_watch_cache(
             "Watch": grpc.stream_stream_rpc_method_handler(
                 svc.Watch,
                 request_deserializer=rpc_pb2.WatchRequest.FromString,
-                response_serializer=rpc_pb2.WatchResponse.SerializeToString,
+                # Event frames leave the pumps pre-composed (wiretier
+                # shared-frame bytes); control responses stay protos.
+                response_serializer=wiretier.serialize_frame_or_message,
             ),
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Maintenance", {
@@ -1463,7 +1665,10 @@ async def serve_watch_cache(
     # existing state).  Port readiness == cache readiness.
     primed_events = [asyncio.Event() for _ in prefixes]
     tasks = [
-        asyncio.create_task(run_upstream(cache, upstream, p, primed=e, handle=h))
+        asyncio.create_task(run_upstream(
+            cache, upstream, p, primed=e, handle=h,
+            resume_floor=resume_floor,
+        ))
         for p, e, h in zip(prefixes, primed_events, handles)
     ]
     try:
@@ -1513,6 +1718,11 @@ def main(argv=None) -> None:
                     "shrinks it under backlog)")
     ap.add_argument("--pumps", type=int, default=_PUMP_SHARDS,
                     help="fan-out pump lanes per Watch stream")
+    ap.add_argument("--resume-floor", type=int, default=0,
+                    help="replica warm restart: catch the history "
+                    "window up from this store revision before serving "
+                    "so the dead replica's clients resume from "
+                    "revision instead of relisting")
     ap.add_argument("--metrics-port", type=int, default=0)
     ap.add_argument("--tls-cert", default=None,
                     help="serve TLS: path to the server cert PEM")
@@ -1543,6 +1753,7 @@ def main(argv=None) -> None:
             index=args.index, window=args.window,
             tls=tls, auth_token=args.auth_token,
             lag_budget=args.lag_budget, pumps=args.pumps,
+            resume_floor=args.resume_floor,
         )
         if args.metrics_port:
             from k8s1m_tpu.obs.http import start_metrics_server

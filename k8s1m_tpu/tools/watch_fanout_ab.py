@@ -102,15 +102,23 @@ def parse_args(argv=None):
     ap.add_argument("--rss-budget-mb", type=float, default=0.0,
                     help="storm gate: peak process RSS (0 = report "
                          "only; --smoke sets a budget)")
+    ap.add_argument("--replica-drill", action="store_true",
+                    help="storm mode: run a watch-cache REPLICA as a "
+                         "subprocess serving a slice of the hot keys, "
+                         "SIGKILL it mid-storm, and relaunch it with "
+                         "--resume-floor — its watches must resume "
+                         "from revision (warm restart), not relist")
     ap.add_argument("--smoke", action="store_true",
                     help="tier-1 storm shape: 10k watchers, same gates "
-                         "plus the RSS budget")
+                         "plus the RSS budget and the replica "
+                         "warm-restart drill")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.smoke:
         args.watchers = args.watchers or 10_000
         args.writes = 8_000 if args.writes == 10000 else args.writes
         args.fault_plan = args.fault_plan or "watchstorm"
+        args.replica_drill = True
         if not args.rss_budget_mb:
             args.rss_budget_mb = 1500.0
     return args
@@ -292,6 +300,12 @@ class _StormLedger:
         self.write_t: dict[tuple[int, int], float] = {}
         self.last_seq: dict[int, int] = {}    # wid -> newest seq seen
         self.key_of: dict[int, int] = {}      # hot wid -> key index
+        # Watches excluded from the p99 population but NOT from the
+        # loss/regression axes: the replica drill's watches sit behind
+        # a deliberate mid-storm SIGKILL outage, and their catch-up lag
+        # measures the restart window, not the fan-out path the p99
+        # gate exists to bound.
+        self.lag_exempt: set[int] = set()
         self.lags: list[float] = []
         self.regressions = 0
         self.idle_delivered = 0
@@ -307,6 +321,8 @@ class _StormLedger:
             self.regressions += 1
             return
         self.last_seq[wid] = seq
+        if wid in self.lag_exempt:
+            return
         t = self.write_t.get((ki, seq))
         if t is not None and len(self.lags) < _LAG_SAMPLE_CAP:
             self.lags.append(now - t)
@@ -322,7 +338,14 @@ class _StormLedger:
 class _StormMux:
     """One bidi Watch stream multiplexing many drill watches (the
     kube-apiserver-to-etcd shape; the only honest way to hold 100K
-    watches from one core), feeding the ledger from its reader."""
+    watches from one core), feeding the ledger from its reader.
+
+    The stream is read RAW (bytes deserializer): the reader decodes the
+    wiretier shared-frame tail itself, fans one frame's events to every
+    watch id riding it (index selection, never a re-parse per watch),
+    and keeps the drill's wire accounting — actual bytes received vs
+    what the unshared encoding would have cost for the same deliveries.
+    """
 
     def __init__(self, channel, ledger: _StormLedger, cancels: asyncio.Queue):
         from k8s1m_tpu.store.proto import rpc_pb2
@@ -331,24 +354,35 @@ class _StormMux:
         self._call = channel.stream_stream(
             "/etcdserverpb.Watch/Watch",
             request_serializer=rpc_pb2.WatchRequest.SerializeToString,
-            response_deserializer=rpc_pb2.WatchResponse.FromString,
+            response_deserializer=lambda b: b,
         )()
         self.ledger = ledger
         self.cancels = cancels
         self.created = 0
         self.delivered = 0
         self.canceled = 0
+        self.frames = 0
+        self.shared_frames = 0
+        self.bytes_on_wire = 0
+        self.unshared_bytes = 0      # core bytes x watch ids sharing them
+        self.create_rev = 0          # newest header revision on a create ack
+        self.watch_rev: dict[int, int] = {}   # wid -> last delivered mod_rev
         self._reader = asyncio.create_task(self._read())
 
-    async def create(self, pairs, start_revision: int = 0) -> None:
-        """pairs: (wid, key) tuples to register on this stream."""
+    async def create(self, pairs, start_revision: int = 0,
+                     start_revisions: dict | None = None) -> None:
+        """pairs: (wid, key) tuples to register on this stream.
+        ``start_revisions`` overrides per wid (warm-restart reattach)."""
         pb = self._pb
         for wid, key in pairs:
+            sr = start_revision
+            if start_revisions is not None:
+                sr = start_revisions.get(wid, start_revision)
             await self._call.write(
                 pb.WatchRequest(
                     create_request=pb.WatchCreateRequest(
                         key=key, watch_id=wid,
-                        start_revision=start_revision,
+                        start_revision=sr,
                     )
                 )
             )
@@ -363,9 +397,14 @@ class _StormMux:
     async def _read(self) -> None:
         import grpc
 
+        from k8s1m_tpu.store.native import decode_shared_tail
+
         led = self.ledger
+        pb = self._pb
         try:
-            async for resp in self._call:
+            async for raw in self._call:
+                extra, _from_rev, core_len = decode_shared_tail(raw)
+                resp = pb.WatchResponse.FromString(raw)
                 # canceled BEFORE created: a compact-cancel arrives as
                 # ONE response with created=True AND canceled=True —
                 # counting it as a successful create would leave the
@@ -379,12 +418,28 @@ class _StormMux:
                     continue
                 if resp.created:
                     self.created += 1
+                    if resp.header.revision > self.create_rev:
+                        self.create_rev = resp.header.revision
                     continue
                 if resp.events:
                     now = time.perf_counter()
-                    self.delivered += len(resp.events)
-                    for ev in resp.events:
-                        led.on_event(resp.watch_id, ev.kv.value, now)
+                    wids = (resp.watch_id, *extra)
+                    self.frames += 1
+                    self.bytes_on_wire += len(raw)
+                    # What len(wids) separate WatchResponses for the
+                    # same events would have cost (each is the frame's
+                    # core — header + watch_id + event chunks — minus
+                    # the few extension varints the sharing adds).
+                    self.unshared_bytes += core_len * len(wids)
+                    if extra:
+                        self.shared_frames += 1
+                    self.delivered += len(resp.events) * len(wids)
+                    last = resp.events[-1].kv.mod_revision
+                    for wid in wids:
+                        for ev in resp.events:
+                            led.on_event(wid, ev.kv.value, now)
+                        if last > self.watch_rev.get(wid, 0):
+                            self.watch_rev[wid] = last
         except (asyncio.CancelledError, grpc.RpcError):
             pass
 
@@ -395,6 +450,141 @@ class _StormMux:
         # Close-path cancel: the reader is being torn down either way.
         except (asyncio.CancelledError, Exception):  # graftlint: disable=broad-except
             pass
+
+
+class _ReplicaDrill:
+    """The storm's fleet lane: a REAL watch-cache replica subprocess
+    serving a slice of the hot keys, SIGKILLed mid-storm and relaunched
+    with ``--resume-floor`` — the warm-restart contract under test is
+    that its watch population resumes from revision (the relaunched
+    replica catches its history window up from the floor and clients
+    re-attach with per-watch start_revision) instead of relisting."""
+
+    def __init__(self, upstream: str, lag_budget: int):
+        self.upstream = upstream
+        self.lag_budget = lag_budget
+        self.proc = None
+        self.port = 0
+        self.metrics_port = 0
+        self.chan = None
+        self.mux: _StormMux | None = None
+        self.keys: list = []        # (wid, key) pairs this replica serves
+        self.report: dict = {}
+
+    async def launch(self, resume_floor: int = 0) -> None:
+        import socket
+        import subprocess
+        import sys
+
+        from k8s1m_tpu.cluster.harness import _free_port
+
+        self.port = _free_port()
+        self.metrics_port = _free_port()
+        cmd = [
+            sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+            "--upstream", self.upstream,
+            "--host", "127.0.0.1", "--port", str(self.port),
+            "--prefix", lease_key(LEASE_NS, "x")[:-1].decode(),
+            "--lag-budget", str(self.lag_budget),
+            "--metrics-port", str(self.metrics_port),
+        ]
+        if resume_floor:
+            cmd += ["--resume-floor", str(resume_floor)]
+        self.proc = subprocess.Popen(
+            cmd, env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
+        )
+        deadline = time.monotonic() + 180
+        while True:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica exited rc={self.proc.returncode}"
+                )
+            try:
+                with socket.create_connection(
+                    ("127.0.0.1", self.port), timeout=0.2
+                ):
+                    return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("replica did not bind")
+                # Deadline-bounded readiness poll, not an op retry.
+                await asyncio.sleep(0.05)  # graftlint: disable=retry-through-policy
+
+    async def attach(self, ledger, cancels, pairs,
+                     start_revisions: dict | None = None) -> None:
+        from grpc import aio
+
+        self.chan = aio.insecure_channel(
+            f"127.0.0.1:{self.port}",
+            options=[("grpc.max_receive_message_length", 64 << 20),
+                     ("grpc.use_local_subchannel_pool", 1)],
+        )
+        self.mux = _StormMux(self.chan, ledger, cancels)
+        await self.mux.create(pairs, start_revisions=start_revisions)
+        await self.mux.wait_created(len(pairs), timeout=180)
+
+    async def kill_and_restart(self, ledger, cancels) -> None:
+        t0 = time.perf_counter()
+        self.proc.kill()            # SIGKILL: no goodbye, no flush
+        await asyncio.to_thread(self.proc.wait)
+        old = self.mux
+        await old.close()
+        await self.chan.close()
+        # The floor is the weakest watch's proven position: everything
+        # after it is owed to SOMEONE, so the relaunched replica must
+        # rebuild history from there.  Per-watch re-attach points stay
+        # individual (a stream-level max would skip events for the
+        # laggards).
+        resume_at = {
+            wid: max(old.watch_rev.get(wid, 0), old.create_rev)
+            for wid, _ in self.keys
+        }
+        floor = min(resume_at.values())
+        await self.launch(resume_floor=floor)
+        await self.attach(
+            ledger, cancels, self.keys,
+            start_revisions={w: r + 1 for w, r in resume_at.items()},
+        )
+        self.report = {
+            "resume_floor": floor,
+            "restart_seconds": round(time.perf_counter() - t0, 2),
+        }
+
+    async def scrape(self) -> dict:
+        """The relaunched replica's own /metrics, summed per counter."""
+        import urllib.request
+
+        def _get():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.metrics_port}/metrics", timeout=10
+            ) as r:
+                return r.read().decode()
+
+        out: dict = {}
+        for line in (await asyncio.to_thread(_get)).splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name, _, val = line.rpartition(" ")
+            base = name.split("{", 1)[0]
+            try:
+                out[base] = out.get(base, 0.0) + float(val)
+            except ValueError:
+                continue
+        return out
+
+    async def close(self) -> None:
+        import subprocess
+
+        if self.mux is not None:
+            await self.mux.close()
+        if self.chan is not None:
+            await self.chan.close()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                await asyncio.to_thread(self.proc.wait, 10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
 
 
 async def run_storm(args) -> dict:
@@ -434,6 +624,12 @@ async def run_storm(args) -> dict:
     channels = []
     relist_client = None
     recreator = None
+    replica = (
+        _ReplicaDrill(f"127.0.0.1:{wf.port}", args.lag_budget)
+        if args.replica_drill else None
+    )
+    rep_restart = None
+    rep_scrape: dict = {}
     try:
         wave = []
         for i in range(n_idle):
@@ -476,6 +672,8 @@ async def run_storm(args) -> dict:
             reads its key through the tier (progress-gated, so the read
             reflects every write the cancel postdates) and re-attaches
             from the read revision."""
+            import grpc as _grpc
+
             while True:
                 mux, wid = await cancels.get()
                 ki = ledger.key_of.get(wid)
@@ -487,10 +685,17 @@ async def run_storm(args) -> dict:
                     if seq > ledger.last_seq.get(wid, 0):
                         ledger.last_seq[wid] = seq
                 ledger.relisted += 1
-                await mux.create(
-                    [(wid, hot_keys[ki])],
-                    start_revision=resp.header.revision + 1,
-                )
+                try:
+                    await mux.create(
+                        [(wid, hot_keys[ki])],
+                        start_revision=resp.header.revision + 1,
+                    )
+                except _grpc.RpcError:
+                    # A cancel racing the replica drill's SIGKILL: the
+                    # stream died under us.  The warm-restart path
+                    # re-attaches the replica's whole population from
+                    # per-watch revisions — nothing to do here.
+                    continue
 
         recreator = asyncio.create_task(recreate_canceled())
 
@@ -511,7 +716,13 @@ async def run_storm(args) -> dict:
         hot_pairs: list[list] = [[] for _ in muxes]
         for wi in range(n_hot):
             ki = wi % nkeys
-            mi = wi % len(muxes)
+            # Place a key's hot watchers on the SAME stream (keyed, not
+            # round-robin by watcher): the kube shape — one apiserver
+            # multiplexes all watches for an object over one etcd
+            # stream — and the layout under which the tier's shared
+            # frames actually share (a frame can only carry the watch
+            # ids of one stream).
+            mi = ki % len(muxes)
             wid = next_wid
             next_wid += 1
             ledger.key_of[wid] = ki
@@ -524,6 +735,26 @@ async def run_storm(args) -> dict:
         create_s = time.perf_counter() - t0
         rss_after_create = _rss_mb()
 
+        # ---- the replica fleet lane: a watch-cache replica subprocess
+        # serves the TOP slice of the hot key range (disjoint from the
+        # flood subset, keys [0, nkeys/8)), gets SIGKILLed as the flood
+        # opens, and must come back warm.  Its watches ride the same
+        # ledger (zero-loss and monotonicity axes) but are lag-exempt:
+        # their catch-up lag measures the deliberate outage window.
+        if replica is not None:
+            await replica.launch()
+            n_rep_keys = min(256, max(1, nkeys // 4))
+            pairs = []
+            for i in range(n_rep_keys):
+                rki = nkeys - 1 - i
+                wid = next_wid
+                next_wid += 1
+                ledger.key_of[wid] = rki
+                ledger.lag_exempt.add(wid)
+                pairs.append((wid, hot_keys[rki]))
+            replica.keys = pairs
+            await replica.attach(ledger, cancels, pairs)
+
         # ---- the storm window: steady -> flood -> steady writes.
         # Steady thirds pace at --rate over ALL keys (the kubelet-
         # renewal shape); the flood third bursts unpaced at
@@ -535,10 +766,25 @@ async def run_storm(args) -> dict:
         total = args.writes
         written = 0
         ki = 0
-        flood_keys = max(1, nkeys // 8)
         base = max(64, min(1000, args.rate // 8))
+        # The flood third must actually FLOOD: bound the hot subset so
+        # each unpaced burst lands ~2x the tier's lag budget on every
+        # flooded key, forcing the latest-only coalescing the wire and
+        # p99 gates are about — not a polite elevated drizzle the pumps
+        # absorb without ever degrading anyone.
+        flood_keys = max(1, min(
+            nkeys // 8,
+            base * args.flood_factor // max(1, args.lag_budget * 2),
+        ))
         while written < total:
             in_flood = total // 3 <= written < 2 * (total // 3)
+            if replica is not None and rep_restart is None and in_flood:
+                # SIGKILL the replica exactly as the flood opens — the
+                # worst moment — and warm-restart it while the storm
+                # keeps writing.
+                rep_restart = asyncio.create_task(
+                    replica.kill_and_restart(ledger, cancels)
+                )
             n = min(base * (args.flood_factor if in_flood else 1),
                     total - written)
             t = time.perf_counter()
@@ -559,6 +805,8 @@ async def run_storm(args) -> dict:
                 if pause > 0:
                     await asyncio.sleep(pause)
         write_s = time.perf_counter() - t0
+        if rep_restart is not None:
+            await asyncio.wait_for(rep_restart, timeout=300)
 
         rss_after_writes = _rss_mb()
         # ---- quiesce: every hot watch must reach its key's final seq
@@ -571,9 +819,13 @@ async def run_storm(args) -> dict:
         store_watchers = store.stats()["watchers"]
         tier_stats = tier.cache.stats()
         rss_quiesce = _rss_mb()
+        if replica is not None:
+            rep_scrape = await replica.scrape()
     finally:
         if recreator is not None:
             recreator.cancel()
+        if replica is not None:
+            await replica.close()
         for m in muxes:
             await m.close()
         for ch in channels:
@@ -604,6 +856,20 @@ async def run_storm(args) -> dict:
     p99 = lags[min(len(lags) - 1, int(len(lags) * 0.99))] if lags else None
     peak_rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
     delivered = sum(m.delivered for m in muxes)
+    # ---- wire accounting (main fan-out muxes; the replica lane is a
+    # separate outage drill).  measured_fanout is the drill's ACTUAL
+    # per-event delivery degree (nominal 3 hot watchers per key, net of
+    # latest-only elisions and cancel->relist gaps); the shared-frame
+    # wire must recoup at least that factor for bytes_per_delivered_event
+    # to have dropped by the fan-out degree vs the unshared encoding.
+    frames = sum(m.frames for m in muxes)
+    shared_frames = sum(m.shared_frames for m in muxes)
+    bytes_on_wire = sum(m.bytes_on_wire for m in muxes)
+    unshared_bytes = sum(m.unshared_bytes for m in muxes)
+    measured_fanout = delivered / max(1, tier_stats["events_in"])
+    wire_drop = unshared_bytes / max(1, bytes_on_wire)
+    rep_resumes = rep_scrape.get("watchcache_resumes_total", 0.0)
+    rep_invals = rep_scrape.get("watchcache_invalidations_total", 0.0)
     gates = {
         "zero_loss": lagging == 0,
         "no_regressions": ledger.regressions == 0,
@@ -623,6 +889,17 @@ async def run_storm(args) -> dict:
         # memory while the steady footprint stays flat.
         "rss_bounded": (
             not args.rss_budget_mb or rss_quiesce <= args.rss_budget_mb
+        ),
+        # Shared frames must recoup at least the measured fan-out
+        # degree in bytes: what N unshared responses would have cost
+        # for the SAME deliveries, over what actually crossed the wire.
+        "wire_compaction": frames > 0 and wire_drop >= measured_fanout,
+        # The killed replica must come back WARM: its own counters show
+        # resume-from-revision (diff replay against the rebuilt history
+        # window), and zero invalidations — no relist storm.
+        "replica_warm_restart": (
+            not args.replica_drill
+            or (rep_resumes >= 1 and rep_invals == 0)
         ),
     }
     passed = all(gates.values())
@@ -646,6 +923,18 @@ async def run_storm(args) -> dict:
             "write_seconds": round(write_s, 2),
             "window_seconds": round(window_s, 2),
             "delivered": delivered,
+            "delivered_per_sec": round(delivered / window_s, 1),
+            "frames": frames,
+            "frames_shared_ratio": round(shared_frames / max(1, frames), 4),
+            "bytes_on_wire_total": bytes_on_wire,
+            "bytes_per_delivered_event": round(
+                bytes_on_wire / max(1, delivered), 1
+            ),
+            "unshared_bytes_per_event": round(
+                unshared_bytes / max(1, delivered), 1
+            ),
+            "wire_compaction_drop": round(wire_drop, 3),
+            "measured_fanout": round(measured_fanout, 3),
             "coalesced_events": int(d_coalesced),
             "tier_backlog_at_end": tier_stats["backlog"],
             "upstream_breaks": breaks,
@@ -665,6 +954,22 @@ async def run_storm(args) -> dict:
             "rss_mb_at_quiesce": round(rss_quiesce, 1),
             "peak_rss_mb": round(peak_rss_mb, 1),
             "rss_budget_mb": args.rss_budget_mb or None,
+            "replica_drill": (
+                {
+                    **(replica.report if replica is not None else {}),
+                    "replica_watches": (
+                        len(replica.keys) if replica is not None else 0
+                    ),
+                    "replica_delivered": (
+                        replica.mux.delivered
+                        if replica is not None and replica.mux is not None
+                        else 0
+                    ),
+                    "resumes": int(rep_resumes),
+                    "invalidations": int(rep_invals),
+                }
+                if args.replica_drill else None
+            ),
             "faults": fired,
         },
     }

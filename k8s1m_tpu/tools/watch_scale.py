@@ -13,6 +13,17 @@ Watches are MULTIPLEXED over a few bidi streams with explicit watch ids
 — exactly how kube-apiserver talks to etcd (one stream, many watches),
 and the only honest way to hold 100K watches from one client core.
 
+With ``--replicas N`` the tier grows into a FLEET: hot keys pin to
+replicas through the wiretier's consistent-hash ``SubscriptionMap``
+(not round-robin slicing), and the ``--kill-one`` drill becomes a WARM
+RESTART — the victim is relaunched with ``--resume-floor`` and its
+watches re-attach to it from their own revisions (reprime diff replay),
+instead of 100K clients relisting through the survivors.  When the
+environment actually has >= 2 effective CPUs the fleet must also scale:
+aggregate fan-out throughput is gated against a single-replica
+calibration window; on a 1-core box the gate degrades to
+correctness-only (zero loss + warm resume), reported as such.
+
     python -m k8s1m_tpu.tools.watch_scale --idle 100000 --active 2000
 """
 
@@ -28,9 +39,10 @@ import grpc
 from grpc import aio
 
 from k8s1m_tpu.store.etcd_client import EtcdClient
-from k8s1m_tpu.store.native import MemStore
+from k8s1m_tpu.store.native import MemStore, decode_shared_tail
 from k8s1m_tpu.store.proto import rpc_pb2
 from k8s1m_tpu.store.watch_cache import serve_watch_cache
+from k8s1m_tpu.store.wiretier import SubscriptionMap
 
 IDLE_PREFIX = b"/registry/configmaps/scale/"
 HOT_PREFIX = b"/registry/leases/scale/"
@@ -42,6 +54,20 @@ def _rss_mb() -> float:
             if line.startswith("VmRSS"):
                 return int(line.split()[1]) / 1024.0
     return 0.0
+
+
+def _effective_cpus() -> int:
+    """CPUs this process can actually burn (cgroup quota wins over the
+    host count): the knob that decides whether the replica fleet can
+    honestly be gated on SCALING or only on correctness."""
+    try:
+        with open("/sys/fs/cgroup/cpu.max") as f:
+            quota, period = f.read().split()
+        if quota != "max":
+            return max(1, int(int(quota) // int(period)))
+    except (OSError, ValueError):
+        pass
+    return os.cpu_count() or 1
 
 
 def _tier_rss_mb(pid: int) -> float:
@@ -60,7 +86,10 @@ class MuxWatch:
         self._call = channel.stream_stream(
             "/etcdserverpb.Watch/Watch",
             request_serializer=rpc_pb2.WatchRequest.SerializeToString,
-            response_deserializer=rpc_pb2.WatchResponse.FromString,
+            # Raw frames: the reader decodes the wiretier shared-frame
+            # tail itself and fans one frame's events to every watch id
+            # riding it (index selection over shared bytes).
+            response_deserializer=lambda b: b,
         )()
         self.created = 0
         self.delivered = 0
@@ -102,7 +131,9 @@ class MuxWatch:
 
     async def _read(self) -> None:
         try:
-            async for resp in self._call:
+            async for raw in self._call:
+                extra, _from_rev, _core = decode_shared_tail(raw)
+                resp = rpc_pb2.WatchResponse.FromString(raw)
                 if resp.canceled:
                     self.canceled += 1
                 elif resp.created:
@@ -110,14 +141,16 @@ class MuxWatch:
                     if resp.header.revision > self.create_rev:
                         self.create_rev = resp.header.revision
                 else:
-                    self.delivered += len(resp.events)
+                    wids = (resp.watch_id, *extra)
+                    self.delivered += len(resp.events) * len(wids)
                     for ev in resp.events:
                         if ev.kv.mod_revision > self.last_rev:
                             self.last_rev = ev.kv.mod_revision
                     if resp.events:
                         r = resp.events[-1].kv.mod_revision
-                        if r > self.watch_rev.get(resp.watch_id, 0):
-                            self.watch_rev[resp.watch_id] = r
+                        for wid in wids:
+                            if r > self.watch_rev.get(wid, 0):
+                                self.watch_rev[wid] = r
         except (asyncio.CancelledError, grpc.RpcError):
             pass
 
@@ -155,8 +188,9 @@ def parse_args(argv=None):
     ap.add_argument(
         "--kill-one", action="store_true",
         help="crash drill: SIGKILL the last replica halfway through the "
-        "fan-out window, re-attach its hot watches to a survivor from "
-        "the last delivered revision, assert zero event loss",
+        "fan-out window, relaunch it with --resume-floor (warm restart) "
+        "and re-attach its watches to it from their own revisions — "
+        "no relist, no subscription reshuffle, zero event loss",
     )
     return ap.parse_args(argv)
 
@@ -201,20 +235,21 @@ async def amain(args) -> dict:
         tier_flags += ["--lag-budget", str(args.lag_budget)]
     if args.pumps:
         tier_flags += ["--pumps", str(args.pumps)]
+    _env = {**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"}
+
+    def _tier_cmd(port: int, extra=()) -> list:
+        return [
+            sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
+            "--upstream", f"127.0.0.1:{store_port}",
+            "--host", "127.0.0.1", "--port", str(port),
+            "--prefix", IDLE_PREFIX.decode(),
+            "--prefix", HOT_PREFIX.decode(),
+            "--index", args.index,
+            *tier_flags, *extra,
+        ]
+
     tier_procs = [
-        subprocess.Popen(
-            [
-                sys.executable, "-m", "k8s1m_tpu.store.watch_cache",
-                "--upstream", f"127.0.0.1:{store_port}",
-                "--host", "127.0.0.1", "--port", str(port),
-                "--prefix", IDLE_PREFIX.decode(),
-                "--prefix", HOT_PREFIX.decode(),
-                "--index", args.index,
-                *tier_flags,
-            ],
-            env={**os.environ, "PYTHONPATH": "", "JAX_PLATFORMS": "cpu"},
-        )
-        for port in tier_ports
+        subprocess.Popen(_tier_cmd(port), env=_env) for port in tier_ports
     ]
     channels = []
     try:
@@ -271,23 +306,74 @@ async def amain(args) -> dict:
             await m.wait_created(len(keys), timeout=240)
         create_s = time.perf_counter() - t0
 
-        # Active watches on the hot keys: slice r rides replica r (each
-        # hot key watched by exactly ONE stream on one replica).
+        # Active watches on the hot keys, placed by the wiretier's
+        # consistent-hash SubscriptionMap — each hot key subscribes to
+        # exactly ONE replica, and the map is what makes a replica
+        # restart a LOCAL event: survivors' subscriptions provably
+        # never move (no fleet-wide reshuffle, no relist storm).
         hot_keys = [HOT_PREFIX + b"lease-%05d" % i for i in range(args.active)]
-        hot_per = (args.active + n_rep - 1) // n_rep
-        hot_slices = []             # (mux, keys, first_id) per replica
-        for r in range(n_rep):
-            keys = hot_keys[r * hot_per : (r + 1) * hot_per]
+        smap = SubscriptionMap(range(n_rep))
+        rep_keys: list[list[bytes]] = [[] for _ in range(n_rep)]
+        for k in hot_keys:
+            rep_keys[smap.replica_for(k)].append(k)
+
+        async def attach_hot(r: int):
+            nonlocal next_id
+            keys = rep_keys[r]
             if not keys:
-                continue
-            hot_slices.append((muxes[r], keys, next_id))
-            await muxes[r].create(keys, next_id)
+                return None
+            first, m = next_id, muxes[r]
             next_id += len(keys)
-        for m, keys, _ in hot_slices:
-            base_idle = sum(
-                len(k) for mm, k, _ in creates if mm is m
+            base = m.created
+            await m.create(keys, first)
+            await m.wait_created(base + len(keys), timeout=120)
+            return (m, keys, first)
+
+        async def burst_window(keys: list, writes: int) -> float:
+            """Unpaced writes over ``keys``; returns delivered/s once
+            every write's event has fanned out."""
+            base = sum(m.delivered for m in muxes)
+            t0 = time.perf_counter()
+            written = 0
+            while written < writes:
+                n = min(2000, writes - written)
+                await seed.put_batch([
+                    (keys[(written + i) % len(keys)], b"c%d" % (written + i))
+                    for i in range(n)
+                ])
+                written += n
+            deadline = time.monotonic() + 120
+            while (
+                sum(m.delivered for m in muxes) - base < writes
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            return round(
+                (sum(m.delivered for m in muxes) - base)
+                / (time.perf_counter() - t0), 1,
             )
-            await m.wait_created(base_idle + len(keys), timeout=120)
+
+        hot_slices = []             # (mux, keys, first_id) per replica
+        calib_rate = None
+        cpus = _effective_cpus()
+        if n_rep > 1 and cpus >= 2:
+            # SCALING lane — only honest with real parallelism: one
+            # replica's fan-out alone first, the fleet's aggregate
+            # after, gated on the ratio.  On a 1-core box the fleet
+            # still runs (correctness-only) but no linearity is
+            # claimed.
+            s0 = await attach_hot(0)
+            if s0 is not None:
+                hot_slices.append(s0)
+                calib_rate = await burst_window(
+                    rep_keys[0], max(500, args.writes // 4)
+                )
+        for r in range(n_rep):
+            if calib_rate is not None and r == 0:
+                continue            # already attached for calibration
+            s = await attach_hot(r)
+            if s is not None:
+                hot_slices.append(s)
 
         rss1 = sum(_tier_rss_mb(p.pid) for p in tier_procs)
         store_watchers = store.stats()["watchers"]
@@ -300,7 +386,8 @@ async def amain(args) -> dict:
         t0 = time.perf_counter()
         written = 0
         killed_at = None
-        lost_idle = 0
+        warm_restart = None
+        victim_mport = 0
         base_delivered = sum(m.delivered for m in muxes)
         while written < args.writes:
             # Batch bounded by writes/4 so a --kill-one drill always
@@ -317,51 +404,109 @@ async def amain(args) -> dict:
             ):
                 killed_at = written
                 victim = n_rep - 1
+                t_kill = time.perf_counter()
                 tier_procs[victim].kill()
                 tier_procs[victim].wait()
                 dead_muxes = [m for m in muxes if m.replica == victim]
                 # Join the dead streams' readers BEFORE reading their
                 # resume revisions: grpc may still hold buffered
                 # responses the reader task hasn't processed — a
-                # snapshot taken early would make the survivor replay
-                # revisions the dead stream then also counts
-                # (duplicates).
+                # snapshot taken early would replay revisions the dead
+                # stream then also counts (duplicates).
                 for dm in dead_muxes:
                     await dm.close()
-                lost_idle = sum(
-                    len(k) for mm, k, _ in creates if mm in dead_muxes
+                # WARM RESTART (the fleet contract): relaunch the
+                # victim on its own port with --resume-floor at the
+                # weakest proven position of its hot watches.  The
+                # SubscriptionMap is untouched — no key moves, no
+                # survivor reshuffles — and every watch re-attaches to
+                # the relaunched replica from its OWN revision (the
+                # watch's last delivered revision, or its registration
+                # revision when it never delivered; a stream-level max
+                # would skip the laggards' events).  Resume is a diff
+                # replay out of the rebuilt history window — not a
+                # relist.
+                hot = next(
+                    (s for s in hot_slices if s[0].replica == victim),
+                    None,
                 )
-                # Re-attach the victim's hot watches to replica 0 from
-                # the last revision each dead stream delivered: replay
-                # from the survivor's history window, no gap.
-                for m, rkeys, first in hot_slices:
-                    if m.replica != victim:
-                        continue
-                    # PER-WATCH resume point: the watch's own last
-                    # delivered revision, or — when it never delivered
-                    # (deliveries lag writes on a loaded tier) — the
-                    # revision it was REGISTERED at: everything after
-                    # that is owed, and start_revision=1 would fall
-                    # below the survivor's replay window
-                    # (compact-cancel).  No loss, no duplicates.
-                    resume_from = [
-                        max(m.watch_rev.get(first + i, 0), m.create_rev)
-                        + 1
+                floor = 0
+                resume_at: list[int] = []
+                if hot is not None:
+                    hot_m, rkeys, first = hot
+                    resume_at = [
+                        max(hot_m.watch_rev.get(first + i, 0),
+                            hot_m.create_rev)
                         for i in range(len(rkeys))
                     ]
-                    resume = MuxWatch(channels[0], replica=0)
+                    floor = min(resume_at)
+                victim_mport = _free_port()
+                tier_procs[victim] = subprocess.Popen(
+                    _tier_cmd(
+                        tier_ports[victim],
+                        ["--resume-floor", str(floor),
+                         "--metrics-port", str(victim_mport)],
+                    ),
+                    env=_env,
+                )
+                bind_by = time.monotonic() + 240
+                while True:
+                    if tier_procs[victim].poll() is not None:
+                        raise RuntimeError(
+                            "relaunched replica exited rc="
+                            f"{tier_procs[victim].returncode}"
+                        )
+                    try:
+                        with _socket.create_connection(
+                            ("127.0.0.1", tier_ports[victim]), timeout=0.2
+                        ):
+                            break
+                    except OSError:
+                        if time.monotonic() > bind_by:
+                            raise TimeoutError(
+                                "relaunched replica did not bind"
+                            )
+                        # Deadline-bounded readiness poll, not an op retry.
+                        await asyncio.sleep(0.05)  # graftlint: disable=retry-through-policy
+                chan = aio.insecure_channel(
+                    f"127.0.0.1:{tier_ports[victim]}",
+                    options=[("grpc.max_receive_message_length", 64 << 20)],
+                )
+                channels.append(chan)
+                if hot is not None:
+                    resume = MuxWatch(chan, replica=victim)
                     await resume.create(
-                        rkeys, first, start_revision=resume_from
+                        rkeys, first,
+                        start_revision=[r + 1 for r in resume_at],
                     )
                     try:
-                        await resume.wait_created(len(rkeys), timeout=60)
+                        await resume.wait_created(len(rkeys), timeout=120)
                     except TimeoutError as e:
                         raise TimeoutError(
                             f"{e}; canceled={resume.canceled} "
-                            f"resume_from={resume_from} "
-                            f"survivor_alive={tier_procs[0].poll() is None}"
+                            f"floor={floor}"
                         ) from None
                     muxes.append(resume)
+                # The victim's idle watches re-register plain: their
+                # keys never changed, so they carry no resume
+                # obligation (nothing to replay, nothing to relist).
+                reattached_idle = 0
+                for mm, ikeys, ifirst in creates:
+                    if mm not in dead_muxes:
+                        continue
+                    im = MuxWatch(chan, replica=victim)
+                    await im.create(ikeys, ifirst)
+                    await im.wait_created(len(ikeys), timeout=240)
+                    muxes.append(im)
+                    reattached_idle += len(ikeys)
+                warm_restart = {
+                    "resume_floor": floor,
+                    "restart_seconds": round(
+                        time.perf_counter() - t_kill, 2
+                    ),
+                    "reattached_hot": len(resume_at),
+                    "reattached_idle": reattached_idle,
+                }
         # Wait for deliveries to drain.
         deadline = time.monotonic() + 120
         while (
@@ -371,6 +516,35 @@ async def amain(args) -> dict:
             await asyncio.sleep(0.05)
         window = time.perf_counter() - t0
         delivered = sum(m.delivered for m in muxes) - base_delivered
+
+        if warm_restart is not None:
+            # The relaunched replica's own counters are the warm-restart
+            # receipt: resumes (reprime diff replay) moved, invalidations
+            # (the relist-everyone path) did not.
+            import urllib.request
+
+            def _scrape():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{victim_mport}/metrics", timeout=10
+                ) as r:
+                    return r.read().decode()
+
+            counts: dict = {}
+            for line in (await asyncio.to_thread(_scrape)).splitlines():
+                if line.startswith("#") or not line.strip():
+                    continue
+                name, _, val = line.rpartition(" ")
+                base_name = name.split("{", 1)[0]
+                try:
+                    counts[base_name] = counts.get(base_name, 0.0) + float(val)
+                except ValueError:
+                    continue
+            warm_restart["resumes"] = int(
+                counts.get("watchcache_resumes_total", 0)
+            )
+            warm_restart["invalidations"] = int(
+                counts.get("watchcache_invalidations_total", 0)
+            )
 
         for m in muxes:
             await m.close()
@@ -403,11 +577,31 @@ async def amain(args) -> dict:
         "delivered_per_sec": round(delivered / window, 1),
         "canceled": sum(m.canceled for m in muxes),
     }
+    if n_rep > 1:
+        agg = round(delivered / window, 1)
+        if calib_rate is not None:
+            out["scaling"] = {
+                "effective_cpus": cpus,
+                "single_replica_delivered_per_sec": calib_rate,
+                "aggregate_delivered_per_sec": agg,
+                "speedup": round(agg / max(1e-9, calib_rate), 2),
+                # Linear-ish: the fleet must beat one replica by 1.5x
+                # before we call the replicas a scaling story.
+                "gate_linear_scaling": agg >= 1.5 * calib_rate,
+            }
+        else:
+            out["scaling"] = {
+                "effective_cpus": cpus,
+                "mode": (
+                    "correctness-only: <2 effective cpus, the replicas "
+                    "timeshare one core so no linearity is claimed"
+                ),
+            }
     if killed_at is not None:
         out["kill_one"] = {
             "killed_after_writes": killed_at,
-            "lost_idle_watches": lost_idle,
             "no_event_loss": delivered >= args.writes,
+            "warm_restart": warm_restart,
         }
     return out
 

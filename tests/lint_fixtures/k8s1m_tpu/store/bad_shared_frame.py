@@ -1,0 +1,9 @@
+"""shared-frame-no-per-watch-encode true positive: a per-watcher loop
+in store/ that re-serializes the same response once per subscriber —
+the encode-bound fan-out the wiretier's shared frame table exists to
+kill (encode once, fan bytes out by reference)."""
+
+
+def fan_out(resp, watchers, out):
+    for w in watchers:
+        out.append((w, resp.SerializeToString()))

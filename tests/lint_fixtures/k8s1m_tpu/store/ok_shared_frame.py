@@ -1,0 +1,10 @@
+"""shared-frame-no-per-watch-encode pragma twin: the same shape with
+the documented escape — per-watch CONTROL acks (created/canceled) are
+per-watch by nature, carry no event payload, and are allowed to
+serialize in the loop when the reason is declared."""
+
+
+def ack_all(ack, watchers, out):
+    for w in watchers:
+        # Tiny per-watch control ack, not event fan-out.
+        out.append((w, ack.SerializeToString()))  # graftlint: disable=shared-frame-no-per-watch-encode (per-watch control ack)

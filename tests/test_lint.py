@@ -53,6 +53,7 @@ EXPECTED = {
     "nondet-to-placement": "k8s1m_tpu/engine/bad_nondet.py",
     "blocking-under-lock": "k8s1m_tpu/control/bad_blocking_lock.py",
     "fallback-counts-or-raises": "k8s1m_tpu/store/bad_fallback.py",
+    "shared-frame-no-per-watch-encode": "k8s1m_tpu/store/bad_shared_frame.py",
 }
 
 

@@ -31,10 +31,12 @@ def _run(cmd, timeout, drop_env=()):
 
 
 def test_watch_fanout_storm_smoke_gates():
-    """ISSUE 15 tier-1 gate: the watchplane kill drill at 10K watchers
-    under the named watchstorm plan — zero event loss by ledger, every
-    injected upstream break resolved by resume (not a relist storm),
-    delivery-lag p99 and peak RSS inside the smoke budgets."""
+    """ISSUE 15 + ISSUE 20 tier-1 gate: the watchplane kill drill at
+    10K watchers under the named watchstorm plan — zero event loss by
+    ledger, every injected upstream break resolved by resume (not a
+    relist storm), delivery-lag p99 and peak RSS inside the smoke
+    budgets, the wiretier's shared-frame/compaction wire gates, and the
+    replica SIGKILL warm-restart lane."""
     out = _run(
         [sys.executable, "-m", "k8s1m_tpu.tools.watch_fanout_ab",
          "--smoke"],
@@ -47,7 +49,9 @@ def test_watch_fanout_storm_smoke_gates():
     assert out["passed"] is True, json.dumps(out, indent=1)
     assert out["shape"]["watchers"] >= 9_900
     ev = out["evidence"]
-    assert ev["store_watchers"] == 2          # fan-out proof holds
+    # Fan-out proof: 2 main-tier prefix watches + the replica's lease
+    # slice watch, regardless of the 10K client watches.
+    assert ev["store_watchers"] == 3
     assert ev["upstream_breaks"] > 0
     assert ev["resume_rate"] >= 0.9
     assert ev["lagging_at_quiesce"] == 0
@@ -55,6 +59,15 @@ def test_watch_fanout_storm_smoke_gates():
     assert ev["idle_delivered"] == 0
     assert ev["lag_p99_ms"] <= ev["p99_budget_s"] * 1000
     assert ev["rss_mb_at_quiesce"] <= ev["rss_budget_mb"]
+    # ISSUE 20 wire gates ride the pass bit; pin the evidence shape too.
+    assert out["gates"]["wire_compaction"] is True
+    assert out["gates"]["replica_warm_restart"] is True
+    assert ev["frames_shared_ratio"] > 0.5    # hot frames actually share
+    assert ev["bytes_per_delivered_event"] < ev["unshared_bytes_per_event"]
+    assert ev["wire_compaction_drop"] >= ev["measured_fanout"]
+    rep = ev["replica_drill"]
+    assert rep["resumes"] >= 1 and rep["invalidations"] == 0
+    assert rep["replica_delivered"] > 0
 
 
 def test_shard_bench_smoke_two_workers_disjoint_and_done():
@@ -119,11 +132,13 @@ def test_watch_scale_smoke_mux_and_fanout():
 
 
 def test_watch_scale_replicas_kill_one_no_loss():
-    """Replicated tier drill: 3 caches over one store, hot watches
-    spread across replicas, the last replica SIGKILLed mid-fan-out, its
-    watches re-attached to a survivor from per-watch resume revisions —
-    every write still delivered exactly once (the haproxy
-    pulls-a-dead-backend contract, reference README.adoc:721-723)."""
+    """Replicated fleet drill (ISSUE 20): 3 caches over one store, hot
+    watches placed by the consistent-hash SubscriptionMap, one replica
+    SIGKILLed mid-fan-out and WARM-RESTARTED with --resume-floor — its
+    watch population re-attaches from per-watch resume revisions (a
+    resume, never an invalidation) and every write is still delivered
+    exactly once (the haproxy pulls-a-dead-backend contract, reference
+    README.adoc:721-723)."""
     idle, active, writes = 600, 90, 600
     out = _run(
         [
@@ -137,7 +152,17 @@ def test_watch_scale_replicas_kill_one_no_loss():
     assert out["store_watchers"] == 6       # 3 replicas x 2 prefixes
     assert out["delivered"] == writes       # no loss, no duplicates
     assert out["kill_one"]["no_event_loss"] is True
-    assert out["kill_one"]["lost_idle_watches"] > 0
+    wr = out["kill_one"]["warm_restart"]
+    assert wr["resume_floor"] > 0
+    assert wr["reattached_hot"] > 0 and wr["reattached_idle"] > 0
+    assert wr["resumes"] >= 1 and wr["invalidations"] == 0
+    # Scaling lane: linearity when the host has the cores to show it,
+    # an explicit correctness-only declaration when it doesn't.
+    sc = out["scaling"]
+    if "gate_linear_scaling" in sc:
+        assert sc["gate_linear_scaling"] is True, sc
+    else:
+        assert sc["mode"].startswith("correctness-only")
 
 
 def test_soak_smoke_secured_tier():

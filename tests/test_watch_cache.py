@@ -940,3 +940,339 @@ def test_prime_paginates_large_prefixes(loop):
 
     loop.run_until_complete(go())
     store.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20 wiretier: shared-frame encoding (one encode fanned out by
+# reference), per-watch start_revision filtering over SHARED frames (no
+# frame fork), and replica warm restart via --resume-floor.
+
+
+def _cache_events(resp):
+    """Rebuild CacheEvents from a parsed WatchResponse (to re-encode
+    the unshared reference for the byte-identity differential)."""
+    from k8s1m_tpu.store.watch_cache import CacheEvent
+
+    return [
+        CacheEvent(
+            1 if e.type else 0, e.kv.key, e.kv.value,
+            e.kv.create_revision, e.kv.mod_revision, e.kv.version,
+        )
+        for e in resp.events
+    ]
+
+
+def test_compose_frame_byte_identity_and_extension_tail():
+    """The license for every sharing trick: a frame composed from
+    independently encoded parts is byte-identical to the constructor
+    path, and the shared-wid/from-rev extension parses as preserved
+    unknown fields with the core slice untouched."""
+    from k8s1m_tpu.store import wiretier
+    from k8s1m_tpu.store.native import decode_shared_tail
+    from k8s1m_tpu.store.proto import rpc_pb2
+    from k8s1m_tpu.store.watch_cache import CacheEvent, encode_event_batch
+
+    header = rpc_pb2.ResponseHeader(
+        cluster_id=1, member_id=2, revision=777, raft_term=1
+    )
+    events = [
+        CacheEvent(0, PFX + b"a", b"v1", 7, 9, 2),
+        CacheEvent(1, PFX + b"b", b"", 5, 10, 3),          # DELETE
+        CacheEvent(0, PFX + b"big", b"x" * 3000, 11, 300000, 41),
+    ]
+    hb = wiretier.header_bytes(header)
+    chunks = [wiretier.encode_event(e) for e in events]
+    for wid in (1, 7, 300000):     # 1-byte and multi-byte varint ids
+        composed = wiretier.compose_frame(hb, [wid], chunks)
+        assert composed == encode_event_batch(
+            header, wid, events
+        ).SerializeToString()
+        assert decode_shared_tail(composed) == ([], 0, len(composed))
+    # Shared frame: extra wids + compaction lower bound ride the tail;
+    # the core slice stays byte-identical to the single-wid response
+    # and a stock parser sees a normal watch_id=7 frame.
+    shared = wiretier.compose_frame(hb, [7, 9, 123456], chunks,
+                                    from_rev=777)
+    extra, from_rev, core = decode_shared_tail(shared)
+    assert (extra, from_rev) == ([9, 123456], 777)
+    assert shared[:core] == encode_event_batch(
+        header, 7, events
+    ).SerializeToString()
+    resp = rpc_pb2.WatchResponse.FromString(shared)
+    assert resp.watch_id == 7 and len(resp.events) == 3
+    assert resp.events[2].kv.mod_revision == 300000
+
+
+class _RawWatch:
+    """Raw-bytes watch mux for the shared-frame tests: one bidi stream,
+    responses kept un-deserialized so frames can be asserted at the byte
+    level (extension tail, core identity) before proto parsing."""
+
+    def __init__(self, target: str):
+        from grpc import aio
+
+        from k8s1m_tpu.store.proto import rpc_pb2
+
+        self._pb = rpc_pb2
+        self._chan = aio.insecure_channel(target)
+        self._call = self._chan.stream_stream(
+            "/etcdserverpb.Watch/Watch",
+            request_serializer=rpc_pb2.WatchRequest.SerializeToString,
+            response_deserializer=lambda b: b,
+        )()
+        self.created: set[int] = set()
+        self.frames: asyncio.Queue = asyncio.Queue()
+        self._reader = asyncio.create_task(self._read())
+
+    async def create(self, wid: int, key: bytes, end: bytes = b"",
+                     start_revision: int = 0) -> None:
+        pb = self._pb
+        await self._call.write(
+            pb.WatchRequest(
+                create_request=pb.WatchCreateRequest(
+                    key=key, range_end=end, watch_id=wid,
+                    start_revision=start_revision,
+                )
+            )
+        )
+        for _ in range(500):
+            if wid in self.created:
+                return
+            await asyncio.sleep(0.01)
+        raise TimeoutError(f"watch {wid} never acked")
+
+    async def _read(self) -> None:
+        pb = self._pb
+        try:
+            async for raw in self._call:
+                resp = pb.WatchResponse.FromString(raw)
+                if resp.created:
+                    self.created.add(resp.watch_id)
+                elif resp.events:
+                    await self.frames.put(raw)
+                # progress/cancel control frames: not under test here
+        # Teardown path: stream cancel/goaway during test exit.
+        except (asyncio.CancelledError, Exception):  # graftlint: disable=broad-except (reader teardown: any stream error here is the test closing the channel)
+            pass
+
+    async def next_frame(self, timeout: float = 5.0) -> bytes:
+        return await asyncio.wait_for(self.frames.get(), timeout)
+
+    async def close(self) -> None:
+        self._reader.cancel()
+        try:
+            await self._reader
+        except (asyncio.CancelledError, Exception):  # graftlint: disable=broad-except (close path: the reader is being torn down either way)
+            pass
+        await self._chan.close()
+
+
+def _wiretier_env(loop, **tier_kwargs):
+    """store + tier + clients for the wiretier tests, with the tier's
+    port in hand (the raw mux dials it directly)."""
+    store = MemStore()
+    state = {}
+
+    async def up():
+        server, port = await serve(store, port=0)
+        sclient = EtcdClient(f"127.0.0.1:{port}")
+        await sclient.put(PFX + b"seed", b"s0")
+        tier = await serve_watch_cache(
+            f"127.0.0.1:{port}", [PFX], port=0, **tier_kwargs
+        )
+        state.update(server=server, sclient=sclient, tier=tier)
+        return sclient, tier
+
+    sclient, tier = loop.run_until_complete(up())
+
+    def down():
+        async def _down():
+            await state["sclient"].close()
+            await state["tier"].close()
+            await state["server"].stop(None)
+
+        loop.run_until_complete(_down())
+        store.close()
+
+    return store, sclient, tier, down
+
+
+def test_shared_frame_multi_wid_on_the_wire(loop):
+    """Two watches on one stream owing the same event get ONE frame:
+    the extra wid rides the extension tail, the core slice is
+    byte-identical to the unshared single-watch encoding, and both
+    watches count as delivered."""
+    from k8s1m_tpu.store.native import decode_shared_tail
+    from k8s1m_tpu.store.proto import rpc_pb2
+    from k8s1m_tpu.store.watch_cache import encode_event_batch
+
+    store, sclient, tier, down = _wiretier_env(loop)
+
+    async def go():
+        mux = _RawWatch(f"127.0.0.1:{tier.port}")
+        try:
+            await mux.create(1, PFX + b"hot")
+            await mux.create(2, PFX + b"hot")
+            await sclient.put(PFX + b"hot", b"v1")
+            raw = await mux.next_frame()
+            extra, from_rev, core = decode_shared_tail(raw)
+            resp = rpc_pb2.WatchResponse.FromString(raw)
+            # One frame, both wids: primary in the known field, the
+            # peer in the extension tail (order is sweep-internal).
+            assert sorted([resp.watch_id, *extra]) == [1, 2]
+            assert from_rev == 0           # queue drain, not a window
+            assert len(resp.events) == 1
+            assert resp.events[0].kv.value == b"v1"
+            # The core slice IS the unshared encoding for the primary.
+            assert raw[:core] == encode_event_batch(
+                resp.header, resp.watch_id, _cache_events(resp)
+            ).SerializeToString()
+            # Nothing further owed: the peer's copy was this same frame.
+            with pytest.raises(asyncio.TimeoutError):
+                await mux.next_frame(timeout=0.3)
+            st = tier.cache.stats()
+            assert st["events_delivered"] == 2   # one event x two watches
+        finally:
+            await mux.close()
+
+    try:
+        loop.run_until_complete(go())
+    finally:
+        down()
+
+
+def test_shared_frame_respects_per_watch_resume_point(loop):
+    """Satellite 2: two watchers with different start_revisions replay
+    over the SAME frame table — the older one gets the full window, the
+    newer one only its suffix, each stream byte-identical to unshared
+    encoding, and the table is never forked: encodes move once per
+    DISTINCT event, the overlap is served from hits."""
+    from k8s1m_tpu.store.native import decode_shared_tail
+    from k8s1m_tpu.store.proto import rpc_pb2
+    from k8s1m_tpu.store.watch_cache import encode_event_batch
+
+    store, sclient, tier, down = _wiretier_env(loop)
+    encodes = REGISTRY.get("watchcache_frame_encodes_total")
+    hits = REGISTRY.get("watchcache_frame_hits_total")
+
+    async def drain(mux, wid, n):
+        """Collect ``n`` events for ``wid``, asserting every frame's
+        core slice is byte-identical to the unshared encoding."""
+        got = []
+        while len(got) < n:
+            raw = await mux.next_frame()
+            extra, _fr, core = decode_shared_tail(raw)
+            resp = rpc_pb2.WatchResponse.FromString(raw)
+            assert wid in (resp.watch_id, *extra)
+            assert raw[:core] == encode_event_batch(
+                resp.header, resp.watch_id, _cache_events(resp)
+            ).SerializeToString()
+            got += [(e.kv.value, e.kv.mod_revision) for e in resp.events]
+        return got
+
+    async def go():
+        revs = []
+        for i in range(4):
+            revs.append(await sclient.put(PFX + b"k%d" % i, b"v%d" % i))
+        for _ in range(200):
+            if tier.cache.last_revision >= revs[-1]:
+                break
+            await asyncio.sleep(0.01)
+
+        mux = _RawWatch(f"127.0.0.1:{tier.port}")
+        try:
+            e0, h0 = encodes.value(), hits.value()
+            # A resumes from the first write: full 4-event replay.
+            await mux.create(1, PFX, prefix_end(PFX),
+                             start_revision=revs[0])
+            assert await drain(mux, 1, 4) == [
+                (b"v%d" % i, revs[i]) for i in range(4)
+            ]
+            assert encodes.value() - e0 == 4
+            assert hits.value() - h0 == 0
+            # B resumes two writes later: only the suffix — the filter
+            # is index selection over the SAME table (no re-encode).
+            await mux.create(2, PFX, prefix_end(PFX),
+                             start_revision=revs[2])
+            assert await drain(mux, 2, 2) == [
+                (b"v2", revs[2]), (b"v3", revs[3])
+            ]
+            assert encodes.value() - e0 == 4     # no frame fork
+            assert hits.value() - h0 == 2        # overlap from the table
+            # Converged: the next live event is ONE shared frame.
+            await sclient.put(PFX + b"k9", b"live")
+            raw = await mux.next_frame()
+            extra, _fr, _core = decode_shared_tail(raw)
+            resp = rpc_pb2.WatchResponse.FromString(raw)
+            assert sorted([resp.watch_id, *extra]) == [1, 2]
+            assert resp.events[0].kv.value == b"live"
+            # One distinct event, TWO tables: the store server encodes
+            # it once for the tier's upstream stream, the tier once for
+            # the downstream fan-out — still never per-watch.
+            assert encodes.value() - e0 == 6
+        finally:
+            await mux.close()
+
+    try:
+        loop.run_until_complete(go())
+    finally:
+        down()
+
+
+def test_tier_warm_restart_resumes_from_floor(loop):
+    """Replica warm restart (--resume-floor): a tier started with a
+    resume floor below its priming revision back-fills the
+    [floor+1, prime] window from upstream history — counted as a
+    RESUME, not an invalidation — so clients re-attach from revision
+    instead of relisting."""
+    resumes = REGISTRY.get("watchcache_resumes_total")
+    invals = REGISTRY.get("watchcache_invalidations_total")
+
+    store = MemStore()
+
+    async def seed():
+        server, port = await serve(store, port=0)
+        sclient = EtcdClient(f"127.0.0.1:{port}")
+        revs = [await sclient.put(PFX + b"w%d" % i, b"v%d" % i)
+                for i in range(5)]
+        return server, port, sclient, revs
+
+    server, port, sclient, revs = loop.run_until_complete(seed())
+    r0, i0 = resumes.value(), invals.value()
+
+    async def go():
+        # "Restarted" tier: floor = the revision a previous incarnation
+        # had confirmed (after the second write).
+        tier = await serve_watch_cache(
+            f"127.0.0.1:{port}", [PFX], port=0, resume_floor=revs[1]
+        )
+        cclient = EtcdClient(f"127.0.0.1:{tier.port}")
+        try:
+            assert resumes.value() - r0 == 1
+            assert invals.value() - i0 == 0
+            # A client that last saw revs[1] re-attaches from revision
+            # and replays exactly the missed suffix — no relist.
+            s = cclient.watch(PFX, prefix_end(PFX),
+                              start_revision=revs[1] + 1)
+            async with s:
+                vals = []
+                while len(vals) < 3:
+                    b = await s.next(timeout=5)
+                    vals += [(e.kv.value, e.kv.mod_revision)
+                             for e in b.events]
+                assert vals == [(b"v%d" % i, revs[i]) for i in (2, 3, 4)]
+                assert not s.canceled
+                await s.cancel()
+        finally:
+            await cclient.close()
+            await tier.close()
+
+    try:
+        loop.run_until_complete(go())
+    finally:
+        async def down():
+            await sclient.close()
+            await server.stop(None)
+
+        loop.run_until_complete(down())
+        store.close()

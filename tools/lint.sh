@@ -14,7 +14,11 @@
 # The pre-commit fast path is `tools/lint.sh --changed-only` — it lints
 # just the touched files and composes with --jobs; cross-file rules
 # still see the whole tree for context, so findings don't flicker with
-# the subset.
+# the subset.  Per-file passes (including the wiretier's
+# shared-frame-no-per-watch-encode rule: no SerializeToString /
+# encode_event_batch inside a per-watch loop in store/) fire on the
+# changed subset exactly as they would on the full tree, so a fan-out
+# re-encode is caught before the commit, not in tier-1.
 #
 # Exit 0 = clean (every finding fixed, pragma'd, or baselined and the
 # committed lint_baseline.txt matches the tree exactly); nonzero fails
